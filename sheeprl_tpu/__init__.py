@@ -1,0 +1,70 @@
+"""sheeprl_tpu — a TPU-native reinforcement-learning framework.
+
+A ground-up JAX/XLA re-design with the capability surface of the reference
+SheepRL (PyTorch/Lightning-Fabric): the same algorithms, Hydra-style recipes,
+replay buffers, and distributed training modes, but built TPU-first — flax
+modules, one jit-compiled train step per algorithm with `lax.scan` time loops,
+SPMD data-parallelism over a `jax.sharding.Mesh`, numpy host ring buffers
+double-buffering host→HBM transfers, and Orbax checkpoints.
+"""
+
+from sheeprl_tpu.utils.imports import _IS_WINDOWS  # noqa: F401
+
+__version__ = "0.1.0"
+
+_ALGOS_REGISTERED = False
+
+# Every built-in algorithm module (reference registers them as an import side
+# effect in sheeprl/__init__.py:18-45). Modules not present yet simply don't
+# register, and the CLI reports what *is* available.
+_ALGO_MODULES = [
+    "sheeprl_tpu.algos.ppo.ppo",
+    "sheeprl_tpu.algos.ppo.ppo_decoupled",
+    "sheeprl_tpu.algos.ppo.evaluate",
+    "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent",
+    "sheeprl_tpu.algos.ppo_recurrent.evaluate",
+    "sheeprl_tpu.algos.a2c.a2c",
+    "sheeprl_tpu.algos.a2c.evaluate",
+    "sheeprl_tpu.algos.sac.sac",
+    "sheeprl_tpu.algos.sac.sac_decoupled",
+    "sheeprl_tpu.algos.sac.evaluate",
+    "sheeprl_tpu.algos.sac_ae.sac_ae",
+    "sheeprl_tpu.algos.sac_ae.evaluate",
+    "sheeprl_tpu.algos.droq.droq",
+    "sheeprl_tpu.algos.droq.evaluate",
+    "sheeprl_tpu.algos.dreamer_v1.dreamer_v1",
+    "sheeprl_tpu.algos.dreamer_v1.evaluate",
+    "sheeprl_tpu.algos.dreamer_v2.dreamer_v2",
+    "sheeprl_tpu.algos.dreamer_v2.evaluate",
+    "sheeprl_tpu.algos.dreamer_v3.dreamer_v3",
+    "sheeprl_tpu.algos.dreamer_v3.evaluate",
+    "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_exploration",
+    "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_finetuning",
+    "sheeprl_tpu.algos.p2e_dv1.evaluate",
+    "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_exploration",
+    "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_finetuning",
+    "sheeprl_tpu.algos.p2e_dv2.evaluate",
+    "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration",
+    "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_finetuning",
+    "sheeprl_tpu.algos.p2e_dv3.evaluate",
+]
+
+
+def register_algorithms(strict: bool = False) -> None:
+    """Import every algorithm module so decorator registration runs.
+
+    Deferred (unlike the reference's eager import block) so that importing
+    :mod:`sheeprl_tpu` stays cheap; the CLI calls this before registry lookup.
+    """
+    global _ALGOS_REGISTERED
+    if _ALGOS_REGISTERED:
+        return
+    import importlib
+
+    for mod in _ALGO_MODULES:
+        try:
+            importlib.import_module(mod)
+        except ModuleNotFoundError as e:
+            if strict or not e.name.startswith("sheeprl_tpu"):
+                raise
+    _ALGOS_REGISTERED = True

@@ -1,0 +1,19 @@
+from sheeprl_tpu.config.engine import (
+    MISSING,
+    SEARCH_PATH_ENV_VAR,
+    available_options,
+    build_search_path,
+    compose,
+    to_yaml,
+    yaml_load,
+)
+
+__all__ = [
+    "MISSING",
+    "SEARCH_PATH_ENV_VAR",
+    "available_options",
+    "build_search_path",
+    "compose",
+    "to_yaml",
+    "yaml_load",
+]

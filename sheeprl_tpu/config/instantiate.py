@@ -1,0 +1,82 @@
+"""`_target_`-style object instantiation (hydra.utils.instantiate parity).
+
+The reference builds objects straight from config via ``_target_`` +
+``hydra.utils.instantiate`` (cli.py:93, ppo.py:192, utils/env.py:72). We keep
+that surface, plus an alias table so *reference* config trees (pointing at
+``sheeprl.*`` / ``torch*`` / ``lightning*`` classes) resolve to their TPU-native
+equivalents — this is what makes the reference's own recipes runnable here.
+"""
+
+from __future__ import annotations
+
+import importlib
+from functools import partial
+from typing import Any, Dict
+
+# reference class path -> tpu-native class path
+TARGET_ALIASES: Dict[str, str] = {
+    "lightning.fabric.Fabric": "sheeprl_tpu.fabric.Fabric",
+    "sheeprl.utils.callback.CheckpointCallback": "sheeprl_tpu.utils.callback.CheckpointCallback",
+    "sheeprl.utils.metric.MetricAggregator": "sheeprl_tpu.utils.metric.MetricAggregator",
+    "torchmetrics.MeanMetric": "sheeprl_tpu.utils.metric.MeanMetric",
+    "torchmetrics.SumMetric": "sheeprl_tpu.utils.metric.SumMetric",
+    "torchmetrics.MaxMetric": "sheeprl_tpu.utils.metric.MaxMetric",
+    "torchmetrics.MinMetric": "sheeprl_tpu.utils.metric.MinMetric",
+    "torch.optim.Adam": "sheeprl_tpu.utils.optim.Adam",
+    "torch.optim.AdamW": "sheeprl_tpu.utils.optim.AdamW",
+    "torch.optim.SGD": "sheeprl_tpu.utils.optim.SGD",
+    "gym.make": "gymnasium.make",
+}
+# any other `sheeprl.` path maps onto the same path under `sheeprl_tpu.`
+_PREFIX_ALIASES = {"sheeprl.": "sheeprl_tpu."}
+
+
+def resolve_target(path: str) -> Any:
+    path = TARGET_ALIASES.get(path, path)
+    for old, new in _PREFIX_ALIASES.items():
+        if path.startswith(old):
+            path = new + path[len(old):]
+            break
+    module_name, _, attr = path.rpartition(".")
+    if not module_name:
+        raise ValueError(f"Cannot resolve target '{path}'")
+    module = importlib.import_module(module_name)
+    return getattr(module, attr)
+
+
+def instantiate(cfg: Any, *args: Any, **kwargs: Any) -> Any:
+    """Build the object described by ``cfg['_target_']``.
+
+    Remaining keys become keyword arguments (nested ``_target_`` dicts are
+    instantiated recursively). ``_partial_: true`` returns a functools.partial.
+    """
+    if cfg is None:
+        return None
+    if isinstance(cfg, (list, tuple)):
+        return [instantiate(c) for c in cfg]
+    if not isinstance(cfg, dict):
+        raise TypeError(f"instantiate expects a dict with _target_, got {type(cfg)}")
+    cfg = dict(cfg)
+    target = cfg.pop("_target_", None)
+    if target is None:
+        raise ValueError(f"Missing _target_ in config: {cfg}")
+    is_partial = bool(cfg.pop("_partial_", False))
+    cfg.pop("_convert_", None)
+    fn = resolve_target(target)
+
+    def convert(v):
+        # hydra's _recursive_=True default: instantiate _target_ dicts found
+        # anywhere inside plain containers too (metrics dicts, callback lists)
+        if isinstance(v, dict):
+            if "_target_" in v:
+                return instantiate(v)
+            return {k: convert(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [convert(x) for x in v]
+        return v
+
+    final_kwargs = {k: convert(v) for k, v in cfg.items()}
+    final_kwargs.update(kwargs)
+    if is_partial:
+        return partial(fn, *args, **final_kwargs)
+    return fn(*args, **final_kwargs)

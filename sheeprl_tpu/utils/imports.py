@@ -1,0 +1,26 @@
+"""Optional-dependency probes (reference sheeprl/utils/imports.py:1-15)."""
+
+from __future__ import annotations
+
+import importlib.util
+import platform
+
+
+def _module_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except Exception:
+        return False
+
+
+_IS_WINDOWS = platform.system() == "Windows"
+
+_IS_ATARI_AVAILABLE = _module_available("ale_py")
+_IS_ATARI_ROMS_AVAILABLE = _IS_ATARI_AVAILABLE
+_IS_DMC_AVAILABLE = _module_available("dm_control")
+_IS_CRAFTER_AVAILABLE = _module_available("crafter")
+_IS_DIAMBRA_AVAILABLE = _module_available("diambra")
+_IS_DIAMBRA_ARENA_AVAILABLE = _module_available("diambra.arena")
+_IS_MINEDOJO_AVAILABLE = _module_available("minedojo")
+_IS_MINERL_AVAILABLE = _module_available("minerl")
+_IS_TORCH_AVAILABLE = _module_available("torch")

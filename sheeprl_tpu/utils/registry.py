@@ -1,0 +1,76 @@
+"""Algorithm / evaluation registries.
+
+Mirrors the reference ``sheeprl/utils/registry.py`` (decorators at :88 and :95,
+registry dicts at :11-12): decorating an entrypoint registers it under its
+defining module, and importing :mod:`sheeprl_tpu` registers every built-in
+algorithm as an import side effect (reference ``sheeprl/__init__.py:18-45``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+# {module_name: [{"name": algo_name, "entrypoint": fn_name, "decoupled": bool}]}
+algorithm_registry: Dict[str, List[Dict[str, Any]]] = {}
+evaluation_registry: Dict[str, List[Dict[str, Any]]] = {}
+
+
+def _register_algorithm(fn: Callable, decoupled: bool = False) -> Callable:
+    module = fn.__module__
+    entrypoint = fn.__name__
+    algos = algorithm_registry.setdefault(module, [])
+    name = module.split(".")[-1]
+    if any(a["name"] == name for a in algos):
+        raise ValueError(f"Algorithm '{name}' already registered in module '{module}'")
+    algos.append({"name": name, "entrypoint": entrypoint, "decoupled": decoupled})
+    return fn
+
+
+def _register_evaluation(fn: Callable, algorithms: Any) -> Callable:
+    module = fn.__module__
+    entrypoint = fn.__name__
+    if isinstance(algorithms, str):
+        algorithms = [algorithms]
+    evals = evaluation_registry.setdefault(module, [])
+    for algo in algorithms:
+        evals.append({"name": algo, "entrypoint": entrypoint})
+    return fn
+
+
+def register_algorithm(decoupled: bool = False) -> Callable:
+    """Decorator: register a ``main(fabric, cfg)`` training entrypoint."""
+
+    def inner(fn: Callable) -> Callable:
+        return _register_algorithm(fn, decoupled=decoupled)
+
+    return inner
+
+
+def register_evaluation(algorithms: Any) -> Callable:
+    """Decorator: register an ``evaluate(fabric, cfg, state)`` entrypoint."""
+
+    def inner(fn: Callable) -> Callable:
+        return _register_evaluation(fn, algorithms)
+
+    return inner
+
+
+def find_algorithm(name: str) -> Optional[Dict[str, Any]]:
+    """Look up a registered algorithm by name → {module, entrypoint, decoupled}."""
+    for module, algos in algorithm_registry.items():
+        for algo in algos:
+            if algo["name"] == name:
+                return {"module": module, **algo}
+    return None
+
+
+def find_evaluation(name: str) -> Optional[Dict[str, Any]]:
+    for module, evals in evaluation_registry.items():
+        for ev in evals:
+            if ev["name"] == name:
+                return {"module": module, **ev}
+    return None
+
+
+def registered_algorithm_names() -> List[str]:
+    return sorted({a["name"] for algos in algorithm_registry.values() for a in algos})

@@ -1,0 +1,31 @@
+"""Test harness setup.
+
+Multi-device testing strategy (reference used 2-process Gloo via Fabric,
+tests/test_algos.py:16-52): here we run JAX on the host CPU platform with 8
+virtual devices so mesh/sharding code paths execute exactly as they would on
+an 8-chip TPU slice, without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _preserve_environ():
+    """Snapshot/restore os.environ around every test (reference
+    tests/conftest.py:20-61 asserts no env-var leaks)."""
+    before = dict(os.environ)
+    yield
+    after = dict(os.environ)
+    for k in after.keys() - before.keys():
+        del os.environ[k]
+    for k, v in before.items():
+        if os.environ.get(k) != v:
+            os.environ[k] = v

@@ -1,0 +1,82 @@
+import os
+
+import pytest
+
+from sheeprl_tpu.config import compose, yaml_load
+from sheeprl_tpu.config.engine import SEARCH_PATH_ENV_VAR
+
+
+def test_compose_ppo_defaults():
+    cfg = compose(overrides=["exp=ppo"])
+    assert cfg.algo.name == "ppo"
+    assert cfg.env.id == "CartPole-v1"
+    assert cfg.total_steps == 65536
+    assert cfg.algo.optimizer.lr == pytest.approx(1e-3)
+    assert cfg.buffer.size == cfg.algo.rollout_steps
+
+
+def test_group_override_beats_exp():
+    cfg = compose(overrides=["exp=ppo", "env=dummy"])
+    assert cfg.env.id == "discrete_dummy"
+    assert cfg.env.wrapper._target_ == "sheeprl_tpu.utils.env.get_dummy_env"
+
+
+def test_value_override_and_interpolation_tracking():
+    cfg = compose(overrides=["exp=ppo", "algo.rollout_steps=8"])
+    assert cfg.algo.rollout_steps == 8
+    assert cfg.buffer.size == 8  # ${algo.rollout_steps}
+    assert cfg.algo.encoder.dense_units == cfg.algo.dense_units
+
+
+def test_missing_exp_raises():
+    with pytest.raises(ValueError, match="exp"):
+        compose(overrides=[])
+
+
+def test_unknown_exp_raises():
+    with pytest.raises(FileNotFoundError):
+        compose(overrides=["exp=not_an_experiment"])
+
+
+def test_scientific_notation_floats():
+    assert yaml_load("2e-4") == pytest.approx(2e-4)
+    assert yaml_load("1e-3") == pytest.approx(1e-3)
+    assert yaml_load("1_000_000") == 1_000_000
+    assert yaml_load("lr: 1e-4")["lr"] == pytest.approx(1e-4)
+
+
+def test_add_and_delete_overrides():
+    cfg = compose(overrides=["exp=ppo", "+algo.new_knob=3", "~algo.anneal_lr"])
+    assert cfg.algo.new_knob == 3
+    assert "anneal_lr" not in cfg.algo
+
+
+def test_search_path_env_var(tmp_path):
+    exp_dir = tmp_path / "exp"
+    exp_dir.mkdir()
+    (exp_dir / "my_exp.yaml").write_text(
+        "# @package _global_\n"
+        "defaults:\n"
+        "  - ppo\n"
+        "  - _self_\n"
+        "total_steps: 123\n"
+    )
+    os.environ[SEARCH_PATH_ENV_VAR] = f"file://{tmp_path};pkg://sheeprl_tpu.configs"
+    try:
+        cfg = compose(overrides=["exp=my_exp"])
+        assert cfg.total_steps == 123
+        assert cfg.algo.name == "ppo"
+    finally:
+        del os.environ[SEARCH_PATH_ENV_VAR]
+
+
+def test_now_resolver_and_run_name():
+    cfg = compose(overrides=["exp=ppo", "exp_name=abc", "seed=9"])
+    assert cfg.run_name.endswith("_abc_9")
+
+
+def test_dotdict_round_trip():
+    cfg = compose(overrides=["exp=ppo"])
+    d = cfg.as_dict()
+    assert isinstance(d, dict)
+    assert d["algo"]["name"] == "ppo"
