@@ -1,0 +1,843 @@
+"""Host-side numpy replay buffers.
+
+Ground-up re-design of the reference data layer for TPU training. The
+reference's fastest variant (v0.5.x "numpy buffers", credited in its README
+benchmark table) stores rollouts as plain numpy dict-of-arrays ``[T, n_envs,
+...]``; we go straight to that design (SURVEY.md preamble) because on TPU the
+buffer *is* the host→HBM staging area: sampling returns numpy batches that the
+prefetcher ships to device with ``jax.device_put`` double-buffering.
+
+API parity (class and method surface mirrors the reference
+``sheeprl/data/buffers.py`` + ``sheeprl/utils/memmap.py``, as pinned by its
+test-suite):
+
+- :class:`ReplayBuffer`       — uniform-sample ring buffer (buffers.py:16-216)
+- :class:`SequentialReplayBuffer` — contiguous sequence sampling (buffers.py:219-339)
+- :class:`EpisodeBuffer`      — whole-episode storage (buffers.py:342-525)
+- :class:`EnvIndependentReplayBuffer` — per-env sub-buffers (buffers.py:528-690)
+
+``sample_tensors``/``to_tensor`` return **jax arrays** (the reference returns
+torch tensors); the optional ``device``/``sharding`` argument stages the batch
+onto HBM (or a mesh sharding) directly.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type, Union
+
+import numpy as np
+
+from sheeprl_tpu.utils.memmap import MemmapArray, validate_memmap_mode
+
+Arrays = Dict[str, Union[np.ndarray, MemmapArray]]
+
+
+def _as_np(v: Union[np.ndarray, MemmapArray]) -> np.ndarray:
+    return v.array if isinstance(v, MemmapArray) else v
+
+
+class ReplayBuffer:
+    """Uniform-sampling ring buffer of shape ``[buffer_size, n_envs, ...]``."""
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        memmap: bool = False,
+        memmap_dir: Optional[Union[str, os.PathLike]] = None,
+        memmap_mode: str = "r+",
+        **kwargs: Any,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+        if isinstance(obs_keys, str):
+            obs_keys = (obs_keys,)
+        self._buffer_size = buffer_size
+        self._n_envs = n_envs
+        self._obs_keys = tuple(obs_keys)
+        self._memmap = memmap
+        self._memmap_dir = os.fspath(memmap_dir) if memmap_dir is not None else None
+        if memmap:
+            validate_memmap_mode(memmap_mode)
+            if self._memmap_dir is None:
+                raise ValueError(
+                    "The buffer is set to be memory-mapped but the 'memmap_dir' attribute is None. "
+                    "Please provide a directory where to save the buffer files."
+                )
+            os.makedirs(self._memmap_dir, exist_ok=True)
+        self._memmap_mode = memmap_mode
+        self._buf: Optional[Arrays] = None
+        self._pos = 0
+        self._full = False
+        self._rng: np.random.Generator = np.random.default_rng()
+
+    # -- properties -------------------------------------------------------
+
+    @property
+    def buffer(self) -> Optional[Arrays]:
+        return self._buf
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def full(self) -> bool:
+        return self._full
+
+    @property
+    def empty(self) -> bool:
+        return self._buf is None
+
+    @property
+    def is_memmap(self) -> bool:
+        return self._memmap
+
+    def __len__(self) -> int:
+        return self._buffer_size
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self._rng = np.random.default_rng(seed)
+
+    # -- storage ----------------------------------------------------------
+
+    def _allocate(self, data: Dict[str, np.ndarray]) -> None:
+        self._buf = {}
+        for k, v in data.items():
+            shape = (self._buffer_size, self._n_envs) + v.shape[2:]
+            if self._memmap:
+                self._buf[k] = MemmapArray(
+                    shape=shape,
+                    dtype=v.dtype,
+                    filename=os.path.join(self._memmap_dir, f"{k}.memmap"),
+                    mode=self._memmap_mode,
+                )
+            else:
+                self._buf[k] = np.empty(shape, dtype=v.dtype)
+
+    def _validate_add(self, data: Any) -> None:
+        if data is None:
+            raise ValueError("The `data` replay buffer must be not None")
+        if not isinstance(data, dict):
+            raise ValueError(
+                "`data` must be a dictionary containing Numpy arrays, "
+                f"but `data` is of type `{type(data)}`"
+            )
+        for k, v in data.items():
+            if not isinstance(v, np.ndarray):
+                raise ValueError(
+                    "`data` must be a dictionary containing Numpy arrays. "
+                    f"Found key `{k}` of type `{type(v)}`"
+                )
+        last_key, last_batch_shape = None, None
+        for k, v in data.items():
+            if v.ndim < 2:
+                raise RuntimeError(
+                    "`data` must have at least 2 dimensions: [sequence_length, n_envs, ...], "
+                    f"key `{k}` has shape {v.shape}"
+                )
+            if v.shape[1] != self._n_envs:
+                raise RuntimeError(
+                    f"The second dimension of `data` must equal n_envs ({self._n_envs}), "
+                    f"key `{k}` has shape {v.shape}"
+                )
+            if last_key is not None and v.shape[:2] != last_batch_shape:
+                raise RuntimeError(
+                    "Every array in 'data' must be congruent in the first 2 dimensions: "
+                    f"key `{k}` has shape {v.shape[:2]}, key `{last_key}` has {last_batch_shape}"
+                )
+            last_key, last_batch_shape = k, v.shape[:2]
+
+    def add(self, data: Union["ReplayBuffer", Dict[str, np.ndarray]], validate_args: bool = False) -> None:
+        """Insert ``[T, n_envs, ...]`` steps with ring wrap-around."""
+        if isinstance(data, ReplayBuffer):
+            data = {k: _as_np(v) for k, v in (data.buffer or {}).items()}
+        if validate_args:
+            self._validate_add(data)
+        data = {k: np.asarray(v) for k, v in data.items()}
+        first = next(iter(data.values()))
+        data_len = first.shape[0]
+        if self._buf is None:
+            self._allocate(data)
+        next_pos = (self._pos + data_len) % self._buffer_size
+        # only the trailing window survives, written at the positions it would
+        # have landed on had every step been inserted one by one
+        write_len = min(data_len, self._buffer_size)
+        start = self._pos + data_len - write_len
+        idxes = np.arange(start, start + write_len) % self._buffer_size
+        for k, v in data.items():
+            self._buf[k][idxes] = v[-write_len:]
+        if self._pos + data_len >= self._buffer_size:
+            self._full = True
+        self._pos = next_pos
+
+    # -- sampling ---------------------------------------------------------
+
+    def _valid_time_indices(self, sample_next_obs: bool) -> np.ndarray:
+        if sample_next_obs:
+            # the newest element has no stored successor
+            if self._full:
+                valid = np.arange(self._buffer_size)
+                newest = (self._pos - 1) % self._buffer_size
+                return np.delete(valid, newest)
+            return np.arange(self._pos - 1)
+        if self._full:
+            return np.arange(self._buffer_size)
+        return np.arange(self._pos)
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        """Uniformly sample ``[n_samples, batch_size, ...]`` transitions."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        if self._buf is None:
+            raise ValueError("No sample has been added to the buffer")
+        if not self._full and self._pos == 0:
+            raise ValueError("No sample has been added to the buffer")
+        valid = self._valid_time_indices(sample_next_obs)
+        if len(valid) == 0:
+            if not self._full:
+                raise RuntimeError(
+                    "You want to sample the next observations, but only one sample has been "
+                    "added to the buffer. Make sure that at least two samples are added."
+                )
+            raise ValueError("No valid sample index to draw from")
+        total = batch_size * n_samples
+        t_idx = valid[self._rng.integers(0, len(valid), size=total)]
+        e_idx = self._rng.integers(0, self._n_envs, size=total)
+        out = self._gather(t_idx, e_idx, sample_next_obs, clone)
+        return {k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in out.items()}
+
+    def _get_samples(self, idxes: np.ndarray, sample_next_obs: bool = False) -> Dict[str, np.ndarray]:
+        if self._buf is None:
+            raise RuntimeError(
+                "The buffer has not been initialized. Try to add some data first."
+            )
+        idxes = np.asarray(idxes, dtype=np.int64).reshape(-1)
+        e_idx = self._rng.integers(0, self._n_envs, size=len(idxes))
+        return self._gather(idxes, e_idx, sample_next_obs, clone=False)
+
+    def _gather(
+        self, t_idx: np.ndarray, e_idx: np.ndarray, sample_next_obs: bool, clone: bool
+    ) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for k, v in self._buf.items():
+            arr = _as_np(v)
+            sel = arr[t_idx, e_idx]
+            out[k] = np.array(sel) if clone else sel
+            if sample_next_obs and k in self._obs_keys:
+                nxt = arr[(t_idx + 1) % self._buffer_size, e_idx]
+                out[f"next_{k}"] = np.array(nxt) if clone else nxt
+        return out
+
+    # -- jax staging ------------------------------------------------------
+
+    def sample_tensors(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        dtype: Optional[Any] = None,
+        device: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        """Like :meth:`sample` but stages the batch to device as jax arrays."""
+        batch = self.sample(batch_size, sample_next_obs, clone, n_samples, **kwargs)
+        return to_device(batch, dtype=dtype, device=device)
+
+    def to_tensor(
+        self, dtype: Optional[Any] = None, clone: bool = False, device: Optional[Any] = None
+    ) -> Dict[str, Any]:
+        if self._buf is None:
+            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+        return to_device({k: _as_np(v) for k, v in self._buf.items()}, dtype=dtype, device=device)
+
+    # -- dict access ------------------------------------------------------
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        if not isinstance(key, str):
+            raise TypeError("'key' must be a string")
+        if self._buf is None:
+            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+        return self._buf[key]
+
+    def __setitem__(self, key: str, value: Union[np.ndarray, MemmapArray]) -> None:
+        if self._buf is None:
+            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+        value_np = _as_np(value)
+        if value_np.shape[:2] != (self._buffer_size, self._n_envs):
+            raise RuntimeError(
+                f"'value' must have shape [{self._buffer_size}, {self._n_envs}, ...], got {value_np.shape}"
+            )
+        if self._memmap:
+            old = self._buf.get(key)
+            if isinstance(old, MemmapArray):
+                if old.shape == value_np.shape and old.dtype == value_np.dtype:
+                    old.array = value_np  # write in place, keep the backing file
+                    return
+                # close+unlink the old mapping *before* re-creating the same path,
+                # else the old owner's __del__ would unlink the new backing file
+                old.__del__()
+                self._buf.pop(key, None)
+            self._buf[key] = MemmapArray.from_array(
+                value_np,
+                filename=os.path.join(self._memmap_dir, f"{key}.memmap"),
+                mode=self._memmap_mode,
+            )
+        else:
+            self._buf[key] = np.array(value_np)
+
+    def __contains__(self, key: str) -> bool:
+        return self._buf is not None and key in self._buf
+
+    # -- checkpointing ----------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "buffer": {k: np.array(_as_np(v)) for k, v in (self._buf or {}).items()},
+            "pos": self._pos,
+            "full": self._full,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        buf = state["buffer"]
+        if buf:
+            if self._buf is None:
+                self._allocate(buf)  # stored arrays are already [size, n_envs, ...]
+            for k, v in buf.items():
+                self._buf[k][...] = v
+        self._pos = int(state["pos"])
+        self._full = bool(state["full"])
+
+
+class SequentialReplayBuffer(ReplayBuffer):
+    """Ring buffer sampling *contiguous* sequences ``[n_samples, seq_len, batch, ...]``.
+
+    Valid sequence starts never straddle the write head ``_pos`` (reference
+    buffers.py:312-339); when the buffer is full, sequences may wrap around
+    the end of storage.
+    """
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        sequence_length: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        if sequence_length <= 0:
+            raise ValueError(f"'sequence_length' ({sequence_length}) must be greater than 0")
+        if self._buf is None or (not self._full and self._pos == 0):
+            raise ValueError("No sample has been added to the buffer")
+        effective_len = sequence_length + (1 if sample_next_obs else 0)
+        total = batch_size * n_samples
+        if self._full:
+            max_offset = self._buffer_size - effective_len
+            if max_offset < 0:
+                raise ValueError(
+                    f"Cannot sample a sequence of length {sequence_length} from a buffer of size "
+                    f"{self._buffer_size}"
+                )
+            offsets = self._rng.integers(0, max_offset + 1, size=total)
+            starts = (self._pos + offsets) % self._buffer_size
+        else:
+            max_start = self._pos - effective_len
+            if max_start < 0:
+                raise ValueError(
+                    f"Cannot sample a sequence of length {sequence_length}: the buffer only "
+                    f"contains {self._pos} steps"
+                )
+            starts = self._rng.integers(0, max_start + 1, size=total)
+        e_idx = self._rng.integers(0, self._n_envs, size=total)
+        # [total, seq_len] absolute time indices (wrap-around safe)
+        seq = (starts[:, None] + np.arange(sequence_length)[None, :]) % self._buffer_size
+        out: Dict[str, np.ndarray] = {}
+        for k, v in self._buf.items():
+            arr = _as_np(v)
+            sel = arr[seq, e_idx[:, None]]  # [total, seq_len, ...]
+            sel = sel.reshape(n_samples, batch_size, sequence_length, *sel.shape[2:])
+            out[k] = np.array(sel.swapaxes(1, 2)) if clone else sel.swapaxes(1, 2)
+            if sample_next_obs and k in self._obs_keys:
+                nseq = (seq + 1) % self._buffer_size
+                nsel = arr[nseq, e_idx[:, None]].reshape(n_samples, batch_size, sequence_length, *sel.shape[3:])
+                out[f"next_{k}"] = np.array(nsel.swapaxes(1, 2)) if clone else nsel.swapaxes(1, 2)
+        return out
+
+
+class EpisodeBuffer:
+    """Whole-episode storage with invariants (reference buffers.py:342-525).
+
+    Episodes are closed by ``dones`` flags; only episodes of length in
+    ``[sequence_length, buffer_size]`` are kept, FIFO-evicted by cumulative
+    step count. Sampling returns ``[n_samples, sequence_length, batch, ...]``
+    windows, optionally biased toward episode ends (``prioritize_ends``).
+    """
+
+    def __init__(
+        self,
+        buffer_size: int,
+        sequence_length: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        prioritize_ends: bool = False,
+        memmap: bool = False,
+        memmap_dir: Optional[Union[str, os.PathLike]] = None,
+        memmap_mode: str = "r+",
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if sequence_length <= 0:
+            raise ValueError(f"The sequence length must be greater than zero, got: {sequence_length}")
+        if buffer_size < sequence_length:
+            raise ValueError(
+                f"The sequence length must be lower than the buffer size, got: bs = {buffer_size}"
+                f" and sl = {sequence_length}"
+            )
+        if isinstance(obs_keys, str):
+            obs_keys = (obs_keys,)
+        self._buffer_size = buffer_size
+        self._sequence_length = sequence_length
+        self._n_envs = n_envs
+        self._obs_keys = tuple(obs_keys)
+        self._prioritize_ends = prioritize_ends
+        self._memmap = memmap
+        self._memmap_dir = os.fspath(memmap_dir) if memmap_dir is not None else None
+        if memmap:
+            validate_memmap_mode(memmap_mode)
+            if self._memmap_dir is None:
+                raise ValueError(
+                    "The buffer is set to be memory-mapped but the 'memmap_dir' attribute is None. "
+                    "Please provide a directory where to save the buffer files."
+                )
+            os.makedirs(self._memmap_dir, exist_ok=True)
+        self._memmap_mode = memmap_mode
+        self._buf: List[Arrays] = []
+        self._open_episodes: List[List[Dict[str, np.ndarray]]] = [[] for _ in range(n_envs)]
+        self._cum_steps = 0  # running step count; kept in sync by save/evict
+        self._rng: np.random.Generator = np.random.default_rng()
+
+    # -- properties -------------------------------------------------------
+
+    @property
+    def buffer(self) -> List[Arrays]:
+        return self._buf
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def sequence_length(self) -> int:
+        return self._sequence_length
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def prioritize_ends(self) -> bool:
+        return self._prioritize_ends
+
+    @property
+    def is_memmap(self) -> bool:
+        return self._memmap
+
+    @property
+    def _cum_length(self) -> int:
+        return self._cum_steps
+
+    @property
+    def full(self) -> bool:
+        return self._buffer_size - self._cum_steps < self._sequence_length
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self._rng = np.random.default_rng(seed)
+
+    # -- insertion --------------------------------------------------------
+
+    def _validate_data(self, data: Any) -> None:
+        if data is None:
+            raise ValueError("The `data` replay buffer must be not None")
+        if not isinstance(data, dict):
+            raise ValueError(
+                "`data` must be a dictionary containing Numpy arrays, "
+                f"but `data` is of type `{type(data)}`"
+            )
+        for k, v in data.items():
+            if not isinstance(v, np.ndarray):
+                raise ValueError(
+                    f"`data` must be a dictionary containing Numpy arrays. Found key `{k}` "
+                    f"of type `{type(v)}`"
+                )
+        last_key, last_shape = None, None
+        for k, v in data.items():
+            if v.ndim < 2:
+                raise RuntimeError(
+                    "`data` must have at least 2: [sequence_length, n_envs, ...], "
+                    f"key `{k}` has shape {v.shape}"
+                )
+            if last_key is not None and v.shape[:2] != last_shape:
+                raise RuntimeError(
+                    "Every array in `data` must be congruent in the first 2 dimensions: "
+                    f"key `{k}` has shape {v.shape[:2]}, key `{last_key}` has {last_shape}"
+                )
+            last_key, last_shape = k, v.shape[:2]
+        if "dones" not in data:
+            raise RuntimeError(f"The episode must contain the `dones` key, got: {set(data.keys())}")
+
+    def add(
+        self,
+        data: Union[Dict[str, np.ndarray], "ReplayBuffer"],
+        env_idxes: Optional[Sequence[int]] = None,
+        validate_args: bool = False,
+    ) -> None:
+        if isinstance(data, ReplayBuffer):
+            data = {k: np.array(_as_np(v)) for k, v in (data.buffer or {}).items()}
+        if validate_args:
+            self._validate_data(data)
+        n_cols = next(iter(data.values())).shape[1] if isinstance(data, dict) and data else 0
+        if env_idxes is None:
+            env_idxes = list(range(n_cols))
+        if validate_args:
+            for idx in env_idxes:
+                if idx < 0 or idx >= self._n_envs:
+                    raise ValueError(
+                        f"The indices of the environment must be integers in [0, {self._n_envs}), "
+                        f"given {idx}"
+                    )
+            if n_cols != len(env_idxes):
+                raise RuntimeError(
+                    f"`data` has {n_cols} env columns but {len(env_idxes)} env indices were given"
+                )
+        for col, env in enumerate(env_idxes):
+            chunk = {k: np.asarray(v)[:, col] for k, v in data.items()}
+            self._add_env_chunk(chunk, env)
+
+    def _add_env_chunk(self, chunk: Dict[str, np.ndarray], env: int) -> None:
+        dones = chunk["dones"].reshape(len(chunk["dones"]), -1)[:, 0]
+        start = 0
+        for t in np.flatnonzero(dones > 0):
+            piece = {k: v[start : t + 1] for k, v in chunk.items()}
+            self._open_episodes[env].append(piece)
+            start = t + 1
+            self._close_episode(env)
+        if start < len(dones):
+            self._open_episodes[env].append({k: v[start:] for k, v in chunk.items()})
+
+    def _close_episode(self, env: int) -> None:
+        chunks = self._open_episodes[env]
+        self._open_episodes[env] = []
+        if not chunks:
+            return
+        length = sum(len(c["dones"]) for c in chunks)
+        if length >= self._sequence_length:
+            self.save_episode(chunks)
+
+    def save_episode(self, episode_chunks: Union[Dict[str, np.ndarray], List[Dict[str, np.ndarray]]]) -> None:
+        """Validate and persist one finished episode (list of chunks or a dict)."""
+        if isinstance(episode_chunks, dict):
+            episode_chunks = [episode_chunks]
+        if len(episode_chunks) == 0:
+            raise RuntimeError("The episode must contain at least one step")
+        episode = {
+            k: np.concatenate([np.asarray(c[k]) for c in episode_chunks], axis=0)
+            for k in episode_chunks[0].keys()
+        }
+        dones = episode["dones"].reshape(len(episode["dones"]), -1)[:, 0]
+        if dones.sum() != 1:
+            raise RuntimeError(f"The episode must contain exactly one done, got: {int(dones.sum())}")
+        if dones[-1] != 1:
+            raise RuntimeError("The last step must contain a done, got: 0")
+        ep_len = len(dones)
+        if ep_len < self._sequence_length or ep_len > self._buffer_size:
+            raise RuntimeError(
+                f"Invalid episode length: the episode length ({ep_len}) must be at least "
+                f"sequence_length ({self._sequence_length}) and at most buffer_size ({self._buffer_size})"
+            )
+        # FIFO eviction by cumulative step count
+        while self._cum_steps + ep_len > self._buffer_size and self._buf:
+            self._evict_oldest()
+        if self._memmap:
+            ep_dir = os.path.join(self._memmap_dir, f"episode_{uuid.uuid4().hex}")
+            episode = {
+                k: MemmapArray.from_array(
+                    v, filename=os.path.join(ep_dir, f"{k}.memmap"), mode=self._memmap_mode
+                )
+                for k, v in episode.items()
+            }
+        self._buf.append(episode)
+        self._cum_steps += ep_len
+
+    def _evict_oldest(self) -> None:
+        old = self._buf.pop(0)
+        self._cum_steps -= len(_as_np(old["dones"]))
+        # unlink memmap files now and remove the per-episode directory
+        dirs = {os.path.dirname(v.filename) for v in old.values() if isinstance(v, MemmapArray)}
+        for v in old.values():
+            if isinstance(v, MemmapArray):
+                v.__del__()
+        old.clear()
+        for d in dirs:
+            try:
+                os.rmdir(d)
+            except OSError:
+                pass
+
+    # -- sampling ---------------------------------------------------------
+
+    def sample(
+        self,
+        batch_size: int,
+        n_samples: int = 1,
+        clone: bool = False,
+        sample_next_obs: bool = False,
+        prioritize_ends: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        if len(self._buf) == 0:
+            raise ValueError("No sample has been added to the buffer")
+        if prioritize_ends is None:
+            prioritize_ends = self._prioritize_ends
+        sl = self._sequence_length
+        effective = sl + (1 if sample_next_obs else 0)
+        lengths = np.array([len(_as_np(ep["dones"])) for ep in self._buf])
+        eligible = np.flatnonzero(lengths >= effective)
+        if len(eligible) == 0:
+            raise ValueError(f"No episode long enough to sample sequences of length {sl}")
+        total = batch_size * n_samples
+        chosen = eligible[self._rng.integers(0, len(eligible), size=total)]
+        out: Dict[str, List[np.ndarray]] = {}
+        for i in chosen:
+            ep = self._buf[i]
+            ep_len = lengths[i]
+            upper = ep_len - effective  # inclusive max start
+            if prioritize_ends:
+                start = min(int(self._rng.integers(0, ep_len)), upper)
+            else:
+                start = int(self._rng.integers(0, upper + 1))
+            for k in ep.keys():
+                arr = _as_np(ep[k])
+                out.setdefault(k, []).append(arr[start : start + sl])
+                if sample_next_obs and k in self._obs_keys:
+                    out.setdefault(f"next_{k}", []).append(arr[start + 1 : start + sl + 1])
+        stacked = {}
+        for k, vs in out.items():
+            arr = np.stack(vs, axis=0).reshape(n_samples, batch_size, sl, *vs[0].shape[1:])
+            arr = arr.swapaxes(1, 2)  # [n_samples, sl, batch, ...]
+            stacked[k] = np.array(arr) if clone else arr
+        return stacked
+
+    def sample_tensors(
+        self,
+        batch_size: int,
+        n_samples: int = 1,
+        clone: bool = False,
+        sample_next_obs: bool = False,
+        prioritize_ends: Optional[bool] = None,
+        dtype: Optional[Any] = None,
+        device: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        batch = self.sample(batch_size, n_samples, clone, sample_next_obs, prioritize_ends, **kwargs)
+        return to_device(batch, dtype=dtype, device=device)
+
+    # -- checkpointing ----------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "buffer": [{k: np.array(_as_np(v)) for k, v in ep.items()} for ep in self._buf],
+            "open_episodes": self._open_episodes,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._buf = []
+        for ep in state["buffer"]:
+            if self._memmap:
+                ep_dir = os.path.join(self._memmap_dir, f"episode_{uuid.uuid4().hex}")
+                ep = {
+                    k: MemmapArray.from_array(
+                        v, filename=os.path.join(ep_dir, f"{k}.memmap"), mode=self._memmap_mode
+                    )
+                    for k, v in ep.items()
+                }
+            self._buf.append(ep)
+        self._cum_steps = sum(len(_as_np(ep["dones"])) for ep in self._buf)
+        self._open_episodes = state.get("open_episodes", [[] for _ in range(self._n_envs)])
+
+
+class EnvIndependentReplayBuffer:
+    """One sub-buffer per environment (reference buffers.py:528-690).
+
+    Keeps vectorized envs with unaligned episode phases temporally coherent:
+    ``add(data, env_idxes)`` routes columns to specific env buffers, sampling
+    draws a balanced mix across envs that hold data.
+    """
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        memmap: bool = False,
+        memmap_dir: Optional[Union[str, os.PathLike]] = None,
+        memmap_mode: str = "r+",
+        buffer_cls: Type[ReplayBuffer] = ReplayBuffer,
+        **kwargs: Any,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+        if memmap:
+            validate_memmap_mode(memmap_mode)
+            if memmap_dir is None:
+                raise ValueError(
+                    "The buffer is set to be memory-mapped but the 'memmap_dir' attribute is None. "
+                    "Please provide a directory where to save the buffer files."
+                )
+        self._buffer_size = buffer_size
+        self._n_envs = n_envs
+        self._buffer_cls = buffer_cls
+        self._concat_along_axis = 2 if issubclass(buffer_cls, SequentialReplayBuffer) else 1
+        self._rng: np.random.Generator = np.random.default_rng()
+        self._buf: List[ReplayBuffer] = [
+            buffer_cls(
+                buffer_size,
+                n_envs=1,
+                obs_keys=obs_keys,
+                memmap=memmap,
+                memmap_dir=os.path.join(os.fspath(memmap_dir), f"env_{i}") if memmap_dir else None,
+                memmap_mode=memmap_mode,
+                **kwargs,
+            )
+            for i in range(n_envs)
+        ]
+
+    @property
+    def buffer(self) -> List[ReplayBuffer]:
+        return self._buf
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def is_memmap(self) -> bool:
+        return all(b.is_memmap for b in self._buf)
+
+    @property
+    def full(self) -> bool:
+        return all(b.full for b in self._buf)
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        for i, b in enumerate(self._buf):
+            b.seed(None if seed is None else seed + i)
+
+    def add(
+        self,
+        data: Dict[str, np.ndarray],
+        env_idxes: Optional[Sequence[int]] = None,
+        validate_args: bool = False,
+    ) -> None:
+        n_cols = next(iter(data.values())).shape[1]
+        if env_idxes is None:
+            env_idxes = list(range(self._n_envs))
+        if n_cols != len(env_idxes):
+            raise ValueError(
+                f"Cannot add data with {n_cols} env columns to {len(env_idxes)} env indices"
+            )
+        for idx in env_idxes:
+            if idx < 0 or idx >= self._n_envs:
+                raise ValueError(
+                    f"The indices of the environment must be integers in [0, {self._n_envs}), given {idx}"
+                )
+        for col, env in enumerate(env_idxes):
+            self._buf[env].add(
+                {k: np.asarray(v)[:, col : col + 1] for k, v in data.items()},
+                validate_args=validate_args,
+            )
+
+    def sample(self, batch_size: int, n_samples: int = 1, **kwargs: Any) -> Dict[str, np.ndarray]:
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        with_data = [i for i, b in enumerate(self._buf) if not b.empty and (b.full or b._pos > 0)]
+        if not with_data:
+            raise ValueError("No sample has been added to the buffer")
+        picks = self._rng.integers(0, len(with_data), size=batch_size)
+        counts = np.bincount(picks, minlength=len(with_data))
+        parts = []
+        for j, env in enumerate(with_data):
+            if counts[j] == 0:
+                continue
+            parts.append(self._buf[env].sample(int(counts[j]), n_samples=n_samples, **kwargs))
+        keys = parts[0].keys()
+        return {k: np.concatenate([p[k] for p in parts], axis=self._concat_along_axis) for k in keys}
+
+    def sample_tensors(
+        self,
+        batch_size: int,
+        n_samples: int = 1,
+        dtype: Optional[Any] = None,
+        device: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        batch = self.sample(batch_size, n_samples=n_samples, **kwargs)
+        return to_device(batch, dtype=dtype, device=device)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"buffers": [b.state_dict() for b in self._buf]}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        for b, s in zip(self._buf, state["buffers"]):
+            b.load_state_dict(s)
+
+
+def to_device(batch: Dict[str, np.ndarray], dtype: Optional[Any] = None, device: Optional[Any] = None):
+    """Stage a numpy batch onto a jax device (or sharding) as one transfer."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    for k, v in batch.items():
+        arr = jnp.asarray(v, dtype=dtype) if device is None else jax.device_put(
+            v.astype(dtype) if dtype is not None else v, device
+        )
+        out[k] = arr
+    return out

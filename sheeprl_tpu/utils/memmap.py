@@ -1,0 +1,163 @@
+"""Disk-backed numpy arrays.
+
+TPU-native counterpart of the reference's ``sheeprl/utils/memmap.MemmapArray``
+(the v0.5.x numpy design its tests target — tests/test_utils/test_memmap.py):
+a picklable, ownership-tracking wrapper over ``np.memmap``. On TPU hosts this
+is the cold tier of the replay buffer: observations live on disk / host RAM
+and only sampled batches are staged to HBM by the prefetcher.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import uuid
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+ACCEPTED_MEMMAP_MODES = ("r+", "w+")
+
+
+def validate_memmap_mode(mode: str) -> str:
+    if mode not in ACCEPTED_MEMMAP_MODES:
+        raise ValueError(
+            f"Accepted values for memmap_mode are {ACCEPTED_MEMMAP_MODES}, got '{mode}'"
+        )
+    return mode
+
+
+class MemmapArray:
+    """A numpy array backed by a file on disk.
+
+    The instance that created the file owns it and unlinks it on deletion;
+    pickled/unpickled copies share the file without ownership (reference
+    semantics, test_memmap.py:46-57).
+    """
+
+    def __init__(
+        self,
+        shape: Sequence[int],
+        dtype: Union[np.dtype, type] = np.float32,
+        filename: Optional[str] = None,
+        mode: str = "r+",
+    ):
+        validate_memmap_mode(mode)
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = np.dtype(dtype)
+        if filename is None:
+            filename = os.path.join(tempfile.gettempdir(), f"memmap_{uuid.uuid4().hex}.memmap")
+        self._filename = os.path.abspath(filename)
+        self._mode = mode
+        self._has_ownership = True
+        self._array: Optional[np.memmap] = None
+        os.makedirs(os.path.dirname(self._filename), exist_ok=True)
+        existed = os.path.isfile(self._filename)
+        self._array = np.memmap(
+            self._filename,
+            dtype=self._dtype,
+            mode="r+" if existed and mode == "r+" else "w+",
+            shape=self._shape,
+        )
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def from_array(
+        cls,
+        array: Union[np.ndarray, "MemmapArray"],
+        filename: Optional[str] = None,
+        mode: str = "r+",
+    ) -> "MemmapArray":
+        if isinstance(array, MemmapArray):
+            array = array.array
+        array = np.asarray(array)
+        out = cls(shape=array.shape, dtype=array.dtype, filename=filename, mode=mode)
+        out._array[...] = array
+        out._array.flush()
+        return out
+
+    # -- core accessors ---------------------------------------------------
+
+    @property
+    def array(self) -> np.memmap:
+        if self._array is None:
+            raise RuntimeError("The MemmapArray has been closed; the file no longer exists")
+        return self._array
+
+    @array.setter
+    def array(self, value: np.ndarray) -> None:
+        arr = self.array
+        if tuple(value.shape) != self._shape:
+            raise ValueError(f"Shape mismatch: expected {self._shape}, got {value.shape}")
+        arr[...] = value
+        arr.flush()
+
+    @property
+    def filename(self) -> str:
+        return self._filename
+
+    @property
+    def shape(self) -> tuple:
+        return self._shape
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._dtype
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    @property
+    def has_ownership(self) -> bool:
+        return self._has_ownership
+
+    # -- numpy protocol ---------------------------------------------------
+
+    def __array__(self, dtype=None, copy=None) -> np.ndarray:
+        arr = self.array
+        if dtype is not None:
+            arr = arr.astype(dtype, copy=False)
+        if copy:
+            arr = np.array(arr)
+        return np.asarray(arr)
+
+    def __getitem__(self, item):
+        return self.array[item]
+
+    def __setitem__(self, item, value):
+        self.array[item] = value
+
+    def __eq__(self, other):
+        return self.array == (other.array if isinstance(other, MemmapArray) else other)
+
+    def __len__(self):
+        return self._shape[0]
+
+    def __repr__(self):
+        return f"MemmapArray(shape={self._shape}, dtype={self._dtype}, file={self._filename})"
+
+    # -- lifecycle --------------------------------------------------------
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_array"] = None
+        state["_has_ownership"] = False
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        if os.path.isfile(self._filename):
+            self._array = np.memmap(self._filename, dtype=self._dtype, mode="r+", shape=self._shape)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_array", None) is not None:
+                del self._array
+            self._array = None
+            if getattr(self, "_has_ownership", False) and os.path.isfile(self._filename):
+                os.unlink(self._filename)
+                self._has_ownership = False
+        except Exception:
+            pass

@@ -1,0 +1,262 @@
+import os
+import pickle
+import shutil
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.memmap import MemmapArray
+
+
+def test_wrong_buffer_size():
+    with pytest.raises(ValueError):
+        ReplayBuffer(-1)
+
+
+def test_wrong_n_envs():
+    with pytest.raises(ValueError):
+        ReplayBuffer(1, -1)
+
+
+@pytest.mark.parametrize("memmap_mode", ["r", "x", "w", "z"])
+def test_wrong_memmap_mode(memmap_mode, tmp_path):
+    with pytest.raises(ValueError, match="Accepted values for memmap_mode are"):
+        ReplayBuffer(10, 10, memmap_mode=memmap_mode, memmap=True, memmap_dir=str(tmp_path))
+
+
+def test_add_single_td_not_full():
+    rb = ReplayBuffer(5, 1)
+    td1 = {"a": np.random.rand(2, 1, 1)}
+    rb.add(td1)
+    assert not rb.full
+    assert rb._pos == 2
+    np.testing.assert_allclose(rb["a"][:2], td1["a"])
+
+
+def test_add_tds():
+    rb = ReplayBuffer(5, 1)
+    td1 = {"a": np.random.rand(2, 1, 1)}
+    td2 = {"a": np.random.rand(2, 1, 1)}
+    td3 = {"a": np.random.rand(3, 1, 1)}
+    rb.add(td1)
+    rb.add(td2)
+    rb.add(td3)
+    assert rb.full
+    assert rb["a"][0] == td3["a"][-2]
+    assert rb["a"][1] == td3["a"][-1]
+    assert rb._pos == 2
+    np.testing.assert_allclose(rb["a"][2:4], td2["a"])
+
+
+def test_add_exceeding_buf_size_multiple_times():
+    rb = ReplayBuffer(7, 1)
+    rb.add({"a": np.random.rand(2, 1, 1)})
+    rb.add({"a": np.random.rand(1, 1, 1)})
+    assert not rb.full
+    td3 = {"a": np.random.rand(9, 1, 1)}
+    rb.add(td3)
+    assert rb.full
+    assert rb._pos == 5
+    remainder = len(td3["a"]) % 7
+    np.testing.assert_allclose(rb["a"][: rb._pos], td3["a"][rb.buffer_size - rb._pos + remainder :])
+
+
+def test_add_single_td_size_is_not_multiple():
+    rb = ReplayBuffer(5, 1)
+    td1 = {"a": np.random.rand(17, 1, 1)}
+    rb.add(td1)
+    assert rb.full
+    assert rb._pos == 2
+    remainder = 17 % 5
+    np.testing.assert_allclose(rb["a"][:remainder], td1["a"][-remainder:])
+    np.testing.assert_allclose(rb["a"][remainder:], td1["a"][-5:-remainder])
+
+
+def test_add_single_td_size_is_multiple():
+    rb = ReplayBuffer(5, 1)
+    td1 = {"a": np.random.rand(20, 1, 1)}
+    rb.add(td1)
+    assert rb.full
+    assert rb._pos == 0
+    np.testing.assert_allclose(rb["a"][:], td1["a"][-5:])
+
+
+def test_add_replay_buffer():
+    rb1 = ReplayBuffer(5, 1)
+    rb1.add({"a": np.random.rand(6, 1, 1)})
+    rb2 = ReplayBuffer(5, 1)
+    rb2.add(rb1)
+    assert (rb1.buffer["a"][:] == rb2.buffer["a"][:]).all()
+
+
+def test_add_error():
+    rb = ReplayBuffer(5, 3)
+    with pytest.raises(ValueError, match="must be a dictionary containing Numpy arrays"):
+        rb.add([i for i in range(5)], validate_args=True)
+    with pytest.raises(ValueError, match=r"must be a dictionary containing Numpy arrays. Found key"):
+        rb.add({"a": [1, 2, 3]}, validate_args=True)
+    with pytest.raises(RuntimeError, match="must have at least 2 dimensions"):
+        rb.add({"a": np.random.rand(6)}, validate_args=True)
+    with pytest.raises(RuntimeError, match="congruent in the first 2 dimensions"):
+        rb.add(
+            {
+                "a": np.random.rand(6, 3, 4),
+                "b": np.random.rand(5, 3, 4),
+            },
+            validate_args=True,
+        )
+    with pytest.raises(RuntimeError, match="must equal n_envs"):
+        rb.add({"c": np.random.rand(6, 1, 4)}, validate_args=True)
+
+
+def test_sample():
+    rb = ReplayBuffer(5, 1, obs_keys=("a",))
+    rb.add({"a": np.random.rand(6, 1, 1)})
+    s = rb.sample(4)
+    assert s["a"].shape == (1, 4, 1)
+    s = rb.sample(4, n_samples=3)
+    assert s["a"].shape == (3, 4, 1)
+    s = rb.sample(4, n_samples=2, clone=True, sample_next_obs=True)
+    assert s["a"].shape == (2, 4, 1)
+    assert s["next_a"].shape == (2, 4, 1)
+
+
+def test_sample_one_sample_next_obs_error():
+    rb = ReplayBuffer(5, 1)
+    rb.add({"a": np.random.rand(1, 1, 1)})
+    with pytest.raises(RuntimeError, match="You want to sample the next observations"):
+        rb.sample(1, sample_next_obs=True)
+
+
+def test_getitem_error():
+    rb = ReplayBuffer(5, 1)
+    with pytest.raises(RuntimeError, match="The buffer has not been initialized"):
+        rb["a"]
+    rb.add({"a": np.random.rand(1, 1, 1)})
+    with pytest.raises(TypeError, match="'key' must be a string"):
+        rb[0]
+
+
+def test_get_samples_empty_error():
+    rb = ReplayBuffer(5, 1)
+    with pytest.raises(RuntimeError, match="The buffer has not been initialized"):
+        rb._get_samples(np.zeros((1,)), sample_next_obs=True)
+
+
+def test_sample_next_obs_not_full():
+    rb = ReplayBuffer(5, 1)
+    td1 = {"observations": np.arange(4).reshape(-1, 1, 1)}
+    rb.add(td1)
+    s = rb.sample(10, sample_next_obs=True)
+    assert s["observations"].shape == (1, 10, 1)
+    assert td1["observations"][-1] not in s["observations"]
+
+
+def test_sample_next_obs_full():
+    rb = ReplayBuffer(5, 1)
+    td1 = {"observations": np.arange(8).reshape(-1, 1, 1)}
+    rb.add(td1)
+    s = rb.sample(10, sample_next_obs=True)
+    assert s["observations"].shape == (1, 10, 1)
+    assert td1["observations"][-1] not in s["observations"]
+
+
+def test_sample_full():
+    rb = ReplayBuffer(5, 1)
+    rb.add({"a": np.random.rand(6, 1, 1)})
+    s = rb.sample(6)
+    assert s["a"].shape == (1, 6, 1)
+
+
+def test_sample_one_element():
+    rb = ReplayBuffer(1, 1)
+    td1 = {"observations": np.random.rand(1, 1, 1)}
+    rb.add(td1)
+    sample = rb.sample(1)
+    assert rb.full
+    assert sample["observations"] == td1["observations"]
+    with pytest.raises(ValueError):
+        rb.sample(1, sample_next_obs=True)
+
+
+def test_sample_fail():
+    rb = ReplayBuffer(1, 1)
+    with pytest.raises(ValueError, match="No sample has been added to the buffer"):
+        rb.sample(1)
+    with pytest.raises(ValueError, match="must be both greater than 0"):
+        rb.sample(-1)
+
+
+def test_memmap_replay_buffer(tmp_path):
+    n_envs = 4
+    with pytest.raises(ValueError, match="The buffer is set to be memory-mapped but the 'memmap_dir'"):
+        ReplayBuffer(10, n_envs, memmap=True, memmap_dir=None)
+    memmap_dir = tmp_path / "memmap_buffer"
+    rb = ReplayBuffer(10, n_envs, memmap=True, memmap_dir=str(memmap_dir))
+    td = {"observations": np.random.randint(0, 256, (10, n_envs, 3, 16, 16), dtype=np.uint8)}
+    rb.add(td)
+    assert rb.is_memmap
+    assert (rb["observations"][:] == td["observations"]).all()
+    del rb
+
+
+def test_sample_tensors():
+    import jax
+
+    rb = ReplayBuffer(5, 1)
+    rb.add({"observations": np.arange(8).reshape(-1, 1, 1)})
+    s = rb.sample_tensors(10, sample_next_obs=True, n_samples=3)
+    assert isinstance(s["observations"], jax.Array)
+    assert s["observations"].shape == (3, 10, 1)
+
+
+def test_to_tensor(tmp_path):
+    import jax
+
+    n_envs = 4
+    memmap_dir = tmp_path / "memmap_buffer"
+    rb = ReplayBuffer(5, n_envs, memmap=True, memmap_dir=str(memmap_dir), obs_keys=("observations",))
+    td = {"observations": np.random.randint(0, 256, (10, n_envs, 3, 16, 16), dtype=np.uint8)}
+    rb.add(td)
+    sample = rb.to_tensor()
+    assert isinstance(sample["observations"], jax.Array)
+    assert sample["observations"].shape == (5, n_envs, 3, 16, 16)
+    assert (td["observations"][5:] == np.asarray(sample["observations"])).all()
+    del rb
+
+
+def test_setitem():
+    rb = ReplayBuffer(5, 4)
+    with pytest.raises(RuntimeError, match="The buffer has not been initialized"):
+        rb["no_init"] = np.zeros((5, 4, 1))
+    rb.add({"observations": np.random.rand(8, 4, 1)})
+    a = np.random.rand(5, 4, 10)
+    rb["a"] = a
+    assert rb["a"].shape == (5, 4, 10)
+    assert (rb["a"] == a).all()
+    with pytest.raises(RuntimeError, match="must have shape"):
+        rb["bad"] = np.zeros((3, 4, 1))
+
+
+def test_setitem_memmap(tmp_path):
+    memmap_dir = tmp_path / "memmap_buffer"
+    rb = ReplayBuffer(5, 4, memmap=True, memmap_dir=str(memmap_dir), obs_keys=("observations",))
+    rb.add({"observations": np.random.randint(0, 256, (10, 4, 3, 8, 8), dtype=np.uint8)})
+    a = np.random.rand(5, 4, 10)
+    rb["a"] = a
+    assert isinstance(rb["a"], MemmapArray)
+    assert rb["a"].shape == (5, 4, 10)
+    assert (rb["a"] == a).all()
+    del rb
+
+
+def test_state_dict_round_trip():
+    rb = ReplayBuffer(5, 2)
+    rb.add({"a": np.random.rand(7, 2, 3)})
+    state = rb.state_dict()
+    rb2 = ReplayBuffer(5, 2)
+    rb2.load_state_dict(state)
+    assert rb2._pos == rb._pos
+    assert rb2.full == rb.full
+    assert (rb2["a"][:] == rb["a"][:]).all()

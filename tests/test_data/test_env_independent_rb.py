@@ -1,0 +1,80 @@
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+
+
+def test_wrong_buffer_size():
+    with pytest.raises(ValueError):
+        EnvIndependentReplayBuffer(-1)
+
+
+def test_wrong_n_envs():
+    with pytest.raises(ValueError):
+        EnvIndependentReplayBuffer(1, -1)
+
+
+def test_missing_memmap_dir():
+    with pytest.raises(ValueError):
+        EnvIndependentReplayBuffer(10, 4, memmap=True, memmap_dir=None)
+
+
+def test_wrong_memmap_mode(tmp_path):
+    with pytest.raises(ValueError):
+        EnvIndependentReplayBuffer(10, 4, memmap=True, memmap_mode="a+", memmap_dir=str(tmp_path))
+
+
+def test_add():
+    rb = EnvIndependentReplayBuffer(20, 4)
+    rb.add({"dones": np.zeros((10, 4, 1))})
+    for i in range(4):
+        assert rb._buf[i]._pos == 10
+    rb.add({"dones": np.zeros((10, 2, 1))}, [0, 3])
+    assert rb._buf[0]._pos == 0
+    assert rb._buf[1]._pos == 10
+    assert rb._buf[2]._pos == 10
+    assert rb._buf[3]._pos == 0
+
+
+def test_add_error():
+    rb = EnvIndependentReplayBuffer(10, 4)
+    with pytest.raises(ValueError):
+        rb.add({"dones": np.zeros((10, 3, 1))})
+
+
+def test_sample_shape():
+    rb = EnvIndependentReplayBuffer(20, 4)
+    rb.add({"dones": np.ones((10, 4, 1))})
+    rb.add({"dones": np.ones((10, 2, 1))}, [0, 3])
+    sample = rb.sample(10, n_samples=10)
+    assert sample["dones"].shape == (10, 10, 1)
+
+
+def test_sample_covers_all_envs():
+    rb = EnvIndependentReplayBuffer(20, 4)
+    stps1 = {"dones": np.ones((10, 4, 1))}
+    for i in range(4):
+        stps1["dones"][:, i] *= i
+    rb.add(stps1)
+    sample = rb.sample(2000, n_samples=2)
+    for i in range(4):
+        assert (sample["dones"] == i).any()
+
+
+def test_sample_error():
+    rb = EnvIndependentReplayBuffer(20, 4)
+    with pytest.raises(ValueError, match="No sample has been added to the buffer"):
+        rb.sample(10, n_samples=10)
+    rb.add({"dones": np.zeros((10, 4, 1))})
+    with pytest.raises(ValueError, match="must be both greater than 0"):
+        rb.sample(0, n_samples=10)
+
+
+def test_sample_tensors_sequential():
+    import jax
+
+    rb = EnvIndependentReplayBuffer(20, 4, buffer_cls=SequentialReplayBuffer)
+    rb.add({"dones": np.zeros((10, 4, 1))})
+    s = rb.sample_tensors(10, n_samples=3, sequence_length=5)
+    assert isinstance(s["dones"], jax.Array)
+    assert s["dones"].shape == (3, 5, 10, 1)

@@ -1,0 +1,191 @@
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EpisodeBuffer, ReplayBuffer
+
+
+def test_wrong_buffer_size():
+    with pytest.raises(ValueError, match="The buffer size must be greater than zero"):
+        EpisodeBuffer(-1, 10)
+
+
+def test_wrong_sequence_length():
+    with pytest.raises(ValueError, match="The sequence length must be greater than zero"):
+        EpisodeBuffer(1, -1)
+
+
+def test_sequence_length_greater_than_buffer_size():
+    with pytest.raises(ValueError, match="The sequence length must be lower than the buffer size"):
+        EpisodeBuffer(5, 10)
+
+
+@pytest.mark.parametrize("memmap_mode", ["r", "x", "w", "z"])
+def test_wrong_memmap_mode(memmap_mode, tmp_path):
+    with pytest.raises(ValueError, match="Accepted values for memmap_mode are"):
+        EpisodeBuffer(10, 10, memmap_mode=memmap_mode, memmap=True, memmap_dir=str(tmp_path))
+
+
+def test_add_episodes():
+    sl = 5
+    rb = EpisodeBuffer(30, sl, n_envs=1, obs_keys=("dones",))
+    eps = []
+    for ln in (sl, sl + 5, sl + 10, sl):
+        ep = {"dones": np.zeros((ln, 1, 1))}
+        ep["dones"][-1] = 1
+        eps.append(ep)
+        rb.add(ep)
+    assert rb.full
+    assert (rb._buf[-1]["dones"][:] == eps[3]["dones"][:, 0]).all()
+    assert (rb._buf[0]["dones"][:] == eps[1]["dones"][:, 0]).all()
+
+
+def test_add_single_dict():
+    sl = 5
+    n_envs = 4
+    rb = EpisodeBuffer(5, sl, n_envs=n_envs, obs_keys=("dones",))
+    ep1 = {"dones": np.zeros((sl, n_envs, 1))}
+    ep1["dones"][-1] = 1
+    rb.add(ep1)
+    assert rb.full
+    for env in range(n_envs):
+        assert (rb._buf[0]["dones"][:] == ep1["dones"][:, env]).all()
+
+
+def test_error_add():
+    sl = 5
+    n_envs = 4
+    rb = EpisodeBuffer(10, sl, n_envs=n_envs, obs_keys=("dones",))
+    with pytest.raises(ValueError, match="`data` must be a dictionary containing Numpy arrays"):
+        rb.add(np.zeros((sl, n_envs, 1)).tolist(), validate_args=True)
+    with pytest.raises(ValueError, match="`data` must be a dictionary containing Numpy arrays. Found key"):
+        rb.add({"dones": np.zeros((sl, n_envs, 1)).tolist()}, validate_args=True)
+    with pytest.raises(ValueError, match="The `data` replay buffer must be not None"):
+        rb.add(None, validate_args=True)
+    with pytest.raises(RuntimeError, match=r"`data` must have at least 2"):
+        rb.add({"dones": np.zeros((1,))}, validate_args=True)
+    rb2 = EpisodeBuffer(10, sl, n_envs=n_envs, obs_keys=("dones", "obs"))
+    with pytest.raises(RuntimeError, match="Every array in `data` must be congruent"):
+        rb2.add({"dones": np.zeros((sl, n_envs, 1)), "obs": np.zeros((sl, 1, 6))}, validate_args=True)
+    with pytest.raises(RuntimeError, match="The episode must contain the `dones` key"):
+        rb2.add({"obs": np.zeros((sl, 1, 6))}, validate_args=True)
+    ep7 = {"dones": np.zeros((sl, 1, 1))}
+    ep7["dones"][-1] = 1
+    with pytest.raises(ValueError, match="The indices of the environment must be integers in"):
+        rb.add(ep7, validate_args=True, env_idxes=[10])
+
+
+def test_add_only_for_some_envs():
+    sl = 5
+    rb = EpisodeBuffer(10, sl, n_envs=4, obs_keys=("dones",))
+    ep1 = {"dones": np.zeros((sl, 2, 1))}
+    rb.add(ep1, env_idxes=[0, 3])
+    assert len(rb._open_episodes[0]) > 0
+    assert len(rb._open_episodes[1]) == 0
+    assert len(rb._open_episodes[2]) == 0
+    assert len(rb._open_episodes[3]) > 0
+
+
+def test_save_episode():
+    rb = EpisodeBuffer(100, 5, n_envs=4, obs_keys=("dones",))
+    chunks = []
+    for i in range(8):
+        ln = int(np.random.randint(1, 8))
+        chunks.append({"dones": np.zeros((ln, 1))})
+    chunks[-1]["dones"][-1] = 1
+    rb.save_episode(chunks)
+    assert len(rb) == 1
+
+
+def test_save_episode_errors():
+    rb = EpisodeBuffer(100, 5, n_envs=4, obs_keys=("dones",))
+    with pytest.raises(RuntimeError, match="must contain at least one step"):
+        rb.save_episode([])
+    bad = {"dones": np.zeros((10, 1))}
+    with pytest.raises(RuntimeError, match="exactly one done"):
+        rb.save_episode([bad])
+    bad2 = {"dones": np.zeros((10, 1))}
+    bad2["dones"][4] = 1
+    with pytest.raises(RuntimeError, match="exactly one done"):
+        two = {"dones": np.zeros((10, 1))}
+        two["dones"][[3, 9]] = 1
+        rb.save_episode([two])
+    with pytest.raises(RuntimeError, match="The last step must contain a done"):
+        rb.save_episode([bad2])
+    short = {"dones": np.zeros((2, 1))}
+    short["dones"][-1] = 1
+    with pytest.raises(RuntimeError, match="Invalid episode length"):
+        rb.save_episode([short])
+
+
+def test_sample_shapes():
+    sl = 5
+    rb = EpisodeBuffer(30, sl, n_envs=1, obs_keys=("dones", "observations"))
+    ep = {"dones": np.zeros((12, 1, 1)), "observations": np.random.rand(12, 1, 3)}
+    ep["dones"][-1] = 1
+    rb.add(ep)
+    s = rb.sample(3, n_samples=2)
+    assert s["observations"].shape == (2, sl, 3, 3)
+    assert s["dones"].shape == (2, sl, 3, 1)
+
+
+def test_sample_next_obs():
+    sl = 5
+    rb = EpisodeBuffer(30, sl, n_envs=1, obs_keys=("observations",))
+    ep = {"dones": np.zeros((12, 1, 1)), "observations": np.arange(12).reshape(12, 1, 1)}
+    ep["dones"][-1] = 1
+    rb.add(ep)
+    s = rb.sample(4, sample_next_obs=True)
+    assert "next_observations" in s
+    assert (s["next_observations"][:, :, :, 0] == s["observations"][:, :, :, 0] + 1).all()
+
+
+def test_sample_prioritize_ends():
+    sl = 5
+    rb = EpisodeBuffer(1000, sl, n_envs=1, obs_keys=("observations",), prioritize_ends=True)
+    ep = {"dones": np.zeros((100, 1, 1)), "observations": np.arange(100).reshape(100, 1, 1)}
+    ep["dones"][-1] = 1
+    rb.add(ep)
+    s = rb.sample(256)
+    # ends should be over-represented: the final window [95..99] must appear
+    assert (s["observations"][..., 0] == 99).any()
+
+
+def test_sample_errors():
+    sl = 5
+    rb = EpisodeBuffer(30, sl, n_envs=1)
+    with pytest.raises(ValueError, match="No sample has been added"):
+        rb.sample(1)
+    with pytest.raises(ValueError, match="must be both greater than 0"):
+        rb.sample(-1)
+
+
+def test_short_episodes_are_discarded():
+    sl = 5
+    rb = EpisodeBuffer(30, sl, n_envs=1)
+    ep = {"dones": np.zeros((3, 1, 1)), "observations": np.random.rand(3, 1, 1)}
+    ep["dones"][-1] = 1
+    rb.add(ep)
+    assert len(rb) == 0
+
+
+def test_memmap_episode_buffer(tmp_path):
+    sl = 4
+    rb = EpisodeBuffer(20, sl, n_envs=1, obs_keys=("observations",), memmap=True, memmap_dir=str(tmp_path))
+    ep = {"dones": np.zeros((8, 1, 1)), "observations": np.random.rand(8, 1, 3)}
+    ep["dones"][-1] = 1
+    rb.add(ep)
+    assert rb.is_memmap
+    assert len(rb) == 1
+    s = rb.sample(2)
+    assert s["observations"].shape == (1, sl, 2, 3)
+
+
+def test_add_rb():
+    sl = 2
+    rb_src = ReplayBuffer(6, 1)
+    data = {"dones": np.zeros((6, 1, 1)), "observations": np.random.rand(6, 1, 2)}
+    data["dones"][-1] = 1
+    rb_src.add(data)
+    rb = EpisodeBuffer(30, sl, n_envs=1, obs_keys=("observations",))
+    rb.add(rb_src)
+    assert len(rb) == 1

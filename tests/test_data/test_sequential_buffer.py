@@ -1,0 +1,99 @@
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import SequentialReplayBuffer
+
+
+def test_sample_shape_basic():
+    rb = SequentialReplayBuffer(10, 1)
+    rb.add({"a": np.random.rand(11, 1, 1)})
+    s = rb.sample(4, sequence_length=2)
+    assert s["a"].shape == (1, 2, 4, 1)
+
+
+def test_sample_one_element():
+    rb = SequentialReplayBuffer(1, 1)
+    td1 = {"a": np.random.rand(1, 1, 1)}
+    rb.add(td1)
+    sample = rb.sample(1, sequence_length=1)
+    assert rb.full
+    assert sample["a"] == td1["a"]
+    with pytest.raises(ValueError):
+        rb.sample(1, sequence_length=2)
+
+
+def test_sample_shapes():
+    rb = SequentialReplayBuffer(30, 2, obs_keys=("a",))
+    t = {"a": np.arange(60).reshape(-1, 2, 1) % 30}
+    rb.add(t)
+    sample = rb.sample(3, sequence_length=5, n_samples=2)
+    assert sample["a"].shape == (2, 5, 3, 1)
+    sample = rb.sample(3, sequence_length=5, n_samples=2, sample_next_obs=True, clone=True)
+    assert sample["a"].shape == (2, 5, 3, 1)
+    assert sample["next_a"].shape == (2, 5, 3, 1)
+
+
+def test_sample_full_no_straddle():
+    # sequences must never straddle the write head
+    buf_size = 1000
+    rb = SequentialReplayBuffer(buf_size, 1)
+    t = {"a": np.arange(1050).reshape(-1, 1, 1) % buf_size}
+    rb.add(t)
+    samples = rb.sample(100, sequence_length=50, n_samples=5)
+    assert not np.logical_and(
+        (samples["a"][:, 0, :] < rb._pos), (samples["a"][:, -1, :] >= rb._pos)
+    ).any()
+
+
+def test_sample_full_large_sl_wraparound():
+    buf_size = 1000
+    seq_len = 100
+    rb = SequentialReplayBuffer(buf_size, 1)
+    t = {"a": np.arange(1050).reshape(-1, 1, 1) % buf_size}
+    rb.add(t)
+    samples = rb.sample(100, sequence_length=seq_len, n_samples=5)
+    assert not np.logical_and(
+        (samples["a"][:, 0, :] >= buf_size + rb._pos - seq_len + 1),
+        (samples["a"][:, -1, :] < rb._pos),
+    ).any()
+    assert not np.logical_and(
+        (samples["a"][:, 0, :] < rb._pos), (samples["a"][:, -1, :] >= rb._pos)
+    ).any()
+
+
+def test_sample_fail_not_full():
+    rb = SequentialReplayBuffer(10, 1)
+    rb.add({"a": np.arange(5).reshape(-1, 1, 1)})
+    with pytest.raises(ValueError, match="Cannot sample a sequence of length"):
+        rb.sample(5, sequence_length=8, n_samples=1)
+
+
+def test_sample_not_full_only_valid_data():
+    rb = SequentialReplayBuffer(10, 1)
+    rb._buf = {"a": np.ones((10, 1, 1)) * 20}
+    t = {"a": np.arange(7).reshape(-1, 1, 1) * 1.0}
+    rb.add(t)
+    sample = rb.sample(2, sequence_length=5, n_samples=2)
+    assert (sample["a"] < 7).all()
+
+
+def test_sample_no_add():
+    rb = SequentialReplayBuffer(10, 1)
+    with pytest.raises(ValueError, match="No sample has been added"):
+        rb.sample(2, sequence_length=5, n_samples=2)
+
+
+def test_sample_error():
+    rb = SequentialReplayBuffer(10, 1)
+    with pytest.raises(ValueError, match="must be both greater than "):
+        rb.sample(-1, sequence_length=5, n_samples=2)
+
+
+def test_sample_tensors():
+    import jax
+
+    rb = SequentialReplayBuffer(10, 1)
+    rb.add({"a": np.arange(11).reshape(-1, 1, 1)})
+    s = rb.sample_tensors(4, sequence_length=2, n_samples=3)
+    assert isinstance(s["a"], jax.Array)
+    assert s["a"].shape == (3, 2, 4, 1)

@@ -1,0 +1,61 @@
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.memmap import MemmapArray
+
+
+@pytest.mark.parametrize(
+    "dtype",
+    [np.int8, np.int16, np.int32, np.int64, np.uint8, np.uint16, np.float32, np.float64],
+)
+@pytest.mark.parametrize("shape", [[2], [1, 2]])
+def test_memmap_data_type(dtype, shape):
+    a = np.array([1, 0], dtype=dtype).reshape(shape)
+    m = MemmapArray.from_array(a)
+    assert m.dtype == a.dtype
+    assert (m == a).all()
+    assert m.shape == a.shape
+
+
+def test_memmap_del():
+    m = MemmapArray.from_array(np.array([1]))
+    filename = m.filename
+    assert os.path.isfile(filename)
+    del m
+    assert not os.path.isfile(filename)
+
+
+def test_memmap_pickling():
+    m1 = MemmapArray.from_array(np.array([1]))
+    filename = m1.filename
+    m1_pickle = pickle.dumps(m1)
+    assert m1._has_ownership
+    m2 = pickle.loads(m1_pickle)
+    assert m2.filename == m1.filename
+    assert not m2._has_ownership
+    del m1, m2
+    assert not os.path.isfile(filename)
+
+
+def test_memmap_array_get_not_none():
+    m1 = MemmapArray.from_array(np.ones((10,)) * 2)
+    assert m1.array is not None
+
+
+def test_memmap_array_get_after_close():
+    m1 = MemmapArray.from_array(np.ones((10,)) * 2)
+    m1.__del__()
+    with pytest.raises(Exception):
+        m1.array
+
+
+def test_memmap_set_array():
+    m = MemmapArray(shape=(4, 2), dtype=np.float32)
+    values = np.random.rand(4, 2).astype(np.float32)
+    m.array = values
+    assert (m.array == values).all()
+    with pytest.raises(ValueError, match="Shape mismatch"):
+        m.array = np.zeros((3, 2), dtype=np.float32)
