@@ -677,7 +677,10 @@ class EpisodeBuffer:
     def state_dict(self) -> Dict[str, Any]:
         return {
             "buffer": [{k: np.array(_as_np(v)) for k, v in ep.items()} for ep in self._buf],
-            "open_episodes": self._open_episodes,
+            "open_episodes": [
+                [{k: np.array(v) for k, v in chunk.items()} for chunk in env_chunks]
+                for env_chunks in self._open_episodes
+            ],
         }
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
@@ -693,7 +696,10 @@ class EpisodeBuffer:
                 }
             self._buf.append(ep)
         self._cum_steps = sum(len(_as_np(ep["dones"])) for ep in self._buf)
-        self._open_episodes = state.get("open_episodes", [[] for _ in range(self._n_envs)])
+        self._open_episodes = [
+            [{k: np.array(v) for k, v in chunk.items()} for chunk in env_chunks]
+            for env_chunks in state.get("open_episodes", [[] for _ in range(self._n_envs)])
+        ]
 
 
 class EnvIndependentReplayBuffer:

@@ -1,0 +1,27 @@
+from sheeprl_tpu.distributions.distributions import (
+    Bernoulli,
+    Independent,
+    MSEDistribution,
+    Normal,
+    OneHotCategorical,
+    OneHotCategoricalStraightThrough,
+    SymlogDistribution,
+    TanhNormal,
+    TruncatedNormal,
+    TwoHotEncodingDistribution,
+    kl_divergence,
+)
+
+__all__ = [
+    "Bernoulli",
+    "Independent",
+    "MSEDistribution",
+    "Normal",
+    "OneHotCategorical",
+    "OneHotCategoricalStraightThrough",
+    "SymlogDistribution",
+    "TanhNormal",
+    "TruncatedNormal",
+    "TwoHotEncodingDistribution",
+    "kl_divergence",
+]

@@ -1,0 +1,373 @@
+"""Neural building blocks, flax.linen edition.
+
+TPU-native re-design of the reference model layer (``sheeprl/models/models.py``:
+MLP :15, CNN :121, DeCNN :204, NatureCNN :287, LayerNormGRUCell :330,
+MultiEncoder :405, MultiDecoder :463, helpers in ``sheeprl/utils/model.py``).
+Differences that matter on TPU:
+
+- convolutions run NHWC (XLA's native TPU layout); the modules accept the
+  env-layer's channel-first ``[..., C, H, W]`` observations and transpose at
+  the module boundary, so the rest of the stack keeps the reference's CHW
+  convention while the MXU sees its preferred layout.
+- no shape probing with dummy forwards (reference NatureCNN :311-314) — output
+  shapes are static math.
+- arbitrary batch shape folding (reference ``cnn_forward`` utils/model.py:164)
+  is a plain reshape here since linen modules are shape-polymorphic over
+  leading dims by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# activation resolution (accepts jax-style names and torch-style class paths,
+# so reference config trees run unchanged)
+# ---------------------------------------------------------------------------
+
+_ACTIVATIONS: Dict[str, Callable] = {
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "sigmoid": jax.nn.sigmoid,
+    "softplus": jax.nn.softplus,
+    "identity": lambda x: x,
+    "none": lambda x: x,
+}
+
+_TORCH_NAMES = {
+    "torch.nn.Tanh": "tanh",
+    "torch.nn.ReLU": "relu",
+    "torch.nn.ReLU6": "relu6",
+    "torch.nn.SiLU": "silu",
+    "torch.nn.ELU": "elu",
+    "torch.nn.GELU": "gelu",
+    "torch.nn.LeakyReLU": "leaky_relu",
+    "torch.nn.Sigmoid": "sigmoid",
+    "torch.nn.Softplus": "softplus",
+    "torch.nn.Identity": "identity",
+}
+
+
+def resolve_activation(act: Union[str, Callable, None]) -> Callable:
+    if act is None:
+        return lambda x: x
+    if callable(act):
+        return act
+    name = _TORCH_NAMES.get(act, act).lower()
+    if name not in _ACTIVATIONS:
+        raise ValueError(f"Unknown activation '{act}'. Known: {sorted(_ACTIVATIONS)}")
+    return _ACTIVATIONS[name]
+
+
+def _broadcast(value: Any, n: int) -> Tuple:
+    """Per-layer argument broadcast (reference create_layers, utils/model.py:90-138)."""
+    if isinstance(value, (list, tuple)):
+        if len(value) != n:
+            raise ValueError(f"Expected {n} per-layer values, got {len(value)}")
+        return tuple(value)
+    return tuple(value for _ in range(n))
+
+
+from sheeprl_tpu.distributions.distributions import symlog as _symlog
+
+
+# ---------------------------------------------------------------------------
+# MLP
+# ---------------------------------------------------------------------------
+
+
+class MLP(nn.Module):
+    """Dense stack of Linear→[LayerNorm]→activation→[dropout] miniblocks.
+
+    Mirrors the reference MLP (models.py:15-118): hidden miniblocks followed by
+    a bare Linear head when ``output_dim`` is set. ``flatten_dim`` folds
+    trailing feature dims before the first Linear (reference ``flatten_dim``
+    semantics); ``symlog_inputs`` applies the DV3 input transform.
+    """
+
+    hidden_sizes: Sequence[int] = ()
+    output_dim: Optional[int] = None
+    activation: Union[str, Callable] = "relu"
+    layer_norm: Union[bool, Sequence[bool]] = False
+    norm_eps: float = 1e-5
+    dropout: float = 0.0
+    flatten_dim: Optional[int] = None
+    symlog_inputs: bool = False
+    bias: Union[bool, Sequence[bool]] = True
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        if self.flatten_dim is not None:
+            x = jnp.reshape(x, x.shape[: self.flatten_dim] + (-1,))
+        if self.symlog_inputs:
+            x = _symlog(x)
+        n = len(self.hidden_sizes)
+        norms = _broadcast(self.layer_norm, n)
+        biases = _broadcast(self.bias, n)
+        act = resolve_activation(self.activation)
+        for i, size in enumerate(self.hidden_sizes):
+            x = nn.Dense(size, use_bias=biases[i], param_dtype=self.param_dtype)(x)
+            if norms[i]:
+                x = nn.LayerNorm(epsilon=self.norm_eps, param_dtype=self.param_dtype)(x)
+            x = act(x)
+            if self.dropout > 0.0:
+                x = nn.Dropout(self.dropout, deterministic=deterministic)(x)
+        if self.output_dim is not None:
+            x = nn.Dense(self.output_dim, param_dtype=self.param_dtype)(x)
+        return x
+
+
+# ---------------------------------------------------------------------------
+# CNN / DeCNN
+# ---------------------------------------------------------------------------
+
+
+def _to_nhwc(x: jnp.ndarray) -> Tuple[jnp.ndarray, Tuple[int, ...]]:
+    """[..., C, H, W] → [N, H, W, C] with leading dims folded."""
+    lead = x.shape[:-3]
+    c, h, w = x.shape[-3:]
+    x = jnp.reshape(x, (-1, c, h, w))
+    return jnp.transpose(x, (0, 2, 3, 1)), lead
+
+
+def _from_nhwc(x: jnp.ndarray, lead: Tuple[int, ...]) -> jnp.ndarray:
+    """[N, H, W, C] → [..., C, H, W] restoring leading dims."""
+    x = jnp.transpose(x, (0, 3, 1, 2))
+    return jnp.reshape(x, lead + x.shape[1:])
+
+
+class CNN(nn.Module):
+    """Conv2d stack (reference CNN, models.py:121-203). Input ``[..., C, H, W]``.
+
+    Runs NHWC internally. ``flatten`` returns ``[..., features]``.
+    """
+
+    channels: Sequence[int]
+    kernel_sizes: Union[int, Sequence[int]] = 3
+    strides: Union[int, Sequence[int]] = 1
+    paddings: Union[int, str, Sequence[Any]] = 0
+    activation: Union[str, Callable] = "relu"
+    layer_norm: Union[bool, Sequence[bool]] = False
+    norm_eps: float = 1e-5
+    bias: Union[bool, Sequence[bool]] = True
+    flatten: bool = False
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        n = len(self.channels)
+        ks = _broadcast(self.kernel_sizes, n)
+        st = _broadcast(self.strides, n)
+        pd = _broadcast(self.paddings, n)
+        norms = _broadcast(self.layer_norm, n)
+        biases = _broadcast(self.bias, n)
+        act = resolve_activation(self.activation)
+        x, lead = _to_nhwc(x)
+        for i, ch in enumerate(self.channels):
+            pad = pd[i] if isinstance(pd[i], str) else [(pd[i], pd[i])] * 2
+            x = nn.Conv(
+                ch,
+                kernel_size=(ks[i], ks[i]),
+                strides=(st[i], st[i]),
+                padding=pad,
+                use_bias=biases[i],
+                param_dtype=self.param_dtype,
+            )(x)
+            if norms[i]:
+                # LayerNorm over the channel axis — NHWC makes the reference's
+                # LayerNormChannelLast permute dance (utils/model.py:225-235) free
+                x = nn.LayerNorm(epsilon=self.norm_eps, param_dtype=self.param_dtype)(x)
+            x = act(x)
+        if self.flatten:
+            x = jnp.reshape(x, (x.shape[0], -1))
+            return jnp.reshape(x, lead + x.shape[1:])
+        return _from_nhwc(x, lead)
+
+
+class DeCNN(nn.Module):
+    """ConvTranspose2d stack (reference DeCNN, models.py:204-284). Input ``[..., C, H, W]``."""
+
+    channels: Sequence[int]
+    kernel_sizes: Union[int, Sequence[int]] = 3
+    strides: Union[int, Sequence[int]] = 1
+    paddings: Union[int, Sequence[int]] = 0
+    activation: Union[str, Callable] = "relu"
+    layer_norm: Union[bool, Sequence[bool]] = False
+    norm_eps: float = 1e-5
+    bias: Union[bool, Sequence[bool]] = True
+    final_activation: Union[str, Callable, None] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        n = len(self.channels)
+        ks = _broadcast(self.kernel_sizes, n)
+        st = _broadcast(self.strides, n)
+        pd = _broadcast(self.paddings, n)
+        norms = _broadcast(self.layer_norm, n)
+        biases = _broadcast(self.bias, n)
+        act = resolve_activation(self.activation)
+        x, lead = _to_nhwc(x)
+        for i, ch in enumerate(self.channels):
+            # configs carry torch-style transposed-conv padding p
+            # (out = (in-1)*s - 2p + k); flax's padding is the forward conv's,
+            # so p maps to (k-1-p) per side
+            if isinstance(pd[i], str):
+                pad = pd[i]
+            else:
+                f = ks[i] - 1 - pd[i]
+                pad = [(f, f)] * 2
+            x = nn.ConvTranspose(
+                ch,
+                kernel_size=(ks[i], ks[i]),
+                strides=(st[i], st[i]),
+                padding=pad,
+                use_bias=biases[i],
+                transpose_kernel=True,
+                param_dtype=self.param_dtype,
+            )(x)
+            if norms[i]:
+                x = nn.LayerNorm(epsilon=self.norm_eps, param_dtype=self.param_dtype)(x)
+            if i < n - 1:
+                x = act(x)
+            elif self.final_activation is not None:
+                x = resolve_activation(self.final_activation)(x)
+        return _from_nhwc(x, lead)
+
+
+class NatureCNN(nn.Module):
+    """DQN-Nature encoder (reference models.py:287-327): 3 convs + Linear head.
+
+    Output feature size is static math, no dummy-forward probe.
+    """
+
+    features_dim: int = 512
+    screen_size: int = 64
+    activation: Union[str, Callable] = "relu"
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        act = resolve_activation(self.activation)
+        x, lead = _to_nhwc(x)
+        for ch, k, s in ((32, 8, 4), (64, 4, 2), (64, 3, 1)):
+            x = nn.Conv(ch, kernel_size=(k, k), strides=(s, s), padding="VALID", param_dtype=self.param_dtype)(x)
+            x = act(x)
+        x = jnp.reshape(x, (x.shape[0], -1))
+        x = act(nn.Dense(self.features_dim, param_dtype=self.param_dtype)(x))
+        return jnp.reshape(x, lead + x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# LayerNormGRUCell
+# ---------------------------------------------------------------------------
+
+
+class LayerNormGRUCell(nn.Module):
+    """Hafner-style GRU cell (reference models.py:330-402, after dreamerv2 nets.py:317).
+
+    One joint Linear over ``[h, x]`` → LayerNorm → (reset, cand, update) with
+    ``cand = tanh(reset * cand)`` and the update gate biased by −1. This is the
+    recurrent core of the RSSM; the time loop lives *outside* in a
+    ``jax.lax.scan`` so XLA fuses the whole sequence.
+    """
+
+    hidden_size: int
+    bias: bool = True
+    layer_norm: bool = False
+    norm_eps: float = 1e-3
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
+        inp = jnp.concatenate([h, x], axis=-1)
+        z = nn.Dense(3 * self.hidden_size, use_bias=self.bias, param_dtype=self.param_dtype)(inp)
+        if self.layer_norm:
+            z = nn.LayerNorm(epsilon=self.norm_eps, param_dtype=self.param_dtype)(z)
+        reset, cand, update = jnp.split(z, 3, axis=-1)
+        reset = jax.nn.sigmoid(reset)
+        cand = jnp.tanh(reset * cand)
+        update = jax.nn.sigmoid(update - 1)
+        return update * cand + (1 - update) * h
+
+
+# ---------------------------------------------------------------------------
+# MultiEncoder / MultiDecoder
+# ---------------------------------------------------------------------------
+
+
+class MultiEncoder(nn.Module):
+    """Fuse cnn and mlp sub-encoders by feature concat (reference models.py:405-460).
+
+    ``cnn_keys`` observations are stacked along channels and encoded once;
+    ``mlp_keys`` observations are concatenated and encoded once; the two
+    feature vectors are concatenated.
+    """
+
+    cnn_encoder: Optional[nn.Module] = None
+    mlp_encoder: Optional[nn.Module] = None
+    cnn_keys: Sequence[str] = ()
+    mlp_keys: Sequence[str] = ()
+
+    def __call__(self, obs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        feats = []
+        if self.cnn_encoder is not None and self.cnn_keys:
+            x = jnp.concatenate([obs[k] for k in self.cnn_keys], axis=-3)
+            feats.append(self.cnn_encoder(x))
+        if self.mlp_encoder is not None and self.mlp_keys:
+            x = jnp.concatenate([obs[k] for k in self.mlp_keys], axis=-1)
+            feats.append(self.mlp_encoder(x))
+        if not feats:
+            raise ValueError("MultiEncoder needs at least one of cnn_keys / mlp_keys")
+        return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
+
+
+class MultiDecoder(nn.Module):
+    """Per-key reconstruction dict (reference models.py:463-489)."""
+
+    cnn_decoder: Optional[nn.Module] = None
+    mlp_decoder: Optional[nn.Module] = None
+    cnn_keys: Sequence[str] = ()
+    mlp_keys: Sequence[str] = ()
+    cnn_channels: Sequence[int] = ()  # per-key channel counts for the split
+    mlp_dims: Sequence[int] = ()      # per-key feature dims for the split
+
+    def __call__(self, latent: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        out: Dict[str, jnp.ndarray] = {}
+        if self.cnn_decoder is not None and self.cnn_keys:
+            if len(self.cnn_keys) > 1 and len(self.cnn_channels) != len(self.cnn_keys):
+                raise ValueError(
+                    f"MultiDecoder: {len(self.cnn_keys)} cnn_keys need {len(self.cnn_keys)} "
+                    f"cnn_channels for the split, got {len(self.cnn_channels)}"
+                )
+            rec = self.cnn_decoder(latent)
+            if len(self.cnn_keys) > 1:
+                parts = jnp.split(rec, np.cumsum(self.cnn_channels)[:-1], axis=-3)
+            else:
+                parts = [rec]
+            out.update({k: v for k, v in zip(self.cnn_keys, parts)})
+        if self.mlp_decoder is not None and self.mlp_keys:
+            if len(self.mlp_keys) > 1 and len(self.mlp_dims) != len(self.mlp_keys):
+                raise ValueError(
+                    f"MultiDecoder: {len(self.mlp_keys)} mlp_keys need {len(self.mlp_keys)} "
+                    f"mlp_dims for the split, got {len(self.mlp_dims)}"
+                )
+            rec = self.mlp_decoder(latent)
+            if len(self.mlp_keys) > 1:
+                parts = jnp.split(rec, np.cumsum(self.mlp_dims)[:-1], axis=-1)
+            else:
+                parts = [rec]
+            out.update({k: v for k, v in zip(self.mlp_keys, parts)})
+        return out

@@ -1,0 +1,151 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.distributions import (
+    Bernoulli,
+    Independent,
+    MSEDistribution,
+    Normal,
+    OneHotCategorical,
+    OneHotCategoricalStraightThrough,
+    SymlogDistribution,
+    TanhNormal,
+    TruncatedNormal,
+    TwoHotEncodingDistribution,
+    kl_divergence,
+)
+from sheeprl_tpu.distributions.distributions import symexp, symlog
+
+
+def test_normal_log_prob_matches_scipy():
+    from scipy.stats import norm
+
+    d = Normal(jnp.array(0.3), jnp.array(1.7))
+    x = jnp.array(0.9)
+    np.testing.assert_allclose(d.log_prob(x), norm.logpdf(0.9, 0.3, 1.7), rtol=1e-5)
+    np.testing.assert_allclose(d.entropy(), norm.entropy(0.3, 1.7), rtol=1e-5)
+
+
+def test_independent_sums():
+    d = Independent(Normal(jnp.zeros((2, 3)), jnp.ones((2, 3))), 1)
+    assert d.log_prob(jnp.zeros((2, 3))).shape == (2,)
+    assert d.entropy().shape == (2,)
+
+
+def test_tanh_normal_log_prob_consistency():
+    d = TanhNormal(jnp.array([0.2]), jnp.array([0.5]))
+    a, lp = d.sample_and_log_prob(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(lp, d.log_prob(a), rtol=1e-4)
+    assert (jnp.abs(a) <= 1.0).all()
+
+
+def test_truncated_normal_bounds_and_moments():
+    d = TruncatedNormal(jnp.array(0.0), jnp.array(1.0), -1.0, 1.0)
+    s = d.sample(jax.random.PRNGKey(0), (20000,))
+    assert (s >= -1).all() and (s <= 1).all()
+    # symmetric truncation of a centered normal keeps mean 0
+    np.testing.assert_allclose(np.asarray(d.mean), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s.mean()), 0.0, atol=0.02)
+    # log_prob integrates to ~1 over the support
+    xs = jnp.linspace(-1, 1, 2001)
+    np.testing.assert_allclose(jnp.trapezoid(jnp.exp(d.log_prob(xs)), xs), 1.0, rtol=1e-3)
+    assert d.log_prob(jnp.array(2.0)) == -jnp.inf
+
+
+def test_symlog_distribution():
+    pred = jnp.array([[0.5, -0.3]])
+    d = SymlogDistribution(pred, dims=1)
+    np.testing.assert_allclose(d.mode, symexp(pred), rtol=1e-6)
+    target = symexp(pred)
+    np.testing.assert_allclose(d.log_prob(target), 0.0, atol=1e-6)
+    assert (d.log_prob(target + 1.0) < 0).all()
+
+
+def test_mse_distribution():
+    pred = jnp.zeros((2, 3, 4, 4))
+    d = MSEDistribution(pred, dims=3)
+    assert d.log_prob(jnp.zeros((2, 3, 4, 4))).shape == (2,)
+    np.testing.assert_allclose(d.log_prob(pred), 0.0)
+
+
+def test_two_hot_round_trip():
+    # a peaked logit vector recovers the bin value through symexp
+    bins = 255
+    logits = jnp.full((1, bins), -1e9)
+    # target symlog value 3.0 sits between bins; use exact bin instead
+    support = np.linspace(-20, 20, bins)
+    k = np.abs(support - 3.0).argmin()
+    logits = logits.at[0, k].set(0.0)
+    d = TwoHotEncodingDistribution(logits, dims=1)
+    np.testing.assert_allclose(np.asarray(d.mean), symexp(jnp.array(support[k])), rtol=1e-4)
+    # log_prob of the decoded mean is the max over perturbed candidates
+    lp_exact = d.log_prob(d.mean)
+    lp_off = d.log_prob(d.mean + 5.0)
+    assert lp_exact > lp_off
+
+
+def test_two_hot_log_prob_is_cross_entropy():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 255))
+    d = TwoHotEncodingDistribution(logits, dims=1)
+    value = jnp.array([[0.7], [-2.0], [10.0], [0.0]])
+    lp = d.log_prob(value)
+    assert lp.shape == (4,) or lp.shape == ()
+    assert (lp <= 0).all()
+
+
+def test_one_hot_categorical():
+    logits = jnp.array([[2.0, 0.5, -1.0]])
+    d = OneHotCategorical(logits=logits)
+    assert (d.mode == jnp.array([[1.0, 0.0, 0.0]])).all()
+    s = d.sample(jax.random.PRNGKey(0), (1000,))
+    assert s.shape == (1000, 1, 3)
+    freq = np.asarray(s.mean(axis=0))[0]
+    np.testing.assert_allclose(freq, np.asarray(d.probs)[0], atol=0.05)
+    assert d.entropy().shape == (1,)
+
+
+def test_straight_through_gradient():
+    def loss(logits, key):
+        d = OneHotCategoricalStraightThrough(logits=logits)
+        s = d.rsample(key)
+        return jnp.sum(s * jnp.arange(3.0))
+
+    g = jax.grad(loss)(jnp.array([0.1, 0.2, 0.3]), jax.random.PRNGKey(0))
+    assert jnp.abs(g).sum() > 0  # gradient flows through probs
+
+
+def test_bernoulli():
+    d = Bernoulli(logits=jnp.array([0.0, 5.0, -5.0]))
+    np.testing.assert_allclose(np.asarray(d.probs), [0.5, 0.9933, 0.0067], atol=1e-3)
+    assert (d.mode == jnp.array([0.0, 1.0, 0.0])).all()
+    lp = d.log_prob(jnp.array([1.0, 1.0, 0.0]))
+    assert (lp <= 0).all()
+
+
+def test_kl_categorical():
+    p = OneHotCategorical(logits=jnp.array([1.0, 0.0]))
+    q = OneHotCategorical(logits=jnp.array([1.0, 0.0]))
+    np.testing.assert_allclose(np.asarray(kl_divergence(p, q)), 0.0, atol=1e-6)
+    r = OneHotCategorical(logits=jnp.array([0.0, 3.0]))
+    assert kl_divergence(p, r) > 0
+
+
+def test_kl_independent_categorical():
+    p = Independent(OneHotCategorical(logits=jnp.zeros((2, 32, 32))), 1)
+    q = Independent(OneHotCategorical(logits=jnp.ones((2, 32, 32))), 1)
+    kl = kl_divergence(p, q)
+    assert kl.shape == (2,)
+    np.testing.assert_allclose(np.asarray(kl), 0.0, atol=1e-5)  # uniform == uniform
+
+
+def test_distributions_jittable():
+    @jax.jit
+    def f(logits, key):
+        d = OneHotCategoricalStraightThrough(logits=logits)
+        s = d.rsample(key)
+        return d.log_prob(s) + d.entropy()
+
+    out = f(jnp.zeros((4, 8)), jax.random.PRNGKey(0))
+    assert out.shape == (4,)

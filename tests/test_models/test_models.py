@@ -1,0 +1,125 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.models import CNN, MLP, DeCNN, LayerNormGRUCell, MultiDecoder, MultiEncoder, NatureCNN
+from sheeprl_tpu.models.models import resolve_activation
+
+
+def test_mlp_shapes():
+    m = MLP(hidden_sizes=(16, 16), output_dim=4, activation="tanh", layer_norm=True)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((2, 8)))
+    y = m.apply(params, jnp.zeros((2, 8)))
+    assert y.shape == (2, 4)
+    # shape polymorphic over leading dims
+    y = m.apply(params, jnp.zeros((5, 3, 8)))
+    assert y.shape == (5, 3, 4)
+
+
+def test_mlp_no_head():
+    m = MLP(hidden_sizes=(16,))
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((2, 8)))
+    assert m.apply(params, jnp.zeros((2, 8))).shape == (2, 16)
+
+
+def test_mlp_flatten_dim():
+    m = MLP(hidden_sizes=(8,), output_dim=2, flatten_dim=1)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((2, 3, 4)))
+    assert m.apply(params, jnp.zeros((2, 3, 4))).shape == (2, 2)
+
+
+def test_mlp_per_layer_broadcast_error():
+    m = MLP(hidden_sizes=(16, 16), layer_norm=[True])
+    with pytest.raises(ValueError, match="per-layer"):
+        m.init(jax.random.PRNGKey(0), jnp.zeros((2, 8)))
+
+
+def test_torch_style_activation_names():
+    assert resolve_activation("torch.nn.Tanh")(jnp.array(0.5)) == jnp.tanh(0.5)
+    assert resolve_activation("torch.nn.SiLU") is jax.nn.silu
+    with pytest.raises(ValueError, match="Unknown activation"):
+        resolve_activation("torch.nn.Nope")
+
+
+def test_cnn_chw_interface():
+    m = CNN(channels=(4, 8), kernel_sizes=4, strides=2, paddings=1, layer_norm=True, activation="silu")
+    x = jnp.zeros((2, 3, 16, 16))  # [B, C, H, W]
+    params = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(params, x)
+    assert y.shape == (2, 8, 4, 4)  # channel-first out, 16 -> 8 -> 4
+
+
+def test_cnn_flatten_and_leading_dims():
+    m = CNN(channels=(4,), kernel_sizes=3, strides=2, paddings=1, flatten=True)
+    x = jnp.zeros((5, 2, 3, 8, 8))
+    params = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(params, x)
+    assert y.shape == (5, 2, 4 * 4 * 4)
+
+
+def test_decnn_inverts_cnn_shapes():
+    m = DeCNN(channels=(8, 3), kernel_sizes=4, strides=2, paddings=1)
+    x = jnp.zeros((2, 16, 4, 4))
+    params = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(params, x)
+    assert y.shape == (2, 3, 16, 16)
+
+
+def test_nature_cnn():
+    m = NatureCNN(features_dim=512)
+    x = jnp.zeros((3, 4, 64, 64))
+    params = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(params, x)
+    assert y.shape == (3, 512)
+
+
+def test_layer_norm_gru_cell():
+    cell = LayerNormGRUCell(hidden_size=8, layer_norm=True)
+    x = jnp.ones((2, 4))
+    h = jnp.zeros((2, 8))
+    params = cell.init(jax.random.PRNGKey(0), x, h)
+    h1 = cell.apply(params, x, h)
+    assert h1.shape == (2, 8)
+    h2 = cell.apply(params, x, h1)
+    assert not jnp.allclose(h1, h2)  # state evolves
+
+
+def test_gru_scan_matches_loop():
+    cell = LayerNormGRUCell(hidden_size=8)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (6, 2, 4))  # [T, B, in]
+    h0 = jnp.zeros((2, 8))
+    params = cell.init(jax.random.PRNGKey(0), xs[0], h0)
+
+    def step(h, x):
+        h = cell.apply(params, x, h)
+        return h, h
+
+    _, hs_scan = jax.lax.scan(step, h0, xs)
+    h = h0
+    for t in range(6):
+        h = cell.apply(params, xs[t], h)
+    np.testing.assert_allclose(np.asarray(hs_scan[-1]), np.asarray(h), rtol=1e-5)
+
+
+def test_multi_encoder_decoder():
+    enc = MultiEncoder(
+        cnn_encoder=CNN(channels=(4,), kernel_sizes=4, strides=2, paddings=1, flatten=True),
+        mlp_encoder=MLP(hidden_sizes=(8,)),
+        cnn_keys=("rgb",),
+        mlp_keys=("state",),
+    )
+    obs = {"rgb": jnp.zeros((2, 3, 8, 8)), "state": jnp.zeros((2, 5))}
+    params = enc.init(jax.random.PRNGKey(0), obs)
+    feat = enc.apply(params, obs)
+    assert feat.shape == (2, 4 * 4 * 4 + 8)
+
+    dec = MultiDecoder(
+        mlp_decoder=MLP(hidden_sizes=(8,), output_dim=5 + 2),
+        mlp_keys=("state", "extra"),
+        mlp_dims=(5, 2),
+    )
+    dparams = dec.init(jax.random.PRNGKey(0), feat)
+    rec = dec.apply(dparams, feat)
+    assert rec["state"].shape == (2, 5)
+    assert rec["extra"].shape == (2, 2)
