@@ -1,0 +1,78 @@
+"""Deterministic dummy environments for tests and dry runs.
+
+Behavioral contract from the reference (``sheeprl/envs/dummy.py:7-103``): a
+fixed-length episode of all-zero uint8 CHW image observations and zero reward,
+with one env per action-space type. The whole algo test suite runs on these,
+so they must be cheap and fully deterministic.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+
+class _DummyBase(gym.Env):
+    """Shared machinery: zero obs, zero reward, done after ``n_steps``."""
+
+    def __init__(self, size: Tuple[int, int, int], n_steps: int):
+        self.observation_space = gym.spaces.Box(0, 255, shape=size, dtype=np.uint8)
+        self.reward_range = (-np.inf, np.inf)
+        self._n_steps = n_steps
+        self._step = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.zeros(self.observation_space.shape, dtype=np.uint8)
+
+    def step(self, action):
+        done = self._step == self._n_steps
+        self._step += 1
+        return self._obs(), 0.0, done, False, {}
+
+    def reset(self, seed: Optional[int] = None, options=None):
+        self._step = 0
+        return self._obs(), {}
+
+    def render(self):  # pragma: no cover - nothing to draw
+        return None
+
+    def close(self):
+        pass
+
+
+class ContinuousDummyEnv(_DummyBase):
+    """Box action space (reference dummy.py:7-37)."""
+
+    def __init__(self, action_dim: int = 2, size: Tuple[int, int, int] = (3, 64, 64), n_steps: int = 128):
+        super().__init__(size, n_steps)
+        self.action_space = gym.spaces.Box(-np.inf, np.inf, shape=(action_dim,))
+
+
+class DiscreteDummyEnv(_DummyBase):
+    """Discrete action space; obs are random uint8 on step (reference dummy.py:40-70)."""
+
+    def __init__(self, action_dim: int = 2, size: Tuple[int, int, int] = (3, 64, 64), n_steps: int = 4):
+        super().__init__(size, n_steps)
+        self.action_space = gym.spaces.Discrete(action_dim)
+        self._rng = np.random.default_rng(0)
+
+    def step(self, action):
+        done = self._step == self._n_steps
+        self._step += 1
+        obs = self._rng.integers(0, 256, self.observation_space.shape, dtype=np.uint8)
+        return obs, 0.0, done, False, {}
+
+
+class MultiDiscreteDummyEnv(_DummyBase):
+    """MultiDiscrete action space (reference dummy.py:73-103)."""
+
+    def __init__(
+        self,
+        action_dims: Optional[List[int]] = None,
+        size: Tuple[int, int, int] = (3, 64, 64),
+        n_steps: int = 128,
+    ):
+        super().__init__(size, n_steps)
+        self.action_space = gym.spaces.MultiDiscrete(action_dims or [2, 2])

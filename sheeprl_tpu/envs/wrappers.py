@@ -1,0 +1,230 @@
+"""Generic gymnasium wrappers.
+
+TPU-native re-implementation of the reference wrapper set
+(``sheeprl/envs/wrappers.py``: MaskVelocityWrapper :11, ActionRepeat :46,
+RestartOnException :72, dilated FrameStack :124, RewardAsObservationWrapper
+:183, GrayscaleRenderWrapper :242). All of these run on the CPU host side of
+the pipeline — they never see a jax array — so the design goal here is low
+Python overhead per step (the host loop competes with the TPU for wall-clock).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import gymnasium as gym
+import numpy as np
+
+
+class MaskVelocityWrapper(gym.ObservationWrapper):
+    """Zero out velocity entries to make classic-control MDPs partially
+    observable (reference wrappers.py:11-43)."""
+
+    velocity_indices: Dict[str, np.ndarray] = {
+        "CartPole-v0": np.array([1, 3]),
+        "CartPole-v1": np.array([1, 3]),
+        "MountainCar-v0": np.array([1]),
+        "MountainCarContinuous-v0": np.array([1]),
+        "Pendulum-v1": np.array([2]),
+        "LunarLander-v2": np.array([2, 3, 5]),
+        "LunarLanderContinuous-v2": np.array([2, 3, 5]),
+    }
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        if env.unwrapped.spec is None:
+            raise NotImplementedError("MaskVelocityWrapper needs a spec'd env")
+        env_id = env.unwrapped.spec.id
+        if env_id not in self.velocity_indices:
+            raise NotImplementedError(f"Velocity masking not implemented for {env_id}")
+        self.mask = np.ones(env.observation_space.shape, dtype=np.float32)
+        self.mask[self.velocity_indices[env_id]] = 0.0
+
+    def observation(self, observation: np.ndarray) -> np.ndarray:
+        return observation * self.mask
+
+
+class ActionRepeat(gym.Wrapper):
+    """Repeat each action ``amount`` times, summing rewards; stop early on
+    termination (reference wrappers.py:46-70)."""
+
+    def __init__(self, env: gym.Env, amount: int = 1):
+        super().__init__(env)
+        if amount <= 0:
+            raise ValueError("`amount` should be a positive integer")
+        self._amount = int(amount)
+
+    @property
+    def action_repeat(self) -> int:
+        return self._amount
+
+    def step(self, action):
+        total_reward = 0.0
+        obs, done, truncated, info = None, False, False, {}
+        for _ in range(self._amount):
+            obs, reward, done, truncated, info = self.env.step(action)
+            total_reward += reward
+            if done or truncated:
+                break
+        return obs, total_reward, done, truncated, info
+
+
+class RestartOnException(gym.Wrapper):
+    """Fault tolerance: rebuild a crashed env from its factory and keep going.
+
+    Reference behavior (wrappers.py:72-121): on exception during step/reset,
+    rebuild via ``env_fn`` after ``wait`` seconds and return a reset
+    observation with ``info["restart_on_exception"]=True``; more than
+    ``maxfails`` crashes within ``window`` seconds re-raises.
+    """
+
+    def __init__(
+        self,
+        env_fn: Callable[[], gym.Env],
+        exceptions: Union[type, Sequence[type]] = (Exception,),
+        window: float = 300,
+        maxfails: int = 2,
+        wait: float = 20,
+    ):
+        if not isinstance(exceptions, (tuple, list)):
+            exceptions = (exceptions,)
+        self._env_fn = env_fn
+        self._exceptions = tuple(exceptions)
+        self._window = window
+        self._maxfails = maxfails
+        self._wait = wait
+        self._last_fail_time = time.time()
+        self._fails = 0
+        super().__init__(env_fn())
+
+    def _handle_crash(self, phase: str, e: Exception) -> Tuple[Any, Dict[str, Any]]:
+        now = time.time()
+        if now > self._last_fail_time + self._window:
+            self._last_fail_time = now
+            self._fails = 1
+        else:
+            self._fails += 1
+        if self._fails > self._maxfails:
+            raise RuntimeError(f"The env crashed too many times: {self._fails}")
+        gym.logger.warn(f"{phase} - Restarting env after crash with {type(e).__name__}: {e}")
+        time.sleep(self._wait)
+        self.env = self._env_fn()
+        obs, info = self.env.reset()
+        info["restart_on_exception"] = True
+        return obs, info
+
+    def step(self, action):
+        try:
+            return self.env.step(action)
+        except self._exceptions as e:
+            obs, info = self._handle_crash("STEP", e)
+            return obs, 0.0, False, False, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        try:
+            return self.env.reset(seed=seed, options=options)
+        except self._exceptions as e:
+            return self._handle_crash("RESET", e)
+
+
+class FrameStack(gym.Wrapper):
+    """Stack the last ``num_stack`` image frames (optionally dilated) along a
+    new leading axis, per cnn key (reference wrappers.py:124-180).
+
+    With ``dilation=d`` the stacked frames are every d-th of the last
+    ``num_stack*d`` raw frames.
+    """
+
+    def __init__(self, env: gym.Env, num_stack: int, cnn_keys: Sequence[str], dilation: int = 1):
+        super().__init__(env)
+        if num_stack <= 0:
+            raise ValueError(f"Invalid value for num_stack, expected a value greater than zero, got {num_stack}")
+        if not isinstance(env.observation_space, gym.spaces.Dict):
+            raise RuntimeError(
+                f"Expected an observation space of type gym.spaces.Dict, got: {type(env.observation_space)}"
+            )
+        self._num_stack = num_stack
+        self._dilation = dilation
+        self._cnn_keys = [k for k, v in env.observation_space.spaces.items() if cnn_keys and len(v.shape) == 3]
+        if not self._cnn_keys:
+            raise RuntimeError("Specify at least one valid cnn key to be stacked")
+        self.observation_space = copy.deepcopy(env.observation_space)
+        for k in self._cnn_keys:
+            space = env.observation_space[k]
+            self.observation_space[k] = gym.spaces.Box(
+                np.repeat(space.low[None], num_stack, axis=0),
+                np.repeat(space.high[None], num_stack, axis=0),
+                (num_stack, *space.shape),
+                space.dtype,
+            )
+        self._frames = {k: deque(maxlen=num_stack * dilation) for k in self._cnn_keys}
+
+    def _stacked(self, key: str) -> np.ndarray:
+        frames = list(self._frames[key])[self._dilation - 1 :: self._dilation]
+        assert len(frames) == self._num_stack
+        return np.stack(frames, axis=0)
+
+    def step(self, action):
+        obs, reward, done, truncated, info = self.env.step(action)
+        for k in self._cnn_keys:
+            self._frames[k].append(obs[k])
+            obs[k] = self._stacked(k)
+        return obs, reward, done, truncated, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None, **kwargs):
+        obs, info = self.env.reset(seed=seed, **kwargs)
+        for k in self._cnn_keys:
+            self._frames[k].clear()
+            self._frames[k].extend([obs[k]] * (self._num_stack * self._dilation))
+            obs[k] = self._stacked(k)
+        return obs, info
+
+
+class RewardAsObservationWrapper(gym.Wrapper):
+    """Expose the scalar reward as a ``reward`` observation key (reference
+    wrappers.py:183-239). Non-dict obs spaces become ``{"obs", "reward"}``."""
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        reward_range = getattr(env, "reward_range", None) or (-np.inf, np.inf)
+        reward_space = gym.spaces.Box(*reward_range, (1,), np.float32)
+        if isinstance(env.observation_space, gym.spaces.Dict):
+            self.observation_space = gym.spaces.Dict(
+                {"reward": reward_space, **dict(env.observation_space.spaces)}
+            )
+        else:
+            self.observation_space = gym.spaces.Dict(
+                {"obs": env.observation_space, "reward": reward_space}
+            )
+
+    def _convert(self, obs: Any, reward: Union[float, np.ndarray]) -> Dict[str, Any]:
+        reward_obs = np.asarray(reward, dtype=np.float32).reshape(-1)
+        if isinstance(obs, dict):
+            obs["reward"] = reward_obs
+            return obs
+        return {"obs": obs, "reward": reward_obs}
+
+    def step(self, action):
+        obs, reward, done, truncated, info = self.env.step(action)
+        return self._convert(obs, reward), reward, done, truncated, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        return self._convert(obs, 0.0), info
+
+
+class GrayscaleRenderWrapper(gym.Wrapper):
+    """Make grayscale renders 3-channel so video encoders accept them
+    (reference wrappers.py:242-253)."""
+
+    def render(self) -> Optional[Union[np.ndarray, List[np.ndarray]]]:
+        frame = super().render()
+        if isinstance(frame, np.ndarray):
+            if frame.ndim == 2:
+                frame = frame[..., None]
+            if frame.ndim == 3 and frame.shape[-1] == 1:
+                frame = frame.repeat(3, axis=-1)
+        return frame

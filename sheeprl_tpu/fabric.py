@@ -1,0 +1,263 @@
+"""The TPU runtime: mesh, precision, collectives, checkpoint I/O, callbacks.
+
+This is the TPU-native replacement for Lightning Fabric as the reference uses
+it (SURVEY §1 L5; ``sheeprl/cli.py:93,139,156``, ``ppo.py:96-201``). The
+design is SPMD-first instead of process-per-rank:
+
+- **One process drives all local chips.** The reference spawns one process per
+  device and wraps modules in DDP; here a single :class:`Fabric` owns a
+  ``jax.sharding.Mesh`` over every device (all hosts) with a ``data`` axis.
+  Train steps are jitted with batch inputs sharded over ``data``; XLA inserts
+  the gradient ``psum`` (the DDP allreduce) over ICI automatically from the
+  shardings. Multi-host runs use ``jax.distributed`` — same code, the mesh
+  just spans hosts and collectives ride ICI within a slice / DCN across.
+- **"rank" semantics.** ``world_size`` is the number of devices in the mesh
+  (matches the reference's world_size = #ranks = #devices); ``global_rank``
+  is the *process* index, used only for host-side concerns (logging,
+  checkpoint ownership, video capture). Per-rank batch/env counts from the
+  reference configs are interpreted per-device, preserving the step-accounting
+  contract (``howto/work_with_steps.md``).
+- ``fabric.save/load`` checkpoints a single pytree via Orbax (async-capable);
+  ``fabric.call(hook)`` dispatches to callbacks (reference callback.py).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _select_devices(devices: Any, accelerator: str) -> List[jax.Device]:
+    """Resolve the device list from the fabric config.
+
+    ``devices`` may be "auto" (all), an int (first N), or a list of indices.
+    ``accelerator`` ∈ {auto, cpu, gpu, cuda, tpu} picks the jax platform; on a
+    machine without that platform we fall back to the default platform with a
+    warning (the reference warns similarly for cpu/ddp mismatches).
+    """
+    platform = None
+    accelerator = (accelerator or "auto").lower()
+    if accelerator in ("tpu", "gpu", "cuda", "cpu"):
+        platform = {"cuda": "gpu"}.get(accelerator, accelerator)
+    try:
+        all_devices = jax.devices(platform) if platform else jax.devices()
+    except RuntimeError:
+        warnings.warn(f"No '{platform}' platform available; using the default jax platform")
+        all_devices = jax.devices()
+    if devices in (None, "auto", -1, "-1"):
+        return list(all_devices)
+    if isinstance(devices, (list, tuple)):
+        return [all_devices[i] for i in devices]
+    n = int(devices)
+    if n > len(all_devices):
+        raise ValueError(f"Requested {n} devices but only {len(all_devices)} are available")
+    return list(all_devices[:n])
+
+
+class Fabric:
+    """Mesh-owning runtime handed to every algorithm entrypoint as ``fabric``."""
+
+    def __init__(
+        self,
+        devices: Any = "auto",
+        num_nodes: int = 1,
+        strategy: str = "auto",
+        accelerator: str = "auto",
+        precision: str = "32-true",
+        callbacks: Optional[Sequence[Any]] = None,
+        data_axis: str = "data",
+    ):
+        self.strategy = strategy or "auto"
+        self.accelerator = accelerator or "auto"
+        self.precision = precision or "32-true"
+        self.callbacks = list(callbacks or [])
+        self.num_nodes = num_nodes
+        self._devices = _select_devices(devices, self.accelerator)
+        self.data_axis = data_axis
+        self.mesh = Mesh(np.asarray(self._devices), (data_axis,))
+        self._launched = False
+
+    # ------------------------------------------------------------------
+    # topology
+    # ------------------------------------------------------------------
+
+    @property
+    def world_size(self) -> int:
+        """Number of devices in the mesh (reference: number of DDP ranks)."""
+        return len(self._devices)
+
+    @property
+    def global_rank(self) -> int:
+        """Process index — host-side identity for logging/checkpointing."""
+        return jax.process_index()
+
+    @property
+    def local_rank(self) -> int:
+        return 0
+
+    @property
+    def node_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return jax.process_index() == 0
+
+    @property
+    def device(self) -> jax.Device:
+        return self._devices[0]
+
+    @property
+    def local_devices(self) -> List[jax.Device]:
+        return [d for d in self._devices if d.process_index == jax.process_index()]
+
+    # ------------------------------------------------------------------
+    # precision
+    # ------------------------------------------------------------------
+
+    @property
+    def compute_dtype(self):
+        """bf16 under mixed precision — params stay f32, activations bf16
+        (the TPU-native analog of fabric's "bf16-mixed")."""
+        return jnp.bfloat16 if "bf16" in self.precision or "16" in self.precision else jnp.float32
+
+    @property
+    def param_dtype(self):
+        return jnp.bfloat16 if self.precision == "bf16-true" else jnp.float32
+
+    # ------------------------------------------------------------------
+    # shardings
+    # ------------------------------------------------------------------
+
+    def sharding(self, *spec: Any) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def data_sharding(self) -> NamedSharding:
+        """Leading axis split over the mesh's data axis."""
+        return NamedSharding(self.mesh, P(self.data_axis))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_data(self, tree: Any) -> Any:
+        """Host→HBM: place a pytree with leading-axis data-parallel sharding."""
+        return jax.device_put(tree, self.data_sharding)
+
+    def to_device(self, tree: Any) -> Any:
+        """Host→HBM replicated placement."""
+        return jax.device_put(tree, self.replicated)
+
+    # ------------------------------------------------------------------
+    # launch & module setup (reference-API parity shims)
+    # ------------------------------------------------------------------
+
+    def launch(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        """Run the entrypoint. No process spawning: SPMD jit covers all local
+        devices, and multi-host launch is external (one process per host via
+        ``jax.distributed``), so this just validates topology and calls in."""
+        self._launched = True
+        if self.num_nodes > 1 and jax.process_count() == 1:
+            warnings.warn(
+                f"fabric.num_nodes={self.num_nodes} but jax.distributed is not initialized; "
+                "running single-host"
+            )
+        return fn(self, *args, **kwargs)
+
+    def setup_module(self, module: Any) -> Any:
+        """Parity shim: flax params are plain pytrees; DP is expressed via
+        shardings at jit boundaries, not module wrappers."""
+        return module
+
+    def setup_optimizers(self, *optimizers: Any):
+        return optimizers if len(optimizers) > 1 else optimizers[0]
+
+    # ------------------------------------------------------------------
+    # host-level collectives (cross-process; in-step collectives are XLA's)
+    # ------------------------------------------------------------------
+
+    def barrier(self, name: str = "") -> None:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name or "fabric-barrier")
+
+    def all_gather(self, tree: Any) -> Any:
+        """Gather a host-side pytree across processes → leaves with a new
+        leading process axis. Single-process: adds the axis (world view)."""
+        if jax.process_count() == 1:
+            return jax.tree_util.tree_map(lambda x: np.asarray(x)[None], tree)
+        from jax.experimental import multihost_utils
+
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(multihost_utils.process_allgather(np.asarray(x))), tree
+        )
+
+    def broadcast(self, tree: Any, src: int = 0) -> Any:
+        if jax.process_count() == 1:
+            return tree
+        from jax.experimental import multihost_utils
+
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(multihost_utils.broadcast_one_to_all(np.asarray(x))), tree
+        )
+
+    # ------------------------------------------------------------------
+    # checkpointing (reference fabric.save/load → Orbax pytree checkpoint)
+    # ------------------------------------------------------------------
+
+    def save(self, path: str, state: Dict[str, Any]) -> None:
+        """Checkpoint a state pytree. Only process 0 writes (single-host);
+        multi-host Orbax coordinates all processes."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        state = jax.device_get(state)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(path, state, force=True)
+
+    def load(self, path: str, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Restore a checkpoint pytree; with ``state`` given, restores
+        structure/dtypes against it (reference fabric.load semantics)."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            if state is not None:
+                restored = ckptr.restore(path, item=jax.device_get(state))
+            else:
+                restored = ckptr.restore(path)
+        return restored
+
+    # ------------------------------------------------------------------
+    # callbacks (reference fabric.call → utils/callback.py)
+    # ------------------------------------------------------------------
+
+    def call(self, hook_name: str, **kwargs: Any) -> None:
+        for cb in self.callbacks:
+            hook = getattr(cb, hook_name, None)
+            if callable(hook):
+                hook(fabric=self, **kwargs)
+
+    # ------------------------------------------------------------------
+    # misc parity helpers
+    # ------------------------------------------------------------------
+
+    def seed_everything(self, seed: int) -> jax.Array:
+        """Seed numpy/python and return the root jax PRNG key."""
+        import random
+
+        random.seed(seed)
+        np.random.seed(seed)
+        return jax.random.PRNGKey(seed)
+
+    def print(self, *args: Any, **kwargs: Any) -> None:
+        if self.is_global_zero:
+            print(*args, **kwargs)

@@ -1,0 +1,84 @@
+"""Checkpoint callback.
+
+Reference behavior (``sheeprl/utils/callback.py:10-92``): dispatched via
+``fabric.call("on_checkpoint_{coupled|player|trainer}")``; optionally embeds
+the replay-buffer state with the last stored ``dones`` forced to 1 so the
+in-progress episode terminates cleanly on restore (callback.py:32-40,59-64),
+and prunes old checkpoints. Buffers are host-side numpy, so each process saves
+its own buffer state alongside the (replicated) model pytree.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class CheckpointCallback:
+    """Saves `state` (a pytree of arrays + counters) and optionally buffers."""
+
+    def __init__(self, keep_last: Optional[int] = None):
+        self.keep_last = keep_last
+
+    # -- buffer embedding ------------------------------------------------
+
+    @staticmethod
+    def _buffer_state(rb) -> Dict[str, Any]:
+        """Snapshot buffer state with trailing dones forced terminal."""
+        if isinstance(rb, (list, tuple)):  # per-env buffer lists (AsyncReplayBuffer parts)
+            return {"__list__": [CheckpointCallback._buffer_state(b) for b in rb]}
+        state = rb.state_dict()
+        buf = state.get("buffer")
+        if isinstance(buf, dict):
+            # force the step before `pos` to be terminal (reference :32-40)
+            for key in ("dones", "terminated", "truncated"):
+                if key in buf and key == "dones":
+                    arr = np.asarray(buf[key])
+                    pos = state.get("pos", 0)
+                    if arr.size and len(rb) > 0:
+                        arr = arr.copy()
+                        arr[(pos - 1) % arr.shape[0]] = 1
+                        buf[key] = arr
+        return state
+
+    def _prune(self, ckpt_dir: str) -> None:
+        if not self.keep_last or not os.path.isdir(ckpt_dir):
+            return
+        paths = glob.glob(os.path.join(ckpt_dir, "ckpt_*"))
+
+        def step_of(p: str) -> int:
+            m = re.search(r"ckpt_(\d+)", os.path.basename(p))
+            return int(m.group(1)) if m else -1
+
+        for path in sorted(paths, key=step_of)[: -self.keep_last]:
+            try:
+                import shutil
+
+                shutil.rmtree(path, ignore_errors=True)
+            except OSError:
+                pass
+
+    # -- hooks (dispatched by fabric.call) -------------------------------
+
+    def on_checkpoint_coupled(
+        self,
+        fabric,
+        ckpt_path: str,
+        state: Dict[str, Any],
+        replay_buffer=None,
+        **_: Any,
+    ) -> None:
+        if replay_buffer is not None:
+            state = {**state, "rb": self._buffer_state(replay_buffer)}
+        fabric.save(ckpt_path, state)
+        self._prune(os.path.dirname(ckpt_path))
+
+    def on_checkpoint_player(self, fabric, ckpt_path: str, state: Dict[str, Any], replay_buffer=None, **_: Any):
+        self.on_checkpoint_coupled(fabric, ckpt_path, state, replay_buffer)
+
+    def on_checkpoint_trainer(self, fabric, ckpt_path: str, state: Dict[str, Any], **_: Any):
+        self.on_checkpoint_coupled(fabric, ckpt_path, state)

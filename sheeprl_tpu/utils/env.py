@@ -1,0 +1,195 @@
+"""Environment factory.
+
+Re-implementation of the reference ``make_env`` pipeline
+(``sheeprl/utils/env.py:25-203``) on gymnasium 1.x: every env is normalized to
+a ``gym.spaces.Dict`` observation space whose image keys are uint8 CHW frames
+resized to ``env.screen_size`` (optionally grayscaled / frame-stacked), and
+whose vector keys pass through untouched. Envs run on the CPU host; the data
+layer stages their numpy output to the TPU.
+
+Pipeline order (matching the reference): wrapper target → ActionRepeat →
+MaskVelocity → dict-ification → resize/grayscale/CHW → FrameStack →
+RewardAsObservation → seeding → TimeLimit → RecordEpisodeStatistics →
+RecordVideo.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+import cv2
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    FrameStack,
+    GrayscaleRenderWrapper,
+    MaskVelocityWrapper,
+    RewardAsObservationWrapper,
+)
+
+
+def _dictify_observations(env: gym.Env, cfg) -> gym.Env:
+    """Wrap non-dict observation spaces into a single-key Dict space.
+
+    Mirrors reference env.py:88-130: 1-D Box → the first mlp key (default
+    ``state``); 2/3-D Box → the first cnn key (default ``rgb``).
+    """
+    space = env.observation_space
+    if isinstance(space, gym.spaces.Dict):
+        return env
+    if not isinstance(space, gym.spaces.Box):
+        raise ValueError(f"Unsupported observation space: {type(space)}")
+    if len(space.shape) < 2:
+        keys = cfg.mlp_keys.encoder
+        if keys:
+            if len(keys) > 1:
+                warnings.warn(
+                    f"Multiple mlp keys specified but {cfg.env.id} has a single vector "
+                    f"observation; keeping only {keys[0]}"
+                )
+            key = keys[0]
+        else:
+            key = "state"
+            cfg.mlp_keys.encoder = [key]
+    elif len(space.shape) <= 3:
+        keys = cfg.cnn_keys.encoder
+        if keys:
+            if len(keys) > 1:
+                warnings.warn(
+                    f"Multiple cnn keys specified but {cfg.env.id} has a single pixel "
+                    f"observation; keeping only {keys[0]}"
+                )
+            key = keys[0]
+        else:
+            key = "rgb"
+            cfg.cnn_keys.encoder = [key]
+    else:
+        raise ValueError(f"Unsupported Box observation rank: {space.shape}")
+    return gym.wrappers.TransformObservation(
+        env, lambda obs, k=key: {k: obs}, observation_space=gym.spaces.Dict({key: space})
+    )
+
+
+def _image_transform(cfg, cnn_keys) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+    """Per-step image normalization: resize → grayscale → uint8 CHW
+    (reference env.py:136-171)."""
+    screen = cfg.env.screen_size
+    grayscale = cfg.env.grayscale
+
+    def transform(obs: Dict[str, Any]) -> Dict[str, Any]:
+        for k in cnn_keys:
+            frame = obs[k]
+            is_3d = frame.ndim == 3
+            is_gray = not is_3d or frame.shape[0] == 1 or frame.shape[-1] == 1
+            channel_first = not is_3d or frame.shape[0] in (1, 3)
+            if not is_3d:
+                frame = frame[None]
+            if channel_first:
+                frame = np.transpose(frame, (1, 2, 0))
+            if frame.shape[:-1] != (screen, screen):
+                frame = cv2.resize(frame, (screen, screen), interpolation=cv2.INTER_AREA)
+            if grayscale and not is_gray:
+                frame = cv2.cvtColor(frame, cv2.COLOR_RGB2GRAY)
+            if frame.ndim == 2:
+                frame = frame[..., None]
+                if not grayscale:
+                    frame = np.repeat(frame, 3, axis=-1)
+            obs[k] = np.transpose(frame, (2, 0, 1))
+        return obs
+
+    return transform
+
+
+def make_env(
+    cfg,
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    vector_env_idx: int = 0,
+) -> Callable[[], gym.Env]:
+    """Return a thunk that builds one fully-wrapped env (reference env.py:25-203)."""
+
+    def thunk() -> gym.Env:
+        try:
+            env_spec = gym.spec(cfg.env.id).entry_point
+        except Exception:
+            env_spec = ""
+
+        kwargs = {}
+        if "seed" in cfg.env.wrapper:
+            kwargs["seed"] = seed
+        if "rank" in cfg.env.wrapper:
+            kwargs["rank"] = rank + vector_env_idx
+        env = instantiate(cfg.env.wrapper, **kwargs)
+
+        # Atari-style envs repeat actions internally (reference env.py:75-80)
+        if cfg.env.action_repeat > 1 and "atari" not in str(env_spec):
+            env = ActionRepeat(env, cfg.env.action_repeat)
+        if cfg.env.get("mask_velocities", False):
+            env = MaskVelocityWrapper(env)
+
+        env = _dictify_observations(env, cfg)
+
+        env_cnn_keys = {k for k, v in env.observation_space.spaces.items() if len(v.shape) in (2, 3)}
+        user_cnn_keys = set(cfg.cnn_keys.encoder or [])
+        cnn_keys = sorted(env_cnn_keys & user_cnn_keys)
+
+        if cnn_keys:
+            channels = 1 if cfg.env.grayscale else 3
+            new_space = dict(env.observation_space.spaces)
+            for k in cnn_keys:
+                new_space[k] = gym.spaces.Box(
+                    0, 255, (channels, cfg.env.screen_size, cfg.env.screen_size), np.uint8
+                )
+            env = gym.wrappers.TransformObservation(
+                env, _image_transform(cfg, cnn_keys), observation_space=gym.spaces.Dict(new_space)
+            )
+
+            if cfg.env.frame_stack > 1:
+                if cfg.env.frame_stack_dilation <= 0:
+                    raise ValueError(
+                        "The frame stack dilation argument must be greater than zero, "
+                        f"got: {cfg.env.frame_stack_dilation}"
+                    )
+                env = FrameStack(env, cfg.env.frame_stack, cnn_keys, cfg.env.frame_stack_dilation)
+
+        if cfg.env.get("reward_as_observation", False):
+            env = RewardAsObservationWrapper(env)
+
+        env.action_space.seed(seed)
+        env.observation_space.seed(seed)
+        if cfg.env.max_episode_steps and cfg.env.max_episode_steps > 0:
+            env = gym.wrappers.TimeLimit(env, max_episode_steps=cfg.env.max_episode_steps)
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if cfg.env.capture_video and rank == 0 and vector_env_idx == 0 and run_name is not None:
+            if cfg.env.grayscale:
+                env = GrayscaleRenderWrapper(env)
+            video_dir = os.path.join(run_name, prefix + "_videos" if prefix else "videos")
+            env = gym.wrappers.RecordVideo(env, video_dir, disable_logger=True)
+        return env
+
+    return thunk
+
+
+def get_dummy_env(id: str) -> gym.Env:  # noqa: A002 — kwarg name fixed by env/dummy.yaml
+    """Deterministic dummy envs used by the test suite (reference env.py:206-221)."""
+    env_id = id
+    if "continuous" in env_id:
+        from sheeprl_tpu.envs.dummy import ContinuousDummyEnv
+
+        return ContinuousDummyEnv()
+    if "multidiscrete" in env_id:
+        from sheeprl_tpu.envs.dummy import MultiDiscreteDummyEnv
+
+        return MultiDiscreteDummyEnv()
+    if "discrete" in env_id:
+        from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+
+        return DiscreteDummyEnv()
+    raise ValueError(f"Unrecognized dummy environment: {env_id}")

@@ -1,0 +1,94 @@
+"""TensorBoard logging + shared run directory.
+
+Reference behavior (``sheeprl/utils/logger.py``): rank-0 creates
+``logs/runs/<root_dir>/<run_name>/version_k`` and broadcasts the resolved path
+so all ranks agree; only rank 0 owns a writer. Here "rank" is the jax process
+index; the broadcast uses multihost utils when multi-host, and is a no-op in
+the common single-process SPMD case (one process drives all local chips).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+class TensorBoardLogger:
+    """Thin tensorboardX wrapper with the log-call surface the train loops use."""
+
+    def __init__(self, log_dir: str):
+        from tensorboardX import SummaryWriter
+
+        self.log_dir = log_dir
+        self._writer = SummaryWriter(log_dir)
+
+    def log_metrics(self, metrics: Dict[str, Any], step: int) -> None:
+        for name, value in metrics.items():
+            if value is None:
+                continue
+            self._writer.add_scalar(name, float(np.asarray(value)), step)
+
+    def log_hyperparams(self, params: Dict[str, Any]) -> None:
+        import yaml
+
+        text = yaml.safe_dump(params, sort_keys=False)
+        self._writer.add_text("hparams", f"```yaml\n{text}\n```")
+
+    def add_video(self, tag: str, video, step: int, fps: int = 30) -> None:
+        self._writer.add_video(tag, video, step, fps=fps)
+
+    def flush(self) -> None:
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+def _next_version(parent: str) -> int:
+    if not os.path.isdir(parent):
+        return 0
+    versions = [
+        int(d.split("_")[1])
+        for d in os.listdir(parent)
+        if d.startswith("version_") and d.split("_")[1].isdigit()
+    ]
+    return max(versions) + 1 if versions else 0
+
+
+def get_log_dir(cfg, root_dir: str, run_name: str, share: bool = True) -> str:
+    """Resolve (and on process 0, create) the versioned run directory.
+
+    Multi-host: process 0 picks ``version_k`` and broadcasts the path, exactly
+    like the reference's rank-0 broadcast (logger.py:24-74).
+    """
+    base = os.path.join("logs", "runs", root_dir, run_name)
+    if jax.process_index() == 0:
+        log_dir = os.path.join(base, f"version_{_next_version(base)}")
+        os.makedirs(log_dir, exist_ok=True)
+    else:
+        log_dir = ""
+    if share and jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        buf = np.zeros(4096, dtype=np.uint8)
+        if jax.process_index() == 0:
+            encoded = log_dir.encode()
+            buf[: len(encoded)] = np.frombuffer(encoded, dtype=np.uint8)
+        buf = multihost_utils.broadcast_one_to_all(buf)
+        log_dir = bytes(buf[buf != 0]).decode()
+    return log_dir
+
+
+def create_tensorboard_logger(cfg, exp_name: Optional[str] = None):
+    """Build (logger, log_dir); logger is None off-process-0 or at log_level 0
+    (reference logger.py:11-21)."""
+    root_dir = cfg.root_dir if cfg.root_dir is not None else exp_name or "default"
+    run_name = cfg.run_name
+    log_dir = get_log_dir(cfg, root_dir, run_name)
+    logger = None
+    if jax.process_index() == 0 and cfg.metric.log_level > 0:
+        logger = TensorBoardLogger(log_dir)
+    return logger, log_dir

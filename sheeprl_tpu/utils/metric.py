@@ -1,0 +1,212 @@
+"""Metric aggregation.
+
+TPU-native re-design of the reference observability pieces
+(``sheeprl/utils/metric.py``: MetricAggregator :17-143,
+RankIndependentMetricAggregator :146-195; torchmetrics Mean/Sum/Max/Min
+metrics built from config, ``configs/metric/default.yaml``).
+
+Metrics here are tiny host-side accumulators over python floats / numpy
+scalars — deliberately *not* jax arrays, so updating them never inserts a
+device sync into the train loop; callers pass values they already pulled from
+the device (usually once per `log_every` window). ``sync_on_compute`` uses
+``jax.experimental.multihost_utils`` process-level collectives when running
+multi-host, mirroring the reference's torchmetrics distributed sync.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+
+def _to_scalar(value: Any) -> float:
+    """Accept python numbers, numpy scalars, and (possibly device) jax arrays."""
+    if hasattr(value, "item"):
+        return float(np.asarray(value).item())
+    return float(value)
+
+
+def _process_sum(values: np.ndarray) -> np.ndarray:
+    """Sum an array across processes (no-op single-process)."""
+    if jax.process_count() == 1:
+        return values
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(values)).sum(axis=0)
+
+
+class Metric:
+    """Base accumulator. Subclasses define how values fold together."""
+
+    def __init__(self, sync_on_compute: bool = False):
+        self.sync_on_compute = sync_on_compute
+        self.reset()
+
+    def update(self, value: Any, weight: float = 1.0) -> None:
+        raise NotImplementedError
+
+    def compute(self) -> float:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class MeanMetric(Metric):
+    def reset(self) -> None:
+        self._sum = 0.0
+        self._count = 0.0
+
+    def update(self, value: Any, weight: float = 1.0) -> None:
+        self._sum += _to_scalar(value) * weight
+        self._count += weight
+
+    def compute(self) -> float:
+        total, count = self._sum, self._count
+        if self.sync_on_compute:
+            synced = _process_sum(np.array([total, count]))
+            total, count = float(synced[0]), float(synced[1])
+        return total / count if count else float("nan")
+
+
+class SumMetric(Metric):
+    def reset(self) -> None:
+        self._sum = 0.0
+
+    def update(self, value: Any, weight: float = 1.0) -> None:
+        self._sum += _to_scalar(value)
+
+    def compute(self) -> float:
+        if self.sync_on_compute:
+            return float(_process_sum(np.array([self._sum]))[0])
+        return self._sum
+
+
+class _ExtremumMetric(Metric):
+    _fold = staticmethod(max)
+    _empty = float("nan")
+
+    def reset(self) -> None:
+        self._value: Optional[float] = None
+
+    def update(self, value: Any, weight: float = 1.0) -> None:
+        v = _to_scalar(value)
+        self._value = v if self._value is None else self._fold(self._value, v)
+
+    def compute(self) -> float:
+        value = self._empty if self._value is None else self._value
+        if self.sync_on_compute and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            gathered = np.asarray(multihost_utils.process_allgather(np.array([value])))
+            finite = gathered[np.isfinite(gathered)]
+            return float(self._fold(finite.tolist())) if finite.size else self._empty
+        return value
+
+
+class MaxMetric(_ExtremumMetric):
+    _fold = staticmethod(max)
+
+
+class MinMetric(_ExtremumMetric):
+    _fold = staticmethod(min)
+
+
+class LastValueMetric(Metric):
+    """Keeps only the most recent value (useful for schedules/counters)."""
+
+    def reset(self) -> None:
+        self._value = float("nan")
+
+    def update(self, value: Any, weight: float = 1.0) -> None:
+        self._value = _to_scalar(value)
+
+    def compute(self) -> float:
+        return self._value
+
+
+class MetricAggregator:
+    """Name→Metric dict driven by config (reference metric.py:17-143).
+
+    ``update`` on a missing key raises only when ``raise_on_missing`` — the CLI
+    prunes unwanted keys at startup, so silent-skip is the normal mode.
+    ``compute`` drops NaN values, as the reference does (metric.py:138-142).
+    """
+
+    disabled: bool = False
+
+    def __init__(self, metrics: Optional[Dict[str, Metric]] = None, raise_on_missing: bool = False):
+        self.metrics: Dict[str, Metric] = dict(metrics or {})
+        self._raise_on_missing = raise_on_missing
+
+    def add(self, name: str, metric: Metric) -> None:
+        if name in self.metrics:
+            raise ValueError(f"Metric '{name}' already present in the aggregator")
+        self.metrics[name] = metric
+
+    def pop(self, name: str) -> None:
+        if name not in self.metrics and self._raise_on_missing:
+            raise KeyError(f"Metric '{name}' not present in the aggregator")
+        self.metrics.pop(name, None)
+
+    def update(self, name: str, value: Any, weight: float = 1.0) -> None:
+        if self.disabled:
+            return
+        metric = self.metrics.get(name)
+        if metric is None:
+            if self._raise_on_missing:
+                raise KeyError(f"Metric '{name}' not present in the aggregator")
+            return
+        metric.update(value, weight)
+
+    def reset(self) -> None:
+        for metric in self.metrics.values():
+            metric.reset()
+
+    def compute(self) -> Dict[str, float]:
+        if self.disabled:
+            return {}
+        out: Dict[str, float] = {}
+        for name, metric in self.metrics.items():
+            try:
+                value = metric.compute()
+            except Exception:
+                continue
+            if not (isinstance(value, float) and math.isnan(value)):
+                out[name] = value
+        return out
+
+    def keys(self):
+        return self.metrics.keys()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.metrics
+
+
+class RankIndependentMetricAggregator:
+    """Per-process values gathered without reduction (reference metric.py:146-195)."""
+
+    def __init__(self, metrics: Union[Sequence[str], Dict[str, Metric]]):
+        if not isinstance(metrics, dict):
+            metrics = {name: MeanMetric(sync_on_compute=False) for name in metrics}
+        self._aggregator = MetricAggregator(metrics)
+
+    def update(self, name: str, value: Any) -> None:
+        self._aggregator.update(name, value)
+
+    def compute(self) -> Dict[str, List[float]]:
+        local = self._aggregator.compute()
+        if jax.process_count() == 1:
+            return {k: [v] for k, v in local.items()}
+        from jax.experimental import multihost_utils
+
+        keys = sorted(local.keys())
+        values = np.array([local[k] for k in keys])
+        gathered = np.asarray(multihost_utils.process_allgather(values))
+        return {k: gathered[:, i].tolist() for i, k in enumerate(keys)}
+
+    def reset(self) -> None:
+        self._aggregator.reset()

@@ -1,0 +1,97 @@
+"""Optimizers: torch-constructor surface over optax.
+
+The reference instantiates ``torch.optim.{Adam,AdamW,SGD}`` straight from
+config (``ppo.py:192``, ``configs/optim/*.yaml``); the alias table in
+:mod:`sheeprl_tpu.config.instantiate` routes those targets here. Each factory
+returns an ``optax.GradientTransformation`` wrapped in
+``optax.inject_hyperparams`` so the learning rate lives *in the optimizer
+state pytree* — schedules (PPO's ``anneal_lr``) become functional state
+updates inside the jitted step instead of host-side mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import optax
+
+
+def _clipped(tx: optax.GradientTransformation, max_grad_norm: Optional[float]) -> optax.GradientTransformation:
+    if max_grad_norm and max_grad_norm > 0:
+        return optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+    return tx
+
+
+def Adam(
+    lr: float = 1e-3,
+    betas: Sequence[float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    max_grad_norm: Optional[float] = None,
+) -> optax.GradientTransformation:
+    b1, b2 = betas
+    if weight_decay:
+        base = optax.inject_hyperparams(optax.adamw)(
+            learning_rate=lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay
+        )
+    else:
+        base = optax.inject_hyperparams(optax.adam)(learning_rate=lr, b1=b1, b2=b2, eps=eps)
+    return _clipped(base, max_grad_norm)
+
+
+def AdamW(
+    lr: float = 1e-3,
+    betas: Sequence[float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 1e-2,
+    max_grad_norm: Optional[float] = None,
+) -> optax.GradientTransformation:
+    b1, b2 = betas
+    base = optax.inject_hyperparams(optax.adamw)(
+        learning_rate=lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay
+    )
+    return _clipped(base, max_grad_norm)
+
+
+def SGD(
+    lr: float = 1e-2,
+    momentum: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    max_grad_norm: Optional[float] = None,
+) -> optax.GradientTransformation:
+    base = optax.inject_hyperparams(optax.sgd)(
+        learning_rate=lr, momentum=momentum if momentum else None, nesterov=nesterov
+    )
+    if weight_decay:
+        base = optax.chain(optax.add_decayed_weights(weight_decay), base)
+    return _clipped(base, max_grad_norm)
+
+
+def get_lr(opt_state) -> float:
+    """Read the current injected learning rate out of an optimizer state."""
+    state = opt_state
+    if isinstance(state, tuple) and hasattr(state, "_fields") is False:
+        # chained: inject_hyperparams state is the last element
+        for part in state:
+            if hasattr(part, "hyperparams"):
+                state = part
+                break
+    if hasattr(state, "hyperparams"):
+        return float(state.hyperparams["learning_rate"])
+    raise ValueError("Optimizer state carries no injected learning rate")
+
+
+def set_lr(opt_state, lr):
+    """Functionally set the injected learning rate (returns a new state)."""
+    import jax
+
+    if hasattr(opt_state, "hyperparams"):
+        hp = dict(opt_state.hyperparams)
+        hp["learning_rate"] = lr
+        return opt_state._replace(hyperparams=hp)
+    if isinstance(opt_state, tuple):
+        return type(opt_state)(
+            *[set_lr(p, lr) if hasattr(p, "hyperparams") else p for p in opt_state]
+        )
+    raise ValueError("Optimizer state carries no injected learning rate")

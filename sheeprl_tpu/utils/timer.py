@@ -1,0 +1,58 @@
+"""Named-scope wall-clock timing.
+
+Same API as the reference global ``timer`` (``sheeprl/utils/timer.py:15-83``):
+a context-decorator keyed by name into a class-level registry, globally
+disable-able, with ``compute()`` returning accumulated seconds and resetting.
+Train loops wrap the env-interaction and train phases; the CLI derives
+``Time/sps_*`` rates from the ratios.
+
+One TPU-specific caveat: jax dispatch is async, so a timed block that only
+*launches* device work would under-report. Callers time around points where
+they already synchronize (e.g. after pulling losses to host); ``timer`` itself
+stays a pure wall-clock measure, matching the reference semantics.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import ContextDecorator
+from typing import Dict, Optional
+
+from sheeprl_tpu.utils.metric import SumMetric
+
+
+class timer(ContextDecorator):
+    """``with timer("Time/train_time"): ...`` accumulates into a global registry."""
+
+    disabled: bool = False
+    timers: Dict[str, SumMetric] = {}
+
+    def __init__(self, name: str, metric: Optional[SumMetric] = None):
+        self.name = name
+        if not timer.disabled and name not in timer.timers:
+            timer.timers[name] = metric if metric is not None else SumMetric(sync_on_compute=False)
+
+    def __enter__(self):
+        if not timer.disabled:
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if not timer.disabled:
+            timer.timers[self.name].update(time.perf_counter() - self._start)
+        return False
+
+    @classmethod
+    def to(cls, device=None) -> None:  # pragma: no cover - reference-API shim
+        pass
+
+    @classmethod
+    def compute(cls) -> Dict[str, float]:
+        """Accumulated seconds per name; resets the registry (reference :60-76)."""
+        out = {name: metric.compute() for name, metric in cls.timers.items()}
+        cls.reset()
+        return out
+
+    @classmethod
+    def reset(cls) -> None:
+        cls.timers = {}

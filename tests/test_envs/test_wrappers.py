@@ -1,0 +1,200 @@
+"""Env-layer tests: wrappers + the make_env normalization pipeline.
+
+The reference's env tests were a stub (tests/test_envs/test_wrappers.py,
+10 LoC); SURVEY.md §4 lists this as a gap to close, so these go further:
+behavioral tests for every generic wrapper and the full make_env pipeline on
+the dummy envs.
+"""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.envs.dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    FrameStack,
+    RestartOnException,
+    RewardAsObservationWrapper,
+)
+from sheeprl_tpu.utils.env import make_env
+
+
+class _CountingEnv(gym.Env):
+    """1-D obs env that counts steps; reward == step index."""
+
+    def __init__(self, n_steps=100):
+        self.observation_space = gym.spaces.Box(-np.inf, np.inf, shape=(3,), dtype=np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+        self._n = n_steps
+        self._t = 0
+
+    def step(self, action):
+        self._t += 1
+        done = self._t >= self._n
+        return np.full(3, self._t, dtype=np.float32), float(self._t), done, False, {}
+
+    def reset(self, seed=None, options=None):
+        self._t = 0
+        return np.zeros(3, dtype=np.float32), {}
+
+
+def test_action_repeat_sums_rewards_and_stops_on_done():
+    env = ActionRepeat(_CountingEnv(n_steps=5), amount=3)
+    env.reset()
+    obs, reward, done, trunc, _ = env.step(0)
+    assert reward == 1 + 2 + 3
+    obs, reward, done, trunc, _ = env.step(0)
+    # only steps 4 and 5 happen before done
+    assert reward == 4 + 5 and done
+
+
+def test_action_repeat_rejects_nonpositive():
+    with pytest.raises(ValueError):
+        ActionRepeat(_CountingEnv(), amount=0)
+
+
+@pytest.mark.parametrize("dilation", [1, 2])
+def test_frame_stack_shapes_and_dilation(dilation):
+    base = DiscreteDummyEnv(size=(3, 8, 8))
+    env = gym.wrappers.TransformObservation(
+        base,
+        lambda o: {"rgb": o},
+        observation_space=gym.spaces.Dict({"rgb": base.observation_space}),
+    )
+    env = FrameStack(env, num_stack=4, cnn_keys=["rgb"], dilation=dilation)
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (4, 3, 8, 8)
+    assert env.observation_space["rgb"].shape == (4, 3, 8, 8)
+    # on reset all stacked frames equal the first frame
+    assert (obs["rgb"] == obs["rgb"][0]).all()
+    obs, *_ = env.step(0)
+    assert obs["rgb"].shape == (4, 3, 8, 8)
+
+
+def test_frame_stack_requires_dict_space():
+    with pytest.raises(RuntimeError):
+        FrameStack(DiscreteDummyEnv(), num_stack=2, cnn_keys=["rgb"])
+
+
+def test_frame_stack_requires_positive_stack():
+    base = DiscreteDummyEnv(size=(3, 8, 8))
+    env = gym.wrappers.TransformObservation(
+        base,
+        lambda o: {"rgb": o},
+        observation_space=gym.spaces.Dict({"rgb": base.observation_space}),
+    )
+    with pytest.raises(ValueError):
+        FrameStack(env, num_stack=0, cnn_keys=["rgb"])
+
+
+def test_reward_as_observation_plain_space():
+    env = RewardAsObservationWrapper(_CountingEnv())
+    obs, _ = env.reset()
+    assert set(obs.keys()) == {"obs", "reward"}
+    assert obs["reward"] == np.zeros(1, dtype=np.float32)
+    obs, reward, *_ = env.step(0)
+    assert obs["reward"][0] == reward == 1.0
+    assert isinstance(env.observation_space, gym.spaces.Dict)
+
+
+def test_restart_on_exception_recovers():
+    calls = {"n": 0}
+
+    class _Crashy(_CountingEnv):
+        def step(self, action):
+            if calls["n"] == 0:
+                calls["n"] += 1
+                raise RuntimeError("boom")
+            return super().step(action)
+
+    env = RestartOnException(lambda: _Crashy(), wait=0, window=300, maxfails=2)
+    env.reset()
+    obs, reward, done, trunc, info = env.step(0)
+    assert info.get("restart_on_exception") is True
+    assert reward == 0.0 and not done
+
+
+def test_restart_on_exception_gives_up():
+    class _AlwaysCrash(_CountingEnv):
+        def step(self, action):
+            raise RuntimeError("boom")
+
+    env = RestartOnException(lambda: _AlwaysCrash(), wait=0, window=300, maxfails=1)
+    env.reset()
+    env.step(0)
+    with pytest.raises(RuntimeError, match="crashed too many times"):
+        env.step(0)
+
+
+# ---------------------------------------------------------------------------
+# make_env pipeline
+# ---------------------------------------------------------------------------
+
+
+def _env_cfg(overrides):
+    return compose(
+        "config",
+        ["exp=ppo", "env=dummy", "env.capture_video=False", *overrides],
+        allow_missing=("env.id",),
+    )
+
+
+@pytest.mark.parametrize("env_id", ["continuous_dummy", "discrete_dummy", "multidiscrete_dummy"])
+def test_make_env_dummy_pixel_pipeline(env_id):
+    cfg = _env_cfg([f"env.id={env_id}", "cnn_keys.encoder=[rgb]", "mlp_keys.encoder=[]"])
+    env = make_env(cfg, seed=0, rank=0)()
+    assert isinstance(env.observation_space, gym.spaces.Dict)
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (3, 64, 64) and obs["rgb"].dtype == np.uint8
+
+
+def test_make_env_resize_and_grayscale():
+    cfg = _env_cfg(
+        [
+            "env.id=discrete_dummy",
+            "env.screen_size=32",
+            "env.grayscale=True",
+            "cnn_keys.encoder=[rgb]",
+            "mlp_keys.encoder=[]",
+        ]
+    )
+    obs, _ = make_env(cfg, seed=0, rank=0)().reset()
+    assert obs["rgb"].shape == (1, 32, 32)
+
+
+def test_make_env_frame_stack():
+    cfg = _env_cfg(
+        [
+            "env.id=discrete_dummy",
+            "env.frame_stack=4",
+            "cnn_keys.encoder=[rgb]",
+            "mlp_keys.encoder=[]",
+        ]
+    )
+    obs, _ = make_env(cfg, seed=0, rank=0)().reset()
+    assert obs["rgb"].shape == (4, 3, 64, 64)
+
+
+def test_make_env_vector_obs_dictified():
+    cfg = compose("config", ["exp=ppo", "env.id=CartPole-v1", "env.capture_video=False"])
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert set(obs.keys()) == {"state"}
+    assert obs["state"].shape == (4,)
+
+
+def test_make_env_time_limit_and_stats():
+    cfg = _env_cfg(["env.id=continuous_dummy", "env.max_episode_steps=7", "cnn_keys.encoder=[rgb]"])
+    env = make_env(cfg, seed=0, rank=0)()
+    env.reset()
+    for i in range(7):
+        obs, reward, done, truncated, info = env.step(env.action_space.sample())
+    assert truncated and "episode" in info
+
+
+def test_dummy_env_action_spaces():
+    assert isinstance(ContinuousDummyEnv().action_space, gym.spaces.Box)
+    assert isinstance(DiscreteDummyEnv().action_space, gym.spaces.Discrete)
+    assert isinstance(MultiDiscreteDummyEnv().action_space, gym.spaces.MultiDiscrete)

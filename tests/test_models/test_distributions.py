@@ -24,8 +24,8 @@ def test_normal_log_prob_matches_scipy():
 
     d = Normal(jnp.array(0.3), jnp.array(1.7))
     x = jnp.array(0.9)
-    np.testing.assert_allclose(d.log_prob(x), norm.logpdf(0.9, 0.3, 1.7), rtol=1e-5)
-    np.testing.assert_allclose(d.entropy(), norm.entropy(0.3, 1.7), rtol=1e-5)
+    np.testing.assert_allclose(d.log_prob(x), norm.logpdf(0.9, 0.3, 1.7), rtol=1e-4)
+    np.testing.assert_allclose(d.entropy(), norm.entropy(0.3, 1.7), rtol=1e-4)
 
 
 def test_independent_sums():
