@@ -1,0 +1,55 @@
+"""Benchmark entry: PPO CartPole throughput vs the reference baseline.
+
+Matches the reference's own PPO benchmark protocol (`README.md:92-104` /
+`benchmarks/benchmark.py:10-41`): 64 envs × 1024 rollout-collection steps
+(65536 policy steps) with test/logging/checkpointing disabled, wall-clock
+timed around `cli.run`. Reference baseline: 80.81 s for sheeprl v0.5.2
+(numpy buffers) on 4 CPUs (`BASELINE.md`).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_SECONDS = 80.81  # reference README.md:92-104, PPO 1 device
+
+
+def main() -> None:
+    from sheeprl_tpu import cli
+
+    args = [
+        "exp=ppo",
+        "env=gym",
+        "env.id=CartPole-v1",
+        "env.num_envs=64",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "total_steps=65536",
+        "algo.rollout_steps=128",
+        "per_rank_batch_size=64",
+        "checkpoint.every=1000000000",
+        "checkpoint.save_last=False",
+        "metric.log_level=0",
+        "buffer.memmap=False",
+        "exp_name=bench_ppo",
+    ]
+    start = time.perf_counter()
+    cli.run(args)
+    elapsed = time.perf_counter() - start
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_cartpole_65536_steps",
+                "value": round(elapsed, 2),
+                "unit": "s",
+                "vs_baseline": round(BASELINE_SECONDS / elapsed, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

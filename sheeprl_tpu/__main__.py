@@ -1,0 +1,6 @@
+"""``python -m sheeprl_tpu`` → the train CLI (reference ``sheeprl.py`` shim)."""
+
+from sheeprl_tpu.cli import run
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,218 @@
+"""PPO agent: flax module + pure policy-head functions.
+
+Behavioral contract from the reference ``sheeprl/algos/ppo/agent.py``
+(CNNEncoder :14-30, MLPEncoder :33-59, PPOAgent :62-197): a MultiEncoder
+feature trunk shared by an actor backbone with one linear head per discrete
+sub-action (or a single mean/log_std head for continuous spaces) and an MLP
+critic.
+
+TPU-native differences: the module is a pure function of ``(params, obs)``;
+sampling / log-prob / entropy live in jit-friendly helper functions that take
+the head outputs (``pre_dist``) so the rollout step, the train step, and the
+greedy test path each jit exactly the math they need. Actions are exchanged as
+one concatenated array (one-hot per discrete sub-action, raw floats for
+continuous), matching the reference's buffer layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models.models import MLP, NatureCNN
+
+
+class PPOAgent(nn.Module):
+    """Actor-critic over dict observations."""
+
+    actions_dim: Tuple[int, ...]
+    is_continuous: bool
+    cnn_keys: Tuple[str, ...]
+    mlp_keys: Tuple[str, ...]
+    screen_size: int
+    cnn_features_dim: int = 512
+    mlp_features_dim: int = 64
+    encoder_dense_units: int = 64
+    encoder_mlp_layers: int = 2
+    encoder_dense_act: str = "relu"
+    encoder_layer_norm: bool = False
+    actor_dense_units: int = 64
+    actor_mlp_layers: int = 2
+    actor_dense_act: str = "tanh"
+    actor_layer_norm: bool = False
+    critic_dense_units: int = 64
+    critic_mlp_layers: int = 2
+    critic_dense_act: str = "tanh"
+    critic_layer_norm: bool = False
+
+    def setup(self) -> None:
+        if self.cnn_keys:
+            self.cnn_encoder = NatureCNN(
+                features_dim=self.cnn_features_dim, screen_size=self.screen_size
+            )
+        if self.mlp_keys:
+            self.mlp_encoder = MLP(
+                hidden_sizes=(self.encoder_dense_units,) * self.encoder_mlp_layers,
+                output_dim=self.mlp_features_dim,
+                activation=self.encoder_dense_act,
+                layer_norm=self.encoder_layer_norm,
+            )
+        self.critic = MLP(
+            hidden_sizes=(self.critic_dense_units,) * self.critic_mlp_layers,
+            output_dim=1,
+            activation=self.critic_dense_act,
+            layer_norm=self.critic_layer_norm,
+        )
+        self.actor_backbone = MLP(
+            hidden_sizes=(self.actor_dense_units,) * self.actor_mlp_layers,
+            output_dim=None,
+            activation=self.actor_dense_act,
+            layer_norm=self.actor_layer_norm,
+        )
+        if self.is_continuous:
+            # single head emitting (mean, log_std) for all continuous dims
+            self.actor_heads = [nn.Dense(int(sum(self.actions_dim)) * 2)]
+        else:
+            self.actor_heads = [nn.Dense(int(d)) for d in self.actions_dim]
+
+    def features(self, obs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        feats = []
+        if self.cnn_keys:
+            x = jnp.concatenate([obs[k] for k in self.cnn_keys], axis=-3)
+            feats.append(self.cnn_encoder(x))
+        if self.mlp_keys:
+            x = jnp.concatenate([obs[k] for k in self.mlp_keys], axis=-1)
+            feats.append(self.mlp_encoder(x))
+        return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
+
+    def pre_dist(self, obs: Dict[str, jnp.ndarray]) -> List[jnp.ndarray]:
+        out = self.actor_backbone(self.features(obs))
+        return [head(out) for head in self.actor_heads]
+
+    def __call__(self, obs: Dict[str, jnp.ndarray]) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+        feat = self.features(obs)
+        out = self.actor_backbone(feat)
+        pre_dist = [head(out) for head in self.actor_heads]
+        values = self.critic(feat)
+        return pre_dist, values
+
+    def get_value(self, obs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        return self.critic(self.features(obs))
+
+
+# ---------------------------------------------------------------------------
+# pure policy-head math (reference PPOAgent.forward :136-180, jit-friendly)
+# ---------------------------------------------------------------------------
+
+
+def _split_logits(pre_dist: Sequence[jnp.ndarray]) -> List[jnp.ndarray]:
+    return [jax.nn.log_softmax(logits, axis=-1) for logits in pre_dist]
+
+
+def sample_actions(
+    pre_dist: Sequence[jnp.ndarray],
+    is_continuous: bool,
+    key: jax.Array,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sample → ``(stored_actions, real_actions, logprob[..., 1])``.
+
+    ``stored_actions`` is what goes in the buffer (one-hot concat / floats);
+    ``real_actions`` is what the env expects (indices / floats).
+    """
+    if is_continuous:
+        mean, log_std = jnp.split(pre_dist[0], 2, axis=-1)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape, dtype=mean.dtype)
+        actions = mean + std * eps
+        logprob = _normal_log_prob(actions, mean, std).sum(axis=-1, keepdims=True)
+        return actions, actions, logprob
+    log_probs = _split_logits(pre_dist)
+    onehots, idxs, lps = [], [], []
+    for i, lp in enumerate(log_probs):
+        sub_key = jax.random.fold_in(key, i)
+        idx = jax.random.categorical(sub_key, lp, axis=-1)
+        onehot = jax.nn.one_hot(idx, lp.shape[-1], dtype=lp.dtype)
+        onehots.append(onehot)
+        idxs.append(idx[..., None])
+        lps.append(jnp.take_along_axis(lp, idx[..., None], axis=-1))
+    actions = jnp.concatenate(onehots, axis=-1)
+    real_actions = jnp.concatenate(idxs, axis=-1)
+    logprob = jnp.concatenate(lps, axis=-1).sum(axis=-1, keepdims=True)
+    return actions, real_actions, logprob
+
+
+def evaluate_actions(
+    pre_dist: Sequence[jnp.ndarray],
+    actions: jnp.ndarray,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Log-prob and entropy of stored actions → ``(logprob[...,1], entropy[...,1])``."""
+    if is_continuous:
+        mean, log_std = jnp.split(pre_dist[0], 2, axis=-1)
+        std = jnp.exp(log_std)
+        logprob = _normal_log_prob(actions, mean, std).sum(axis=-1, keepdims=True)
+        entropy = (0.5 + 0.5 * jnp.log(2 * jnp.pi) + log_std).sum(axis=-1, keepdims=True)
+        return logprob, entropy
+    log_probs = _split_logits(pre_dist)
+    splits = np.cumsum(np.asarray(actions_dim))[:-1]
+    sub_actions = jnp.split(actions, splits, axis=-1)
+    lps, ents = [], []
+    for lp, act in zip(log_probs, sub_actions):
+        lps.append(jnp.sum(act * lp, axis=-1, keepdims=True))
+        probs = jnp.exp(lp)
+        ents.append(-jnp.sum(probs * lp, axis=-1, keepdims=True))
+    logprob = jnp.concatenate(lps, axis=-1).sum(axis=-1, keepdims=True)
+    entropy = jnp.concatenate(ents, axis=-1).sum(axis=-1, keepdims=True)
+    return logprob, entropy
+
+
+def greedy_actions(
+    pre_dist: Sequence[jnp.ndarray], is_continuous: bool
+) -> jnp.ndarray:
+    """Mode actions in env format (reference get_greedy_actions :185-197)."""
+    if is_continuous:
+        mean, _ = jnp.split(pre_dist[0], 2, axis=-1)
+        return mean
+    return jnp.concatenate([jnp.argmax(l, axis=-1)[..., None] for l in pre_dist], axis=-1)
+
+
+def _normal_log_prob(x: jnp.ndarray, mean: jnp.ndarray, std: jnp.ndarray) -> jnp.ndarray:
+    var = std**2
+    return -((x - mean) ** 2) / (2 * var) - jnp.log(std) - 0.5 * jnp.log(2 * jnp.pi)
+
+
+def build_agent(
+    cfg,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cnn_keys: Sequence[str],
+    mlp_keys: Sequence[str],
+) -> PPOAgent:
+    """Construct the agent from the composed config (reference build at ppo.py:178-190)."""
+    enc, act, crit = cfg.algo.encoder, cfg.algo.actor, cfg.algo.critic
+    return PPOAgent(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        screen_size=cfg.env.screen_size,
+        cnn_features_dim=enc.cnn_features_dim,
+        mlp_features_dim=enc.mlp_features_dim,
+        encoder_dense_units=enc.dense_units,
+        encoder_mlp_layers=enc.mlp_layers,
+        encoder_dense_act=enc.dense_act,
+        encoder_layer_norm=enc.layer_norm,
+        actor_dense_units=act.dense_units,
+        actor_mlp_layers=act.mlp_layers,
+        actor_dense_act=act.dense_act,
+        actor_layer_norm=act.layer_norm,
+        critic_dense_units=crit.dense_units,
+        critic_mlp_layers=crit.mlp_layers,
+        critic_dense_act=crit.dense_act,
+        critic_layer_norm=crit.layer_norm,
+    )

@@ -1,0 +1,57 @@
+"""PPO evaluation entrypoint (reference ``sheeprl/algos/ppo/evaluate.py:15-66``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import build_agent
+from sheeprl_tpu.algos.ppo.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["ppo"])
+def evaluate_ppo(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger, log_dir = create_tensorboard_logger(cfg)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.cnn_keys.encoder) + len(cfg.mlp_keys.encoder) == 0:
+        raise RuntimeError(
+            "You should specify at least one CNN keys or MLP keys from the cli: "
+            "`cnn_keys.encoder=[rgb]` or `mlp_keys.encoder=[state]`"
+        )
+    fabric.print("Encoder CNN keys:", cfg.cnn_keys.encoder)
+    fabric.print("Encoder MLP keys:", cfg.mlp_keys.encoder)
+
+    is_continuous = isinstance(env.action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(env.action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        env.action_space.shape
+        if is_continuous
+        else (env.action_space.nvec.tolist() if is_multidiscrete else [env.action_space.n])
+    )
+    env.close()
+
+    agent = build_agent(
+        cfg, actions_dim, is_continuous, cfg.cnn_keys.encoder, cfg.mlp_keys.encoder
+    )
+    params = jax.tree_util.tree_map(np.asarray, state["params"])
+    test(agent, params, fabric, cfg, log_dir)
+
+
+# Same model as coupled PPO — the checkpoint layout is identical.
+@register_evaluation(algorithms=["ppo_decoupled"])
+def evaluate_ppo_decoupled(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    evaluate_ppo(fabric, cfg, state)

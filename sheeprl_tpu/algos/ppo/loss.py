@@ -1,0 +1,50 @@
+"""PPO losses (reference ``sheeprl/algos/ppo/loss.py:6-72``), pure jnp."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _reduce(x: jnp.ndarray, reduction: str) -> jnp.ndarray:
+    reduction = reduction.lower()
+    if reduction == "none":
+        return x
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    raise ValueError(f"Unrecognized reduction: {reduction}")
+
+
+def policy_loss(
+    new_logprobs: jnp.ndarray,
+    logprobs: jnp.ndarray,
+    advantages: jnp.ndarray,
+    clip_coef: jnp.ndarray,
+    reduction: str = "mean",
+) -> jnp.ndarray:
+    """Clipped surrogate objective, equation (7) of the PPO paper."""
+    logratio = new_logprobs - logprobs
+    ratio = jnp.exp(logratio)
+    pg_loss1 = advantages * ratio
+    pg_loss2 = advantages * jnp.clip(ratio, 1.0 - clip_coef, 1.0 + clip_coef)
+    return _reduce(-jnp.minimum(pg_loss1, pg_loss2), reduction)
+
+
+def value_loss(
+    new_values: jnp.ndarray,
+    old_values: jnp.ndarray,
+    returns: jnp.ndarray,
+    clip_coef: jnp.ndarray,
+    clip_vloss: bool,
+    reduction: str = "mean",
+) -> jnp.ndarray:
+    if not clip_vloss:
+        values_pred = new_values
+    else:
+        values_pred = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
+    return _reduce((values_pred - returns) ** 2, reduction)
+
+
+def entropy_loss(entropy: jnp.ndarray, reduction: str = "mean") -> jnp.ndarray:
+    return _reduce(-entropy, reduction)

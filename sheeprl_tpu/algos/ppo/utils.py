@@ -1,0 +1,78 @@
+"""PPO helpers: aggregator keys, obs staging, greedy test rollout.
+
+Reference: ``sheeprl/algos/ppo/utils.py`` (AGGREGATOR_KEYS :9, test :12-56).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+
+
+def normalize_obs(
+    obs: Dict[str, jnp.ndarray], cnn_keys: Sequence[str], obs_keys: Sequence[str]
+) -> Dict[str, jnp.ndarray]:
+    """uint8 pixels → centered floats; vectors pass through (reference ppo.py:60-64)."""
+    return {
+        k: (obs[k].astype(jnp.float32) / 255.0 - 0.5) if k in cnn_keys else obs[k].astype(jnp.float32)
+        for k in obs_keys
+    }
+
+
+def prepare_obs(obs: Dict[str, np.ndarray], cnn_keys: Sequence[str], num_envs: int = 1) -> Dict[str, np.ndarray]:
+    """Host-side staging of a raw env observation batch: flatten any frame-stack
+    dim into channels for cnn keys, float32 for mlp keys (reference ppo.py:263-268)."""
+    out = {}
+    for k, v in obs.items():
+        v = np.asarray(v)
+        if k in cnn_keys:
+            out[k] = v.reshape(num_envs, -1, *v.shape[-2:])
+        else:
+            out[k] = v.reshape(num_envs, -1).astype(np.float32)
+    return out
+
+
+def test(agent, params, fabric, cfg, log_dir: str) -> None:
+    """Greedy single-env evaluation episode (reference utils.py:12-56)."""
+    from sheeprl_tpu.algos.ppo.agent import greedy_actions
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    obs_keys = list(cfg.mlp_keys.encoder) + list(cfg.cnn_keys.encoder)
+    cnn_keys = list(cfg.cnn_keys.encoder)
+
+    @jax.jit
+    def act(params, obs):
+        norm = normalize_obs(obs, cnn_keys, obs_keys)
+        pre_dist = agent.apply({"params": params}, norm, method=agent.pre_dist)
+        return greedy_actions(pre_dist, agent.is_continuous)
+
+    done = False
+    cumulative_rew = 0.0
+    o = env.reset(seed=cfg.seed)[0]
+    while not done:
+        obs = {k: v for k, v in prepare_obs(o, cnn_keys, 1).items() if k in obs_keys}
+        real_actions = np.asarray(act(params, obs))
+        o, reward, terminated, truncated, _ = env.step(
+            real_actions.reshape(env.action_space.shape)
+        )
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    fabric.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

@@ -1,0 +1,214 @@
+"""Command-line entry points.
+
+Re-implementation of the reference ``sheeprl/cli.py`` (run :265-273,
+run_algorithm :48-156, eval_algorithm :159-198, check_configs :201-257,
+resume_from_checkpoint :22-45) on the mini-hydra config engine and the mesh
+:class:`~sheeprl_tpu.fabric.Fabric`. One process drives every local device
+(SPMD), so ``fabric.launch`` validates topology instead of spawning ranks.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+import warnings
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import sheeprl_tpu
+from sheeprl_tpu.config.engine import compose, to_yaml
+from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.registry import (
+    algorithm_registry,
+    evaluation_registry,
+    find_algorithm,
+    find_evaluation,
+    registered_algorithm_names,
+)
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import dotdict, print_config
+
+
+def _load_run_config(ckpt_path: str):
+    """Read the persisted ``.hydra/config.yaml`` of the run that produced a
+    checkpoint (checkpoints live at ``<log_dir>/checkpoint/ckpt_*``).
+    Returns ``(cfg, log_dir)``."""
+    import yaml
+
+    log_dir = os.path.dirname(os.path.dirname(os.path.abspath(ckpt_path)))
+    cfg_path = os.path.join(log_dir, ".hydra", "config.yaml")
+    if not os.path.isfile(cfg_path):
+        raise RuntimeError(
+            f"Cannot use checkpoint {ckpt_path}: missing persisted config at {cfg_path}"
+        )
+    with open(cfg_path) as f:
+        return dotdict(yaml.safe_load(f)), log_dir
+
+
+def resume_from_checkpoint(cfg) -> Any:
+    """Merge the checkpoint run's persisted config into the current one
+    (reference cli.py:22-45): the old config wins except for runtime keys."""
+    ckpt_path = cfg.checkpoint.resume_from
+    old_cfg, _ = _load_run_config(ckpt_path)
+    if old_cfg.env.id != cfg.env.id:
+        raise ValueError(
+            f"This experiment is run with a different environment from the one of the "
+            f"checkpoint: got {cfg.env.id}, the checkpoint was trained on {old_cfg.env.id}"
+        )
+    if old_cfg.algo.name != cfg.algo.name:
+        raise ValueError(
+            f"This experiment is run with a different algorithm from the one of the "
+            f"checkpoint: got {cfg.algo.name}, the checkpoint was trained with {old_cfg.algo.name}"
+        )
+    # keep the old experiment config, but let the new run control runtime keys
+    old_cfg.checkpoint.resume_from = ckpt_path
+    old_cfg.root_dir = cfg.root_dir
+    old_cfg.run_name = cfg.run_name
+    old_cfg.fabric = cfg.fabric
+    return old_cfg
+
+
+def check_configs(cfg) -> None:
+    """Strategy validation (reference cli.py:201-257)."""
+    algo_name = cfg.algo.name
+    entry = find_algorithm(algo_name)
+    if entry is None:
+        raise RuntimeError(
+            f"Given the algorithm named '{algo_name}', no algorithm has been found to be imported. "
+            f"Available algorithms: {registered_algorithm_names()}"
+        )
+    strategy = str(cfg.fabric.get("strategy", "auto"))
+    if entry["decoupled"]:
+        devices = cfg.fabric.get("devices", 1)
+        if devices not in ("auto", -1) and int(devices) < 2:
+            raise RuntimeError(
+                f"The decoupled version of {algo_name} algorithm requires at least 2 devices: "
+                "one player and at least one trainer. "
+                f"Please set `fabric.devices` to at least 2, got {devices}"
+            )
+    elif strategy not in ("auto", "ddp", "dp"):
+        warnings.warn(
+            f"Running an algorithm with a strategy ('{strategy}') "
+            "different than 'auto'/'ddp': on TPU every strategy maps to SPMD "
+            "data-parallel over the mesh",
+            UserWarning,
+        )
+    if cfg.metric.get("log_level", 1) > 0 and len(cfg.metric.get("aggregator", {}).get("metrics", {})) == 0:
+        warnings.warn(
+            "No metrics defined in metric.aggregator.metrics: nothing will be aggregated",
+            UserWarning,
+        )
+
+
+def _prune_metric_keys(cfg, algo_module: str) -> None:
+    """Drop aggregator keys the algorithm never updates (reference cli.py:141-155)."""
+    try:
+        utils_module = importlib.import_module(f"{algo_module.rsplit('.', 1)[0]}.utils")
+        keys = getattr(utils_module, "AGGREGATOR_KEYS", None)
+    except ModuleNotFoundError:
+        keys = None
+    if keys is None:
+        return
+    metrics_cfg = cfg.metric.get("aggregator", {}).get("metrics", {})
+    for name in list(metrics_cfg.keys()):
+        if name not in keys:
+            metrics_cfg.pop(name)
+
+
+def run_algorithm(cfg) -> None:
+    """Registry lookup → Fabric → entrypoint (reference cli.py:48-156)."""
+    entry = find_algorithm(cfg.algo.name)
+    if entry is None:
+        raise RuntimeError(
+            f"Given the algorithm named '{cfg.algo.name}', no algorithm has been found to be imported. "
+            f"Available algorithms: {registered_algorithm_names()}"
+        )
+    module = importlib.import_module(entry["module"])
+    entrypoint = getattr(module, entry["entrypoint"])
+
+    fabric = instantiate(cfg.fabric)
+
+    # Observability gates (reference cli.py:141-155)
+    _prune_metric_keys(cfg, entry["module"])
+    MetricAggregator.disabled = cfg.metric.log_level == 0 or len(
+        cfg.metric.get("aggregator", {}).get("metrics", {})
+    ) == 0
+    timer.disabled = cfg.metric.log_level == 0 or cfg.metric.get("disable_timer", False)
+
+    fabric.launch(entrypoint, cfg)
+
+
+def eval_algorithm(cfg) -> None:
+    """Load checkpoint state and dispatch the evaluation fn (cli.py:159-198)."""
+    entry = find_evaluation(cfg.algo.name)
+    if entry is None:
+        raise RuntimeError(
+            f"Given the algorithm named '{cfg.algo.name}', no evaluation function has been found"
+        )
+    module = importlib.import_module(entry["module"])
+    entrypoint = getattr(module, entry["entrypoint"])
+
+    cfg.fabric.devices = 1
+    fabric = instantiate(cfg.fabric)
+    state = fabric.load(cfg.checkpoint_path)
+    fabric.launch(entrypoint, cfg, state)
+
+
+def _compose_from_argv(args: Optional[Sequence[str]], **kwargs) -> Any:
+    overrides = list(args) if args is not None else sys.argv[1:]
+    return compose("config", overrides=overrides, **kwargs)
+
+
+def run(args: Optional[Sequence[str]] = None) -> None:
+    """Train entrypoint (reference cli.py:265-273)."""
+    sheeprl_tpu.register_algorithms()
+    cfg = _compose_from_argv(args)
+    if cfg.metric.log_level > 0:
+        print_config(cfg)
+    if cfg.checkpoint.resume_from:
+        cfg = resume_from_checkpoint(cfg)
+    check_configs(cfg)
+    run_algorithm(cfg)
+
+
+def evaluation(args: Optional[Sequence[str]] = None) -> None:
+    """Eval entrypoint (reference cli.py:276-312): re-reads the run's persisted
+    config, forces a single-device single-env setup, and keeps the seed."""
+    sheeprl_tpu.register_algorithms()
+    overrides = list(args) if args is not None else sys.argv[1:]
+    # the eval CLI takes checkpoint_path=... plus optional fabric overrides
+    eval_cfg = compose(
+        "eval_config",
+        overrides=overrides,
+        allow_missing=("checkpoint_path",),
+    )
+    ckpt_path = eval_cfg.get("checkpoint_path")
+    if not ckpt_path:
+        raise ValueError("You must specify the checkpoint path: checkpoint_path=/path/to/ckpt")
+    cfg, log_dir = _load_run_config(ckpt_path)
+
+    cfg.run_name = os.path.join(
+        os.path.basename(log_dir), f"evaluation_{np.random.randint(0, 2**16)}"
+    )
+    cfg.env.num_envs = 1
+    cfg.env.capture_video = bool(eval_cfg.get("env", {}).get("capture_video", cfg.env.capture_video))
+    cfg.fabric = dotdict(
+        {
+            "_target_": "sheeprl_tpu.fabric.Fabric",
+            "devices": 1,
+            "num_nodes": 1,
+            "strategy": "auto",
+            "accelerator": eval_cfg.get("fabric", {}).get("accelerator", "auto"),
+            "precision": eval_cfg.get("fabric", {}).get("precision", "32-true"),
+            "callbacks": [],
+        }
+    )
+    cfg.checkpoint_path = ckpt_path
+    eval_algorithm(cfg)
+
+
+if __name__ == "__main__":
+    run()

@@ -84,14 +84,11 @@ def get_lr(opt_state) -> float:
 
 def set_lr(opt_state, lr):
     """Functionally set the injected learning rate (returns a new state)."""
-    import jax
-
     if hasattr(opt_state, "hyperparams"):
         hp = dict(opt_state.hyperparams)
         hp["learning_rate"] = lr
         return opt_state._replace(hyperparams=hp)
     if isinstance(opt_state, tuple):
-        return type(opt_state)(
-            *[set_lr(p, lr) if hasattr(p, "hyperparams") else p for p in opt_state]
-        )
+        # chained transforms: a plain tuple of per-transform states
+        return tuple(set_lr(p, lr) if hasattr(p, "hyperparams") else p for p in opt_state)
     raise ValueError("Optimizer state carries no injected learning rate")

@@ -16,6 +16,18 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The sitecustomize in this image may have imported jax's config with the
+# container's JAX_PLATFORMS before conftest ran; pin the platform again
+# post-import so tests never try to initialize a hardware backend.
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the e2e algo tests jit several programs each;
+# caching compilations to disk makes repeated suite runs fast.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_pytest_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest  # noqa: E402
 
 
