@@ -1,0 +1,69 @@
+"""DroQ agent: dropout+LayerNorm Q ensemble (https://arxiv.org/abs/2110.02034).
+
+Behavioral contract from the reference ``sheeprl/algos/droq/agent.py``
+(DROQCritic :16-57: two-layer MLP with Dropout and LayerNorm on every hidden
+layer; DROQAgent :60-210 reuses the SAC actor/alpha machinery, adds
+``get_ith_q_value`` and per-critic target EMA).
+
+TPU-native: the ensemble is stacked params under ``jax.vmap`` with one
+dropout PRNG key per member, so all N dropout-perturbed Q evaluations run as
+one batched program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.models.models import MLP
+
+
+class DROQCritic(nn.Module):
+    """Q(s, a) with Dropout + LayerNorm hidden layers (reference :16-57)."""
+
+    hidden_size: int = 256
+    num_critics: int = 1
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray, action: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        x = jnp.concatenate([obs, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=self.num_critics,
+            activation="relu",
+            layer_norm=True,
+            dropout=self.dropout,
+        )(x, deterministic=deterministic)
+
+
+def init_droq_ensemble(critic: DROQCritic, key: jax.Array, n: int, obs_dim: int, act_dim: int) -> Any:
+    dummy_obs = jnp.zeros((1, obs_dim), jnp.float32)
+    dummy_act = jnp.zeros((1, act_dim), jnp.float32)
+    keys = jax.random.split(key, n)
+    return jax.vmap(lambda k: critic.init(k, dummy_obs, dummy_act)["params"])(keys)
+
+
+def droq_ensemble_q(
+    critic: DROQCritic,
+    stacked_params: Any,
+    obs: jnp.ndarray,
+    action: jnp.ndarray,
+    dropout_key: jax.Array = None,
+) -> jnp.ndarray:
+    """Ensemble Q → ``[batch, n]``; with a key, dropout is active and every
+    member draws its own mask (the DroQ training regime)."""
+    n = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if dropout_key is None:
+        q = jax.vmap(lambda p: critic.apply({"params": p}, obs, action))(stacked_params)
+    else:
+        keys = jax.random.split(dropout_key, n)
+        q = jax.vmap(
+            lambda p, k: critic.apply(
+                {"params": p}, obs, action, deterministic=False, rngs={"dropout": k}
+            )
+        )(stacked_params, keys)
+    return jnp.moveaxis(q[..., 0], 0, -1)

@@ -1,0 +1,414 @@
+"""DroQ — SAC with a dropout-regularized Q ensemble and high replay ratio.
+
+Behavioral contract from the reference ``sheeprl/algos/droq/droq.py``
+(train :33-128, main :131-409): per update, ``per_rank_gradient_steps`` (20)
+critic batches each update every ensemble member against a freshly sampled
+dropout-perturbed TD target with a target-EMA after each member's step; the
+actor and alpha update once per update from a *separate* batch, the actor
+against the ensemble **mean** Q (reference :112 — not the min).
+
+TPU-native notes (one jitted shard_map program per update, as in SAC):
+
+- The reference steps each ensemble member with its own backward/step inside a
+  Python loop (sharing one Adam across members, so each step also nudges the
+  other members through stale momenta — an implementation quirk, not DroQ
+  Algorithm 2). Here every member computes its loss with an independent
+  dropout mask and the summed loss updates all members jointly; the target
+  EMA runs once per gradient step, giving each member the same EMA cadence
+  as the reference.
+- Dropout keys thread through ``lax.scan`` so every gradient step and every
+  member uses fresh masks, exactly one compiled program.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.algos.droq.agent import DROQCritic, droq_ensemble_q, init_droq_ensemble
+from sheeprl_tpu.algos.sac.agent import SACActor, action_bounds, squash_sample
+from sheeprl_tpu.algos.sac.loss import entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac.utils import concat_obs, test
+from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import save_configs
+
+
+def build_train_fn(
+    actor: SACActor,
+    critic: DROQCritic,
+    actor_tx,
+    qf_tx,
+    alpha_tx,
+    cfg,
+    fabric,
+    action_scale: np.ndarray,
+    action_bias: np.ndarray,
+    target_entropy: float,
+):
+    """G dropout-critic steps + one actor/alpha step, compiled as one SPMD
+    program. ``critic_batch`` leaves are ``[G, B_local, ...]``;
+    ``actor_batch`` leaves are ``[B_local, ...]``."""
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    n_critics = int(cfg.algo.critic.n)
+    axis = fabric.data_axis
+    scale = jnp.asarray(action_scale)
+    bias = jnp.asarray(action_bias)
+    tgt_entropy = jnp.float32(target_entropy)
+
+    def critic_step(carry, batch_and_key):
+        state, qf_opt = carry
+        batch, key = batch_and_key
+        next_key, tgt_key, drop_key = jax.random.split(key, 3)
+
+        alpha = jax.lax.stop_gradient(jnp.exp(state["log_alpha"]))
+        next_mean, next_std = actor.apply({"params": state["actor"]}, batch["next_observations"])
+        next_actions, next_logprob = squash_sample(next_mean, next_std, next_key, scale, bias)
+        target_q = droq_ensemble_q(
+            critic, state["target_critics"], batch["next_observations"], next_actions, tgt_key
+        )
+        min_target = jnp.min(target_q, axis=-1, keepdims=True) - alpha * next_logprob
+        td_target = jax.lax.stop_gradient(
+            batch["rewards"] + (1.0 - batch["dones"]) * gamma * min_target
+        )
+
+        def qf_loss_fn(critic_params):
+            q = droq_ensemble_q(critic, critic_params, batch["observations"], batch["actions"], drop_key)
+            # per-member MSE against the shared target (Algorithm 2, line 8)
+            return sum(((q[..., i : i + 1] - td_target) ** 2).mean() for i in range(n_critics))
+
+        qf_loss, qf_grads = jax.value_and_grad(qf_loss_fn)(state["critics"])
+        qf_grads = jax.lax.pmean(qf_grads, axis)
+        qf_updates, qf_opt = qf_tx.update(qf_grads, qf_opt, state["critics"])
+        critics = optax.apply_updates(state["critics"], qf_updates)
+        targets = jax.tree_util.tree_map(
+            lambda p, t: tau * p + (1.0 - tau) * t, critics, state["target_critics"]
+        )
+        state = {**state, "critics": critics, "target_critics": targets}
+        return (state, qf_opt), qf_loss
+
+    def local_train(state, opt_states, critic_batch, actor_batch, key):
+        g = jax.tree_util.tree_leaves(critic_batch)[0].shape[0]
+        keys = jax.random.split(key, g + 2)
+        (state, qf_opt), qf_losses = jax.lax.scan(
+            critic_step, (state, opt_states["qf"]), (critic_batch, keys[:g])
+        )
+
+        # ---- actor update from the separate batch, mean over the ensemble
+        alpha = jax.lax.stop_gradient(jnp.exp(state["log_alpha"]))
+
+        def actor_loss_fn(actor_params):
+            mean, std = actor.apply({"params": actor_params}, actor_batch["observations"])
+            actions, logprob = squash_sample(mean, std, keys[g], scale, bias)
+            q = droq_ensemble_q(critic, state["critics"], actor_batch["observations"], actions, keys[g + 1])
+            mean_q = jnp.mean(q, axis=-1, keepdims=True)
+            return policy_loss(alpha, logprob, mean_q), logprob
+
+        (actor_loss, logprob), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+            state["actor"]
+        )
+        actor_grads = jax.lax.pmean(actor_grads, axis)
+        actor_updates, actor_opt = actor_tx.update(actor_grads, opt_states["actor"], state["actor"])
+        actor_params = optax.apply_updates(state["actor"], actor_updates)
+
+        def alpha_loss_fn(log_alpha):
+            return entropy_loss(log_alpha, jax.lax.stop_gradient(logprob), tgt_entropy)
+
+        alpha_loss, alpha_grad = jax.value_and_grad(alpha_loss_fn)(state["log_alpha"])
+        alpha_grad = jax.lax.pmean(alpha_grad, axis)
+        alpha_updates, alpha_opt = alpha_tx.update(alpha_grad, opt_states["alpha"], state["log_alpha"])
+        log_alpha = optax.apply_updates(state["log_alpha"], alpha_updates)
+
+        state = {**state, "actor": actor_params, "log_alpha": log_alpha}
+        opt_states = {"actor": actor_opt, "qf": qf_opt, "alpha": alpha_opt}
+        metrics = jax.lax.pmean(
+            jnp.stack([jnp.mean(qf_losses), actor_loss, alpha_loss]), axis
+        )
+        return state, opt_states, metrics
+
+    shmapped = jax.shard_map(
+        local_train,
+        mesh=fabric.mesh,
+        in_specs=(P(), P(), P(None, axis), P(axis), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any]):
+    if "minedojo" in (cfg.env.wrapper._target_ or "").lower():
+        raise ValueError("MineDojo is not currently supported by DroQ agent")
+
+    world_size = fabric.world_size
+    root_key = fabric.seed_everything(cfg.seed)
+
+    if len(cfg.cnn_keys.encoder) > 0:
+        warnings.warn("DroQ algorithm cannot allow to use images as observations, the CNN keys will be ignored")
+        cfg.cnn_keys.encoder = []
+
+    state = None
+    logger, log_dir = create_tensorboard_logger(cfg)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    n_envs = int(cfg.env.num_envs) * world_size
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+
+    vectorized_env = SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(cfg, cfg.seed + i, 0, log_dir if fabric.is_global_zero else None, "train", vector_env_idx=i)
+            for i in range(n_envs)
+        ],
+        autoreset_mode=AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the DroQ agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    for k in cfg.mlp_keys.encoder:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                "Only environments with vector-only observations are supported by the DroQ agent. "
+                f"Provided environment: {cfg.env.id}"
+            )
+    if cfg.metric.log_level > 0:
+        fabric.print("Encoder MLP keys:", cfg.mlp_keys.encoder)
+
+    act_dim = int(np.prod(action_space.shape))
+    obs_dim = int(sum(np.prod(observation_space[k].shape) for k in cfg.mlp_keys.encoder))
+    action_scale, action_bias = action_bounds(action_space)
+
+    actor = SACActor(action_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size)
+    critic = DROQCritic(
+        hidden_size=cfg.algo.critic.hidden_size, num_critics=1, dropout=cfg.algo.critic.dropout
+    )
+    target_entropy = -float(act_dim)
+
+    root_key, a_key, c_key = jax.random.split(root_key, 3)
+    actor_params = actor.init(a_key, jnp.zeros((1, obs_dim), jnp.float32))["params"]
+    critic_params = init_droq_ensemble(critic, c_key, int(cfg.algo.critic.n), obs_dim, act_dim)
+    agent_state = {
+        "actor": actor_params,
+        "critics": critic_params,
+        "target_critics": jax.tree_util.tree_map(jnp.copy, critic_params),
+        "log_alpha": jnp.log(jnp.asarray([cfg.algo.alpha.alpha], jnp.float32)),
+    }
+
+    qf_tx = instantiate(cfg.algo.critic.optimizer)
+    actor_tx = instantiate(cfg.algo.actor.optimizer)
+    alpha_tx = instantiate(cfg.algo.alpha.optimizer)
+    opt_states = {
+        "actor": actor_tx.init(agent_state["actor"]),
+        "qf": qf_tx.init(agent_state["critics"]),
+        "alpha": alpha_tx.init(agent_state["log_alpha"]),
+    }
+
+    if cfg.checkpoint.resume_from:
+        template = {
+            "agent": agent_state,
+            "opt_states": opt_states,
+            "update": 0,
+            "batch_size": 0,
+            "last_log": 0,
+            "last_checkpoint": 0,
+        }
+        state = fabric.load(cfg.checkpoint.resume_from, template)
+        agent_state = state["agent"]
+        opt_states = state["opt_states"]
+        cfg.per_rank_batch_size = int(np.asarray(state["batch_size"])) // world_size
+    agent_state = jax.device_put(agent_state, fabric.replicated)
+    opt_states = jax.device_put(opt_states, fabric.replicated)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        max(buffer_size, 1),
+        n_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
+        obs_keys=("observations",),
+    )
+
+    scale_j, bias_j = jnp.asarray(action_scale), jnp.asarray(action_bias)
+
+    @jax.jit
+    def policy_fn(actor_params, obs, key):
+        mean, std = actor.apply({"params": actor_params}, obs)
+        actions, _ = squash_sample(mean, std, key, scale_j, bias_j)
+        return actions
+
+    train_fn = build_train_fn(
+        actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric, action_scale, action_bias, target_entropy
+    )
+    critic_sharding = fabric.sharding(None, fabric.data_axis)
+    actor_sharding = fabric.data_sharding
+
+    last_train = 0
+    train_step = 0
+    start_step = int(np.asarray(state["update"])) // world_size if state is not None else 1
+    policy_step = int(np.asarray(state["update"])) * cfg.env.num_envs if state is not None else 0
+    last_log = int(np.asarray(state["last_log"])) if state is not None else 0
+    last_checkpoint = int(np.asarray(state["last_checkpoint"])) if state is not None else 0
+    policy_steps_per_update = int(n_envs)
+    num_updates = int(cfg.total_steps // policy_steps_per_update) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_update if not cfg.dry_run else 0
+    if cfg.checkpoint.resume_from and not cfg.buffer.get("checkpoint", False):
+        learning_starts += start_step
+
+    o = envs.reset(seed=cfg.seed)[0]
+    obs = concat_obs(o, cfg.mlp_keys.encoder, n_envs)
+    per_rank_gradient_steps = int(cfg.algo.per_rank_gradient_steps)
+
+    for update in range(start_step, num_updates + 1):
+        policy_step += n_envs
+
+        with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+            if update <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                root_key, act_key = jax.random.split(root_key)
+                actions = np.asarray(policy_fn(agent_state["actor"], obs, act_key))
+            next_o, rewards, terminated, truncated, infos = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated)
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            fi = infos["final_info"]
+            if isinstance(fi, dict) and "episode" in fi:
+                mask = np.asarray(fi.get("_episode", []), dtype=bool)
+                for i in np.nonzero(mask)[0]:
+                    ep_rew = float(fi["episode"]["r"][i])
+                    ep_len = float(fi["episode"]["l"][i])
+                    if aggregator and not aggregator.disabled:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                    fabric.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        next_obs = concat_obs(next_o, cfg.mlp_keys.encoder, n_envs)
+        real_next_obs = next_obs.copy()
+        if "final_obs" in infos:
+            for idx, final_obs in enumerate(infos["final_obs"]):
+                if final_obs is not None:
+                    real_next_obs[idx] = concat_obs(final_obs, cfg.mlp_keys.encoder, 1)[0]
+
+        step_data = {
+            "observations": obs[None],
+            "actions": np.asarray(actions, np.float32).reshape(1, n_envs, -1),
+            "rewards": np.asarray(rewards, np.float32).reshape(1, n_envs, 1),
+            "dones": np.asarray(dones, np.float32).reshape(1, n_envs, 1),
+        }
+        if not cfg.buffer.sample_next_obs:
+            step_data["next_observations"] = real_next_obs[None]
+        rb.add(step_data)
+        obs = next_obs
+
+        if update > learning_starts:
+            critic_sample = rb.sample(
+                per_rank_gradient_steps * cfg.per_rank_batch_size * world_size,
+                sample_next_obs=cfg.buffer.sample_next_obs,
+            )
+            critic_batch = {
+                k: np.reshape(
+                    v, (per_rank_gradient_steps, world_size * cfg.per_rank_batch_size) + v.shape[2:]
+                )
+                for k, v in critic_sample.items()
+            }
+            actor_sample = rb.sample(cfg.per_rank_batch_size * world_size)
+            actor_batch = {k: v[0] for k, v in actor_sample.items()}
+            critic_batch = jax.device_put(critic_batch, critic_sharding)
+            actor_batch = jax.device_put(actor_batch, actor_sharding)
+
+            with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+                root_key, train_key = jax.random.split(root_key)
+                agent_state, opt_states, losses = train_fn(
+                    agent_state, opt_states, critic_batch, actor_batch, train_key
+                )
+                losses = np.asarray(losses)
+            train_step += world_size
+
+            if aggregator and not aggregator.disabled:
+                aggregator.update("Loss/value_loss", losses[0])
+                aggregator.update("Loss/policy_loss", losses[1])
+                aggregator.update("Loss/alpha_loss", losses[2])
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or update == num_updates
+        ):
+            if aggregator and not aggregator.disabled:
+                metrics_dict = aggregator.compute()
+                if logger is not None:
+                    logger.log_metrics(metrics_dict, policy_step)
+                aggregator.reset()
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if logger is not None:
+                    if timer_metrics.get("Time/train_time"):
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time"):
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            update == num_updates and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": jax.device_get(agent_state),
+                "opt_states": jax.device_get(opt_states),
+                "update": update * world_size,
+                "batch_size": cfg.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{fabric.global_rank}")
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
+            )
+
+    envs.close()
+    if fabric.is_global_zero:
+        test(actor, agent_state["actor"], scale_j, bias_j, fabric, cfg, log_dir)

@@ -1,0 +1,14 @@
+"""DroQ evaluation entrypoint (reference ``sheeprl/algos/droq/evaluate.py``):
+the actor is a plain SAC actor, so evaluation is SAC's greedy test."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.sac.evaluate import evaluate_sac
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["droq"])
+def evaluate_droq(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    evaluate_sac(fabric, cfg, state)

@@ -1,0 +1,3 @@
+"""DroQ helpers (reference ``sheeprl/algos/droq`` reuses SAC's)."""
+
+from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, concat_obs, test  # noqa: F401

@@ -1,0 +1,45 @@
+"""SAC evaluation entrypoint (reference ``sheeprl/algos/sac/evaluate.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import SACActor, action_bounds
+from sheeprl_tpu.algos.sac.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["sac"])
+def evaluate_sac(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger, log_dir = create_tensorboard_logger(cfg)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    action_space = env.action_space
+    observation_space = env.observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    env.close()
+
+    act_dim = int(np.prod(action_space.shape))
+    action_scale, action_bias = action_bounds(action_space)
+    actor = SACActor(action_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size)
+    actor_params = state["agent"]["actor"]
+    test(actor, actor_params, jnp.asarray(action_scale), jnp.asarray(action_bias), fabric, cfg, log_dir)
+
+
+@register_evaluation(algorithms=["sac_decoupled"])
+def evaluate_sac_decoupled(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    evaluate_sac(fabric, cfg, state)

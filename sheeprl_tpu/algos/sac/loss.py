@@ -1,0 +1,23 @@
+"""SAC losses (reference ``sheeprl/algos/sac/loss.py``, Eqs. 5/7/17 of
+https://arxiv.org/abs/1812.05905), pure jnp."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def policy_loss(alpha: jnp.ndarray, logprobs: jnp.ndarray, qf_values: jnp.ndarray) -> jnp.ndarray:
+    # Eq. 7
+    return ((alpha * logprobs) - qf_values).mean()
+
+
+def critic_loss(qf_values: jnp.ndarray, next_qf_value: jnp.ndarray, num_critics: int) -> jnp.ndarray:
+    # Eq. 5 — sum of per-critic MSEs against the shared TD target
+    return sum(
+        ((qf_values[..., i : i + 1] - next_qf_value) ** 2).mean() for i in range(num_critics)
+    )
+
+
+def entropy_loss(log_alpha: jnp.ndarray, logprobs: jnp.ndarray, target_entropy: jnp.ndarray) -> jnp.ndarray:
+    # Eq. 17
+    return (-log_alpha * (logprobs + target_entropy)).mean()

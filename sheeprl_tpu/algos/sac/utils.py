@@ -1,0 +1,53 @@
+"""SAC helpers (reference ``sheeprl/algos/sac/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+
+
+def concat_obs(obs: Dict[str, np.ndarray], mlp_keys, n_envs: int) -> np.ndarray:
+    """Stack the selected vector keys into one float32 ``[n_envs, obs_dim]``."""
+    return np.concatenate(
+        [np.asarray(obs[k], np.float32).reshape(n_envs, -1) for k in mlp_keys], axis=-1
+    )
+
+
+def test(actor, actor_params, action_scale, action_bias, fabric, cfg, log_dir: str) -> None:
+    """Greedy single-env evaluation episode (reference utils.py:19-46)."""
+    from sheeprl_tpu.algos.sac.agent import greedy_action
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+
+    @jax.jit
+    def act(params, obs):
+        mean, _ = actor.apply({"params": params}, obs)
+        return greedy_action(mean, action_scale, action_bias)
+
+    done = False
+    cumulative_rew = 0.0
+    o = env.reset(seed=cfg.seed)[0]
+    while not done:
+        obs = concat_obs(o, cfg.mlp_keys.encoder, 1)
+        action = np.asarray(act(actor_params, obs))
+        o, reward, terminated, truncated, _ = env.step(action.reshape(env.action_space.shape))
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    fabric.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

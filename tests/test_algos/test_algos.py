@@ -114,6 +114,68 @@ def test_ppo_checkpoint_resume(tmp_path, monkeypatch):
     cli.run(resume_args)
 
 
+def test_sac(tmp_path, devices, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args(tmp_path) + [
+        "exp=sac",
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        "per_rank_batch_size=4",
+        "algo.learning_starts=2",
+        "algo.hidden_size=8",
+        "env=gym",
+        "env.id=Pendulum-v1",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "buffer.size=64",
+    ]
+    cli.run(args)
+
+
+def test_sac_sample_next_obs(tmp_path, monkeypatch):
+    """next-obs synthesis path: the buffer derives next_observations at idx+1.
+
+    Needs a real (non-dry) run: dry_run forces buffer_size=1 and next-obs
+    synthesis requires at least two stored steps."""
+    monkeypatch.chdir(tmp_path)
+    args = standard_args(tmp_path) + [
+        "exp=sac",
+        "dry_run=False",
+        "total_steps=16",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+        "per_rank_batch_size=4",
+        "algo.learning_starts=8",
+        "algo.hidden_size=8",
+        "env=gym",
+        "env.id=Pendulum-v1",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "buffer.size=64",
+        "buffer.sample_next_obs=True",
+    ]
+    cli.run(args)
+
+
+def test_droq(tmp_path, devices, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args(tmp_path) + [
+        "exp=droq",
+        f"fabric.devices={devices}",
+        "fabric.accelerator=cpu",
+        "per_rank_batch_size=4",
+        "algo.learning_starts=2",
+        "algo.hidden_size=8",
+        "algo.per_rank_gradient_steps=2",
+        "env=gym",
+        "env.id=Pendulum-v1",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "buffer.size=64",
+    ]
+    cli.run(args)
+
+
 def test_unknown_algorithm(tmp_path):
     with pytest.raises(Exception):
         cli.run(standard_args(tmp_path) + ["exp=does_not_exist"])
