@@ -32,6 +32,21 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _reset_observability_globals():
+    """Restore the class-level disable flags the CLI flips (cli.py:136-139);
+    without this an algo test run with ``metric.log_level=0`` leaks
+    ``disabled=True`` into later aggregator/timer unit tests."""
+    from sheeprl_tpu.utils.metric import MetricAggregator
+    from sheeprl_tpu.utils.timer import timer
+
+    agg_disabled, timer_disabled = MetricAggregator.disabled, timer.disabled
+    yield
+    MetricAggregator.disabled = agg_disabled
+    timer.disabled = timer_disabled
+    timer.reset()
+
+
+@pytest.fixture(autouse=True)
 def _preserve_environ():
     """Snapshot/restore os.environ around every test (reference
     tests/conftest.py:20-61 asserts no env-var leaks)."""
