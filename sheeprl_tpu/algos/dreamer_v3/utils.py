@@ -1,0 +1,174 @@
+"""DreamerV3 utilities (reference ``sheeprl/algos/dreamer_v3/utils.py``).
+
+- :data:`AGGREGATOR_KEYS` — the metric allow-list (reference :14-39).
+- :func:`update_moments` — the return-normalizer percentile EMA
+  (reference Moments :42-67) as a *functional* state update; the cross-rank
+  ``all_gather`` becomes a ``lax.all_gather`` over the mesh axis when called
+  inside the sharded train step.
+- :func:`compute_lambda_values` — TD(λ) backward recursion (reference :70-81)
+  as one reversed ``lax.scan``.
+- :func:`test` — greedy rollout on a fresh env (reference :86-137).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sg = jax.lax.stop_gradient
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Params/exploration_amount",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+    "User/LambdaValues",
+    "User/Advantages",
+    "User/Entropy",
+    "User/PredictedRewards",
+    "User/PredictedValues",
+    "User/DynLoss",
+    "User/ReprLoss",
+}
+
+
+def init_moments() -> Dict[str, jnp.ndarray]:
+    return {"low": jnp.zeros(()), "high": jnp.zeros(())}
+
+
+def update_moments(
+    state: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,
+    decay: float = 0.99,
+    max_: float = 1e8,
+    percentile_low: float = 0.05,
+    percentile_high: float = 0.95,
+    axis_name: Optional[str] = None,
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, jnp.ndarray]:
+    """EMA of the 5%/95% percentiles of λ-returns (reference Moments :61-67).
+
+    When ``axis_name`` is given (inside shard_map) the percentiles are taken
+    over the values gathered from the whole mesh, matching the reference's
+    ``fabric.all_gather``. Returns ``(new_state, offset, invscale)``, all
+    stop-gradiented.
+    """
+    x = sg(x)
+    if axis_name is not None:
+        x = jax.lax.all_gather(x, axis_name)
+    low = jnp.quantile(x, percentile_low)
+    high = jnp.quantile(x, percentile_high)
+    new_low = decay * state["low"] + (1 - decay) * low
+    new_high = decay * state["high"] + (1 - decay) * high
+    invscale = jnp.maximum(1.0 / max_, new_high - new_low)
+    return {"low": new_low, "high": new_high}, sg(new_low), sg(invscale)
+
+
+def compute_lambda_values(
+    rewards: jnp.ndarray,
+    values: jnp.ndarray,
+    continues: jnp.ndarray,
+    lmbda: float = 0.95,
+) -> jnp.ndarray:
+    """TD(λ) returns over ``[H, ...]`` (reference :70-81): one reversed scan,
+    ``continues`` already folded with γ by the caller."""
+    interm = rewards + continues * values * (1 - lmbda)
+
+    def step(nxt, inp):
+        interm_t, cont_t = inp
+        val = interm_t + cont_t * lmbda * nxt
+        return val, val
+
+    _, vals = jax.lax.scan(step, values[-1], (interm, continues), reverse=True)
+    return vals
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], cnn_keys, mlp_keys, n_envs: int
+) -> Dict[str, np.ndarray]:
+    """Host-side obs dict → float arrays shaped for the models: cnn keys keep
+    uint8 [C,H,W] folded over frame-stack and are normalized on device; mlp
+    keys flattened to [n_envs, -1]."""
+    out = {}
+    for k in cnn_keys:
+        v = np.asarray(obs[k])
+        out[k] = v.reshape(n_envs, -1, *v.shape[-2:])
+    for k in mlp_keys:
+        v = np.asarray(obs[k])
+        out[k] = v.reshape(n_envs, -1).astype(np.float32)
+    return out
+
+
+def normalize_obs_jnp(obs: Dict[str, jnp.ndarray], cnn_keys) -> Dict[str, jnp.ndarray]:
+    """uint8 pixels → [0, 1] floats on device (reference /255 at
+    dreamer_v3.py:619-624)."""
+    return {
+        k: (jnp.asarray(v, jnp.float32) / 255.0 if k in cnn_keys else jnp.asarray(v, jnp.float32))
+        for k, v in obs.items()
+    }
+
+
+def test(
+    player_fns: Dict[str, Any],
+    params: Dict[str, Any],
+    fabric,
+    cfg,
+    log_dir: str,
+    test_name: str = "",
+    sample_actions: bool = False,
+):
+    """Greedy episode on a fresh single env (reference utils.py:86-137)."""
+    import gymnasium as gym  # noqa: F401
+
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(
+        cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else "")
+    )()
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+    done = False
+    cumulative_rew = 0.0
+    key = jax.random.PRNGKey(cfg.seed)
+    obs = env.reset(seed=cfg.seed)[0]
+    state = player_fns["init_states"](params["world_model"], 1)
+    act_fn = player_fns["exploration_action"] if sample_actions else player_fns["greedy_action"]
+    while not done:
+        prepared = prepare_obs(obs, cnn_keys, mlp_keys, 1)
+        norm = normalize_obs_jnp(prepared, cnn_keys)
+        key, k = jax.random.split(key)
+        if sample_actions:
+            actions, state = act_fn(
+                params["world_model"], params["actor"], state, norm, k, jnp.float32(0.0)
+            )
+        else:
+            actions, state = act_fn(params["world_model"], params["actor"], state, norm, k)
+        if len(np.asarray(actions[0]).shape) > 1 and not isinstance(
+            env.action_space, gym.spaces.Box
+        ):
+            real_actions = np.array([np.argmax(np.asarray(a), axis=-1) for a in actions])
+        else:
+            real_actions = np.concatenate([np.asarray(a) for a in actions], -1)
+        obs, reward, done, truncated, _ = env.step(
+            real_actions.reshape(env.action_space.shape)
+        )
+        done = done or truncated or cfg.dry_run
+        cumulative_rew += float(reward)
+    fabric.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

@@ -1,0 +1,133 @@
+"""DreamerV3 tests: CLI dry runs over action types (reference
+``tests/test_algos/test_algos.py`` dreamer_v3 cases) + numeric units for the
+λ-return scan and the Moments percentile EMA."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu import cli
+
+
+def dv3_args(tmp_path, extra=()):
+    return [
+        "dry_run=True",
+        "env=dummy",
+        "env.sync_env=True",
+        "checkpoint.every=1000000",
+        "metric.log_every=1000000",
+        "metric.log_level=0",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+        f"root_dir={tmp_path}/logs",
+        "run_name=test",
+        "exp=dreamer_v3",
+        "fabric.accelerator=cpu",
+        "per_rank_batch_size=2",
+        "per_rank_sequence_length=8",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4",
+        "algo.world_model.discrete_size=4",
+        "algo.learning_starts=0",
+        "cnn_keys.encoder=[rgb]",
+        *extra,
+    ]
+
+
+@pytest.fixture(params=["1", "2"])
+def devices(request):
+    return request.param
+
+
+@pytest.mark.parametrize(
+    "env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"]
+)
+def test_dreamer_v3(tmp_path, devices, env_id, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(dv3_args(tmp_path, [f"fabric.devices={devices}", f"env.id={env_id}"]))
+
+
+def test_dreamer_v3_checkpoint_resume(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        dv3_args(
+            tmp_path,
+            ["fabric.devices=1", "env.id=discrete_dummy", "checkpoint.every=1", "checkpoint.save_last=True"],
+        )
+    )
+    import glob
+    import os
+
+    ckpts = glob.glob(f"{tmp_path}/logs/**/checkpoint/ckpt_*", recursive=True)
+    assert ckpts, "no checkpoint written"
+    cli.run(
+        dv3_args(
+            tmp_path,
+            ["fabric.devices=1", "env.id=discrete_dummy", f"checkpoint.resume_from={os.path.abspath(ckpts[-1])}"],
+        )
+    )
+
+
+def test_compute_lambda_values_matches_reference_recursion():
+    from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values
+
+    rng = np.random.default_rng(0)
+    H, B = 7, 5
+    rewards = rng.normal(size=(H, B, 1)).astype(np.float32)
+    values = rng.normal(size=(H, B, 1)).astype(np.float32)
+    continues = (rng.random(size=(H, B, 1)) > 0.1).astype(np.float32) * 0.997
+    lmbda = 0.95
+
+    # reference recursion (dreamer_v3/utils.py:70-81)
+    vals = [values[-1:]]
+    interm = rewards + continues * values * (1 - lmbda)
+    for t in reversed(range(H)):
+        vals.append(interm[t : t + 1] + continues[t : t + 1] * lmbda * vals[-1])
+    expected = np.concatenate(list(reversed(vals))[:-1], axis=0)
+
+    got = np.asarray(compute_lambda_values(rewards, values, continues, lmbda))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_moments_percentile_ema():
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.dreamer_v3.utils import init_moments, update_moments
+
+    state = init_moments()
+    x = jnp.asarray(np.linspace(-10.0, 10.0, 1001, dtype=np.float32))
+    state, offset, invscale = update_moments(state, x, decay=0.0, max_=1.0)
+    # decay 0 → pure percentiles of x; invscale = max(1/max, high-low)
+    assert np.isclose(float(offset), -9.0, atol=0.1)
+    assert np.isclose(float(invscale), 18.0, atol=0.2)
+    # EMA accumulates with decay
+    state2, offset2, _ = update_moments(state, x, decay=0.5, max_=1.0)
+    assert np.isclose(float(offset2), 0.5 * float(offset) + 0.5 * (-9.0), atol=0.2)
+
+
+def test_hafner_initialization_heads():
+    import jax
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import (
+        CRITIC_UNIFORM_HEADS,
+        hafner_initialization,
+    )
+
+    params = {
+        "Dense_0": {"kernel": np.ones((8, 16), np.float32), "bias": np.zeros(16, np.float32)},
+        "head": {"kernel": np.ones((16, 255), np.float32), "bias": np.zeros(255, np.float32)},
+    }
+    out = hafner_initialization(params, jax.random.PRNGKey(0), CRITIC_UNIFORM_HEADS)
+    # zero-scale head → exactly zero (reference uniform_init_weights(0.0))
+    assert np.allclose(np.asarray(out["head"]["kernel"]), 0.0)
+    # trunk re-initialized with truncated normal, bounded by 2σ
+    k = np.asarray(out["Dense_0"]["kernel"])
+    std = np.sqrt(1.0 / 12.0) / 0.87962566103423978
+    assert np.abs(k).max() <= 2 * std + 1e-6
+    assert k.std() > 0.1 * std
