@@ -1,0 +1,502 @@
+"""DreamerV1 agent — flax modules, functional player, Xavier init.
+
+Behavioral contract from the reference ``sheeprl/algos/dreamer_v1/agent.py``
+(RecurrentModel :29-59, RSSM :62-191, WorldModel :193-218, PlayerDV1 :221-340,
+build_agent :343-540). V1 reuses the V2 encoder/decoder geometry (the
+reference imports them directly, agent.py:15-18) but differs in the core:
+
+- **Gaussian latent**: the representation/transition heads emit
+  ``2·stochastic_size`` (mean ‖ raw-std); the state is
+  ``Normal(mean, softplus(std) + min_std).rsample()``
+  (reference dreamer_v1/utils.py compute_stochastic_state :66-93);
+- plain GRU cell after a ``Linear(→recurrent_state_size) + act`` pre-layer
+  (reference :41-43) — no LayerNorm anywhere;
+- ``dynamic`` has **no** is_first reset (reference :95-133);
+- ReLU convs / ELU denses, Xavier-normal init.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    CNNDecoder,
+    CNNEncoder,
+    MLPDecoder,
+    MLPEncoder,
+    MLPHead,
+    cnn_encoder_output_dim,
+    xavier_normal_initialization,
+)
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    Actor,
+    actor_entropy,
+    add_exploration_noise,
+    build_actor_dists,
+    resolve_actor_distribution,
+    sample_actor_actions,
+)
+from sheeprl_tpu.distributions import Independent, Normal
+
+sg = jax.lax.stop_gradient
+
+__all__ = [
+    "Actor",
+    "RecurrentModel",
+    "RSSM",
+    "WorldModel",
+    "MLPHead",
+    "actor_entropy",
+    "add_exploration_noise",
+    "build_actor_dists",
+    "build_agent",
+    "build_player_fns",
+    "compute_stochastic_state",
+    "resolve_actor_distribution",
+    "sample_actor_actions",
+]
+
+
+def compute_stochastic_state(
+    state_information: jnp.ndarray,
+    key: Optional[jax.Array],
+    min_std: float = 0.1,
+) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
+    """``[..., 2S]`` head output → ``((mean, std), sampled state)`` with
+    ``std = softplus(raw) + min_std`` (reference dv1/utils.py:66-93). With no
+    key the mean is returned (the deterministic player-init path)."""
+    mean, std = jnp.split(state_information, 2, axis=-1)
+    std = jax.nn.softplus(std) + min_std
+    if key is None:
+        return (mean, std), mean
+    state = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+    return (mean, std), state
+
+
+class RecurrentModel(nn.Module):
+    """Linear(→recurrent size) + activation + plain GRU cell
+    (reference agent.py:29-59)."""
+
+    recurrent_state_size: int
+    activation: Any = "elu"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
+        from sheeprl_tpu.models import resolve_activation
+
+        feat = nn.Dense(self.recurrent_state_size)(x)
+        feat = resolve_activation(self.activation)(feat)
+        return nn.GRUCell(self.recurrent_state_size, name="gru")(h, feat)[1]
+
+
+class _GaussianStochasticModel(nn.Module):
+    """MLP trunk + ``2S`` head for the prior/posterior (reference
+    build_agent :396-411)."""
+
+    hidden_size: int
+    stochastic_size: int
+    activation: Any = "elu"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from sheeprl_tpu.models import MLP
+
+        x = MLP(hidden_sizes=[self.hidden_size], activation=self.activation)(x)
+        return nn.Dense(2 * self.stochastic_size, name="head")(x)
+
+
+class RSSM(nn.Module):
+    """Gaussian-latent RSSM (reference agent.py:62-191). Single-step methods;
+    callers scan over time. No is_first resets."""
+
+    recurrent_state_size: int
+    stochastic_size: int
+    hidden_size: int
+    representation_hidden_size: Optional[int] = None
+    min_std: float = 0.1
+    activation: Any = "elu"
+
+    def setup(self):
+        self.recurrent_model = RecurrentModel(
+            recurrent_state_size=self.recurrent_state_size,
+            activation=self.activation,
+        )
+        self.representation_model = _GaussianStochasticModel(
+            hidden_size=self.representation_hidden_size or self.hidden_size,
+            stochastic_size=self.stochastic_size,
+            activation=self.activation,
+        )
+        self.transition_model = _GaussianStochasticModel(
+            hidden_size=self.hidden_size,
+            stochastic_size=self.stochastic_size,
+            activation=self.activation,
+        )
+
+    def _transition(
+        self, recurrent_out: jnp.ndarray, key: Optional[jax.Array]
+    ) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
+        return compute_stochastic_state(
+            self.transition_model(recurrent_out), key, self.min_std
+        )
+
+    def _representation(
+        self, recurrent_state: jnp.ndarray, embedded_obs: jnp.ndarray, key: Optional[jax.Array]
+    ) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
+        return compute_stochastic_state(
+            self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1)),
+            key,
+            self.min_std,
+        )
+
+    def dynamic(
+        self,
+        posterior: jnp.ndarray,
+        recurrent_state: jnp.ndarray,
+        action: jnp.ndarray,
+        embedded_obs: jnp.ndarray,
+        key: jax.Array,
+    ):
+        """One posterior step (reference :95-133). Returns ``(recurrent_state,
+        posterior, (post_mean, post_std), (prior_mean, prior_std))``."""
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        k1, k2 = jax.random.split(key)
+        prior_mean_std, _ = self._transition(recurrent_state, k1)
+        posterior_mean_std, posterior = self._representation(recurrent_state, embedded_obs, k2)
+        return recurrent_state, posterior, posterior_mean_std, prior_mean_std
+
+    def imagination(
+        self, stochastic_state: jnp.ndarray, recurrent_state: jnp.ndarray,
+        actions: jnp.ndarray, key: jax.Array,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """One prior step in imagination (reference :171-191)."""
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([stochastic_state, actions], -1), recurrent_state
+        )
+        _, imagined_prior = self._transition(recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+    def __call__(self, posterior, recurrent_state, action, embedded_obs, key):
+        return self.dynamic(posterior, recurrent_state, action, embedded_obs, key)
+
+
+class WorldModel(nn.Module):
+    """Encoder + Gaussian RSSM + observation/reward/[continue] heads
+    (reference agent.py:193-218)."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_channels: Sequence[int]
+    mlp_dims: Sequence[int]
+    image_size: Tuple[int, int]
+    channels_multiplier: int
+    encoder_mlp_layers: int
+    decoder_mlp_layers: int
+    dense_units: int
+    recurrent_state_size: int
+    stochastic_size: int
+    hidden_size: int
+    representation_hidden_size: Optional[int] = None
+    reward_mlp_layers: Optional[int] = None
+    reward_dense_units: Optional[int] = None
+    continue_mlp_layers: Optional[int] = None
+    continue_dense_units: Optional[int] = None
+    use_continues: bool = False
+    min_std: float = 0.1
+    cnn_act: Any = "relu"
+    dense_act: Any = "elu"
+
+    def setup(self):
+        if self.cnn_keys:
+            self.cnn_encoder = CNNEncoder(
+                keys=self.cnn_keys,
+                channels_multiplier=self.channels_multiplier,
+                layer_norm=False,
+                activation=self.cnn_act,
+            )
+            self.cnn_decoder = CNNDecoder(
+                output_channels=self.cnn_channels,
+                channels_multiplier=self.channels_multiplier,
+                cnn_encoder_output_dim=cnn_encoder_output_dim(
+                    self.image_size, self.channels_multiplier
+                ),
+                layer_norm=False,
+                activation=self.cnn_act,
+            )
+        if self.mlp_keys:
+            self.mlp_encoder = MLPEncoder(
+                keys=self.mlp_keys,
+                mlp_layers=self.encoder_mlp_layers,
+                dense_units=self.dense_units,
+                layer_norm=False,
+                activation=self.dense_act,
+            )
+            self.mlp_decoder = MLPDecoder(
+                keys=self.mlp_keys,
+                output_dims=self.mlp_dims,
+                mlp_layers=self.decoder_mlp_layers,
+                dense_units=self.dense_units,
+                layer_norm=False,
+                activation=self.dense_act,
+            )
+        self.rssm = RSSM(
+            recurrent_state_size=self.recurrent_state_size,
+            stochastic_size=self.stochastic_size,
+            hidden_size=self.hidden_size,
+            representation_hidden_size=self.representation_hidden_size,
+            min_std=self.min_std,
+            activation=self.dense_act,
+        )
+        self.reward_model = MLPHead(
+            output_dim=1,
+            mlp_layers=self.reward_mlp_layers or self.decoder_mlp_layers,
+            dense_units=self.reward_dense_units or self.dense_units,
+            layer_norm=False,
+            activation=self.dense_act,
+        )
+        if self.use_continues:
+            self.continue_model = MLPHead(
+                output_dim=1,
+                mlp_layers=self.continue_mlp_layers or self.decoder_mlp_layers,
+                dense_units=self.continue_dense_units or self.dense_units,
+                layer_norm=False,
+                activation=self.dense_act,
+            )
+
+    # -- methods for apply(..., method=...) --------------------------------
+
+    def encode(self, obs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        feats = []
+        if self.cnn_keys:
+            feats.append(self.cnn_encoder(obs))
+        if self.mlp_keys:
+            feats.append(self.mlp_encoder(obs))
+        return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
+
+    def dynamic(self, posterior, recurrent_state, action, embedded_obs, key):
+        return self.rssm.dynamic(posterior, recurrent_state, action, embedded_obs, key)
+
+    def imagination(self, prior, recurrent_state, actions, key):
+        return self.rssm.imagination(prior, recurrent_state, actions, key)
+
+    def recurrent_step(self, stochastic, actions, recurrent_state):
+        return self.rssm.recurrent_model(
+            jnp.concatenate([stochastic, actions], -1), recurrent_state
+        )
+
+    def representation(self, recurrent_state, embedded_obs, key):
+        return self.rssm._representation(recurrent_state, embedded_obs, key)
+
+    def decode(self, latent: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        out: Dict[str, jnp.ndarray] = {}
+        if self.cnn_keys:
+            rec = self.cnn_decoder(latent)
+            if len(self.cnn_keys) > 1:
+                parts = jnp.split(rec, np.cumsum(np.asarray(self.cnn_channels))[:-1], axis=-3)
+            else:
+                parts = [rec]
+            out.update({k: v for k, v in zip(self.cnn_keys, parts)})
+        if self.mlp_keys:
+            out.update(self.mlp_decoder(latent))
+        return out
+
+    def reward(self, latent: jnp.ndarray) -> jnp.ndarray:
+        return self.reward_model(latent)
+
+    def continues(self, latent: jnp.ndarray) -> jnp.ndarray:
+        return self.continue_model(latent)
+
+    def __call__(self, obs, posterior, recurrent_state, action, key):
+        """Init-path: touches every submodule once."""
+        embed = self.encode(obs)
+        recurrent_state, posterior, post_ms, prior_ms = self.rssm.dynamic(
+            posterior, recurrent_state, action, embed, key
+        )
+        latent = jnp.concatenate([posterior, recurrent_state], -1)
+        recon = self.decode(latent)
+        cont = self.continue_model(latent) if self.use_continues else None
+        return recurrent_state, posterior, post_ms, prior_ms, recon, self.reward_model(latent), cont
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+
+def build_agent(
+    cfg,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    observation_space,
+    key: jax.Array,
+) -> Tuple[WorldModel, Actor, MLPHead, Dict[str, Any]]:
+    """Construct module defs + Xavier-initialized params (reference
+    build_agent, agent.py:343-540)."""
+    wm_cfg = cfg.algo.world_model
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+    screen = int(cfg.env.screen_size)
+    cnn_channels = [int(np.prod(observation_space[k].shape[:-2])) for k in cnn_keys]
+    mlp_dims = [int(np.prod(observation_space[k].shape)) for k in mlp_keys]
+
+    world_model = WorldModel(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        cnn_channels=cnn_channels,
+        mlp_dims=mlp_dims,
+        image_size=(screen, screen),
+        channels_multiplier=int(wm_cfg.encoder.cnn_channels_multiplier),
+        encoder_mlp_layers=int(wm_cfg.encoder.mlp_layers),
+        decoder_mlp_layers=int(wm_cfg.observation_model.mlp_layers),
+        dense_units=int(wm_cfg.encoder.dense_units),
+        recurrent_state_size=int(wm_cfg.recurrent_model.recurrent_state_size),
+        stochastic_size=int(wm_cfg.stochastic_size),
+        hidden_size=int(wm_cfg.transition_model.hidden_size),
+        representation_hidden_size=int(wm_cfg.representation_model.hidden_size),
+        reward_mlp_layers=int(wm_cfg.reward_model.mlp_layers),
+        reward_dense_units=int(wm_cfg.reward_model.dense_units),
+        continue_mlp_layers=int(wm_cfg.discount_model.mlp_layers),
+        continue_dense_units=int(wm_cfg.discount_model.dense_units),
+        use_continues=bool(wm_cfg.use_continues),
+        min_std=float(wm_cfg.min_std),
+        cnn_act=cfg.algo.cnn_act,
+        dense_act=cfg.algo.dense_act,
+    )
+    latent_size = int(wm_cfg.stochastic_size) + int(wm_cfg.recurrent_model.recurrent_state_size)
+    actor = Actor(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=resolve_actor_distribution(
+            cfg.distribution.get("type", "auto"), is_continuous
+        ),
+        dense_units=int(cfg.algo.actor.dense_units),
+        mlp_layers=int(cfg.algo.actor.mlp_layers),
+        layer_norm=False,
+        activation=cfg.algo.actor.dense_act,
+    )
+    critic = MLPHead(
+        output_dim=1,
+        mlp_layers=int(cfg.algo.critic.mlp_layers),
+        dense_units=int(cfg.algo.critic.dense_units),
+        layer_norm=False,
+        activation=cfg.algo.critic.dense_act,
+    )
+
+    k_wm, k_actor, k_critic, k_xw, k_xa, k_xc, k_s = jax.random.split(key, 7)
+    dummy_obs = {}
+    for k, ch in zip(cnn_keys, cnn_channels):
+        dummy_obs[k] = jnp.zeros((1, ch, screen, screen), jnp.float32)
+    for k, dim in zip(mlp_keys, mlp_dims):
+        dummy_obs[k] = jnp.zeros((1, dim), jnp.float32)
+    stoch = int(wm_cfg.stochastic_size)
+    rec = int(wm_cfg.recurrent_model.recurrent_state_size)
+    act_dim = int(np.sum(actions_dim))
+
+    wm_params = world_model.init(
+        k_wm,
+        dummy_obs,
+        jnp.zeros((1, stoch)),
+        jnp.zeros((1, rec)),
+        jnp.zeros((1, act_dim)),
+        k_s,
+    )["params"]
+    actor_params = actor.init(k_actor, jnp.zeros((1, latent_size)))["params"]
+    critic_params = critic.init(k_critic, jnp.zeros((1, latent_size)))["params"]
+
+    wm_params = xavier_normal_initialization(wm_params, k_xw)
+    actor_params = xavier_normal_initialization(actor_params, k_xa)
+    critic_params = xavier_normal_initialization(critic_params, k_xc)
+
+    params = {
+        "world_model": wm_params,
+        "actor": actor_params,
+        "critic": critic_params,
+    }
+    return world_model, actor, critic, params
+
+
+# ---------------------------------------------------------------------------
+# functional player (reference PlayerDV1, agent.py:221-340)
+# ---------------------------------------------------------------------------
+
+
+def build_player_fns(
+    world_model: WorldModel,
+    actor: Actor,
+    cfg,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+):
+    """Pure jitted player over an explicit ``{"actions", "recurrent",
+    "stochastic"}`` pytree; zero-init states (reference init_states :300-310)."""
+    distribution = resolve_actor_distribution(
+        cfg.distribution.get("type", "auto"), is_continuous
+    )
+    init_std = float(cfg.algo.actor.init_std)
+    min_std = float(cfg.algo.actor.min_std)
+    rec_size = int(cfg.algo.world_model.recurrent_model.recurrent_state_size)
+    stoch_size = int(cfg.algo.world_model.stochastic_size)
+    act_dim = int(np.sum(actions_dim))
+
+    def init_states(wm_params, n_envs: int):
+        del wm_params
+        return {
+            "actions": jnp.zeros((n_envs, act_dim)),
+            "recurrent": jnp.zeros((n_envs, rec_size)),
+            "stochastic": jnp.zeros((n_envs, stoch_size)),
+        }
+
+    def reset_states(wm_params, state, reset_mask):
+        del wm_params
+        return jax.tree_util.tree_map(lambda s: (1.0 - reset_mask) * s, state)
+
+    def _step(wm_params, actor_params, state, obs, key, is_training: bool):
+        embed = world_model.apply({"params": wm_params}, obs, method=WorldModel.encode)
+        recurrent = world_model.apply(
+            {"params": wm_params},
+            state["stochastic"],
+            state["actions"],
+            state["recurrent"],
+            method=WorldModel.recurrent_step,
+        )
+        k_repr, k_act = jax.random.split(key)
+        _, stochastic = world_model.apply(
+            {"params": wm_params}, recurrent, embed, k_repr, method=WorldModel.representation
+        )
+        latent = jnp.concatenate([stochastic, recurrent], -1)
+        pre_dist = actor.apply({"params": actor_params}, latent)
+        dists = build_actor_dists(
+            pre_dist, is_continuous, distribution, init_std, min_std, unimix=0.0
+        )
+        actions = sample_actor_actions(dists, is_continuous, k_act, is_training)
+        new_state = {
+            "actions": jnp.concatenate(actions, -1),
+            "recurrent": recurrent,
+            "stochastic": stochastic,
+        }
+        return actions, new_state
+
+    @jax.jit
+    def greedy_action(wm_params, actor_params, state, obs, key):
+        return _step(wm_params, actor_params, state, obs, key, is_training=False)
+
+    @jax.jit
+    def exploration_action(wm_params, actor_params, state, obs, key, expl_amount):
+        k_step, k_expl = jax.random.split(key)
+        actions, new_state = _step(wm_params, actor_params, state, obs, k_step, is_training=True)
+        expl = add_exploration_noise(actions, expl_amount, is_continuous, k_expl)
+        new_state = dict(new_state, actions=jnp.concatenate(expl, -1))
+        return expl, new_state
+
+    return {
+        "init_states": init_states,
+        "reset_states": jax.jit(reset_states),
+        "greedy_action": greedy_action,
+        "exploration_action": exploration_action,
+    }

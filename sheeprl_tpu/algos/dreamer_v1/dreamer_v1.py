@@ -1,27 +1,17 @@
-"""DreamerV3 — the flagship model-based algorithm.
+"""DreamerV1 — Gaussian-latent world model with dynamics-backprop actor.
 
-Behavioral contract from the reference ``sheeprl/algos/dreamer_v3/dreamer_v3.py``
-(train :49-378, main :381-832): sequence-replay world-model learning
-(posterior scan over T=64), 15-step imagination for actor-critic learning with
-percentile-normalized λ-returns, two-hot critic with EMA target regularizer,
-ε-greedy env interaction gated by ``learning_starts``/``train_every``.
+Behavioral contract from the reference ``sheeprl/algos/dreamer_v1/dreamer_v1.py``
+(train :38-400, main :403-795): sequence-replay world-model learning with a
+Gaussian RSSM and free-nats-clamped KL, H-step imagination, the actor trained
+by pure **dynamics backpropagation** (``-mean(discount · λ-values)``,
+reference actor_loss, dv1/loss.py:97-110) and a Gaussian critic regressed on
+the V1 λ-targets — no target critic.
 
-TPU-native design (NOT a translation):
-
-- **One jitted SPMD program per gradient step.** The reference runs three
-  separate backward/step passes plus a Python GRU loop per batch; here the
-  target-EMA, world-model update, imagination rollout, actor update, critic
-  update, and Moments state all live in a single ``shard_map``-ped jit with
-  the batch dim sharded over the mesh's ``data`` axis. Sequence (T) and
-  horizon (H) loops are ``lax.scan``; XLA fuses the GRU cell across steps.
-- **Gradient psum via shardings.** Each of the three losses takes
-  ``lax.pmean`` on its grads over the data axis — the DDP allreduce —
-  and the Moments percentile EMA all-gathers λ-returns across the mesh
-  (reference utils.py:61), keeping bitwise 1-vs-N invariance of the math.
-- **Stateless cadences.** Target-EMA cadence (tau ∈ {0, τ, 1}) and
-  exploration amount enter as dynamic scalars: no recompiles.
-- The whole agent (3 param trees + target + 3 optax states + moments) is one
-  pytree, donated through the step: params stay resident in HBM.
+TPU-native design: identical chassis to ``dreamer_v2.py`` — one
+``shard_map``-ped jit per gradient step, ``lax.scan`` time/horizon loops,
+``lax.pmean`` grads. Data layout matches V2: buffer row *t* holds the action
+that led to observation *t*; the dynamic scan consumes actions unshifted and
+V1 has no is_first handling at all (reference train :147-158).
 """
 
 from __future__ import annotations
@@ -37,27 +27,25 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
-from sheeprl_tpu.algos.dreamer_v3.agent import (
+from sheeprl_tpu.algos.dreamer_v1.agent import (
     Actor,
     WorldModel,
     build_actor_dists,
     build_agent,
     build_player_fns,
-    actor_entropy,
+    resolve_actor_distribution,
     sample_actor_actions,
 )
-from sheeprl_tpu.algos.dreamer_v3.loss import continue_distribution, reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v3.utils import (
+from sheeprl_tpu.algos.dreamer_v1.loss import gaussian_independent, reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v1.utils import (
     compute_lambda_values,
-    init_moments,
     normalize_obs_jnp,
     prepare_obs,
     test,
-    update_moments,
 )
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.distributions import MSEDistribution, SymlogDistribution, TwoHotEncodingDistribution
+from sheeprl_tpu.distributions import Bernoulli, Independent, Normal
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -80,139 +68,116 @@ def build_train_fn(
     actions_dim: Sequence[int],
     is_continuous: bool,
 ):
-    """Compile one full DreamerV3 gradient step as a single SPMD program.
+    """Compile one full DreamerV1 gradient step as a single SPMD program.
 
-    Returns ``train_step(agent_state, data, key, tau) -> (agent_state,
-    metrics)`` where ``data`` leaves are ``[T, B_total, ...]`` (B sharded over
-    the mesh) and ``tau`` is the dynamic target-EMA coefficient (0 = skip).
+    Returns ``train_step(agent_state, data, key) -> (agent_state, metrics)``.
     """
     axis = fabric.data_axis
     cnn_keys = tuple(cfg.cnn_keys.encoder)
     mlp_keys = tuple(cfg.mlp_keys.encoder)
-    cnn_dec_keys = tuple(cfg.cnn_keys.decoder)
-    mlp_dec_keys = tuple(cfg.mlp_keys.decoder)
     wm_cfg = cfg.algo.world_model
-    stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    stoch_size = int(wm_cfg.stochastic_size)
     rec_size = int(wm_cfg.recurrent_model.recurrent_state_size)
     horizon = int(cfg.algo.horizon)
     gamma = float(cfg.algo.gamma)
     lmbda = float(cfg.algo.lmbda)
-    kl_dynamic = float(wm_cfg.kl_dynamic)
-    kl_representation = float(wm_cfg.kl_representation)
     kl_free_nats = float(wm_cfg.kl_free_nats)
     kl_regularizer = float(wm_cfg.kl_regularizer)
     continue_scale = float(wm_cfg.continue_scale_factor)
-    ent_coef = float(cfg.algo.actor.ent_coef)
-    from sheeprl_tpu.algos.dreamer_v3.agent import resolve_actor_distribution
-
+    use_continues = bool(wm_cfg.use_continues)
     distribution = resolve_actor_distribution(
         cfg.distribution.get("type", "auto"), is_continuous
     )
     init_std = float(cfg.algo.actor.init_std)
     min_std = float(cfg.algo.actor.min_std)
-    unimix = float(cfg.algo.unimix)
-    moments_cfg = cfg.algo.actor.moments
-    m_decay = float(moments_cfg.decay)
-    m_max = float(moments_cfg.max)
-    m_low = float(moments_cfg.percentile.low)
-    m_high = float(moments_cfg.percentile.high)
-    dims = tuple(int(d) for d in actions_dim)
-    splits = list(np.cumsum(dims)[:-1])
 
     def wm_apply(params, method, *args):
         return world_model.apply({"params": params}, *args, method=method)
 
     # ------------------------------------------------------------------
-    # world-model loss (reference train :104-194)
+    # world-model loss (reference train :105-250)
     # ------------------------------------------------------------------
 
     def wm_loss_fn(wm_params, data, key):
         T, B = data["rewards"].shape[:2]
-        batch_obs = {k: data[k] / 255.0 for k in cnn_keys}
+        batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
         batch_obs.update({k: data[k] for k in mlp_keys})
-        is_first = data["is_first"].at[0].set(1.0)
-        # shift: the action column becomes "action that led here"
-        batch_actions = jnp.concatenate(
-            [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
-        )
         embedded = wm_apply(wm_params, WorldModel.encode, batch_obs)
 
         def step(carry, inp):
             posterior, recurrent = carry
-            action, embed, first, k = inp
-            recurrent, posterior, post_logits, prior_logits = world_model.apply(
+            action, embed, k = inp
+            recurrent, posterior, post_ms, prior_ms = world_model.apply(
                 {"params": wm_params},
                 posterior,
                 recurrent,
                 action,
                 embed,
-                first,
                 k,
                 method=WorldModel.dynamic,
             )
-            return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
+            return (posterior, recurrent), (recurrent, posterior, post_ms, prior_ms)
 
         keys = jax.random.split(key, T)
-        (_, _), (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
+        (_, _), (recurrents, posteriors, post_ms, prior_ms) = jax.lax.scan(
             step,
-            (jnp.zeros((B, stoch_flat)), jnp.zeros((B, rec_size))),
-            (batch_actions, embedded, is_first, keys),
+            (jnp.zeros((B, stoch_size)), jnp.zeros((B, rec_size))),
+            (data["actions"], embedded, keys),
         )
         latents = jnp.concatenate([posteriors, recurrents], -1)
         recon = wm_apply(wm_params, WorldModel.decode, latents)
-        po = {k: MSEDistribution(recon[k], dims=3) for k in cnn_dec_keys}
-        po.update({k: SymlogDistribution(recon[k], dims=1) for k in mlp_dec_keys})
-        pr = TwoHotEncodingDistribution(
-            wm_apply(wm_params, WorldModel.reward_logits, latents), dims=1
-        )
-        pc = continue_distribution(
-            wm_apply(wm_params, WorldModel.continue_logits, latents)
-        )
-        S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
+        qo = {
+            k: gaussian_independent(recon[k], 1.0, 3 if k in cnn_keys else 1)
+            for k in recon
+        }
+        qr = gaussian_independent(wm_apply(wm_params, WorldModel.reward, latents), 1.0, 1)
+        if use_continues:
+            qc = Independent(Bernoulli(logits=wm_apply(wm_params, WorldModel.continues, latents)), 1)
+            continue_targets = 1.0 - data["dones"]
+        else:
+            qc = continue_targets = None
+        posteriors_dist = Independent(Normal(post_ms[0], post_ms[1]), 1)
+        priors_dist = Independent(Normal(prior_ms[0], prior_ms[1]), 1)
         loss, metrics = reconstruction_loss(
-            po,
+            qo,
             batch_obs,
-            pr,
+            qr,
             data["rewards"],
-            prior_logits.reshape(T, B, S, D),
-            post_logits.reshape(T, B, S, D),
-            kl_dynamic,
-            kl_representation,
+            posteriors_dist,
+            priors_dist,
             kl_free_nats,
             kl_regularizer,
-            pc,
-            1.0 - data["dones"],
+            qc,
+            continue_targets,
             continue_scale,
         )
         return loss, (metrics, sg(posteriors), sg(recurrents))
 
     # ------------------------------------------------------------------
-    # actor loss via imagination (reference train :230-345)
+    # actor loss via imagination (reference train :252-367)
     # ------------------------------------------------------------------
 
     def imagination_rollout(wm_params, actor_params, posteriors, recurrents, key):
-        """15-step prior rollout from every (t, b) posterior. Returns
-        ``(trajectories [H+1, BT, L], actions [H+1, BT, A])`` with gradients
-        flowing through the actor's straight-through/rsample actions."""
-        prior = posteriors.reshape(-1, stoch_flat)
+        """H prior steps from every (t, b) posterior; the starting latent is
+        *not* part of the trajectory (reference :252-283). Returns
+        ``[H, BT, L]``."""
+        prior = posteriors.reshape(-1, stoch_size)
         recurrent = recurrents.reshape(-1, rec_size)
-        latent0 = jnp.concatenate([prior, recurrent], -1)
+        latent = jnp.concatenate([prior, recurrent], -1)
 
         def policy(latent, k):
             pre = actor.apply({"params": actor_params}, sg(latent))
             dists = build_actor_dists(
-                pre, is_continuous, distribution, init_std, min_std, unimix
+                pre, is_continuous, distribution, init_std, min_std, unimix=0.0
             )
             return jnp.concatenate(
                 sample_actor_actions(dists, is_continuous, k, True), -1
             )
 
-        k0, key = jax.random.split(key)
-        a0 = policy(latent0, k0)
-
         def step(carry, k):
-            prior, recurrent, action = carry
+            prior, recurrent, latent = carry
             k_img, k_act = jax.random.split(k)
+            action = policy(latent, k_act)
             prior, recurrent = world_model.apply(
                 {"params": wm_params},
                 prior,
@@ -222,106 +187,61 @@ def build_train_fn(
                 method=WorldModel.imagination,
             )
             latent = jnp.concatenate([prior, recurrent], -1)
-            action = policy(latent, k_act)
-            return (prior, recurrent, action), (latent, action)
+            return (prior, recurrent, latent), latent
 
         keys = jax.random.split(key, horizon)
-        _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, a0), keys)
-        trajectories = jnp.concatenate([latent0[None], latents], 0)
-        actions = jnp.concatenate([a0[None], acts], 0)
-        return trajectories, actions
+        _, latents = jax.lax.scan(step, (prior, recurrent, latent), keys)
+        return latents
 
-    def actor_loss_fn(actor_params, wm_params, critic_params, posteriors, recurrents,
-                      true_continue, moments_state, key):
-        traj, imagined_actions = imagination_rollout(
-            wm_params, actor_params, posteriors, recurrents, key
-        )
-        predicted_values = TwoHotEncodingDistribution(
-            critic.apply({"params": critic_params}, traj), dims=1
-        ).mean
-        predicted_rewards = TwoHotEncodingDistribution(
-            wm_apply(wm_params, WorldModel.reward_logits, traj), dims=1
-        ).mean
-        continues = continue_distribution(
-            wm_apply(wm_params, WorldModel.continue_logits, traj)
-        ).base.mode
-        continues = jnp.concatenate([true_continue[None], continues[1:]], 0)
+    def actor_loss_fn(actor_params, wm_params, critic_params, posteriors, recurrents, key):
+        traj = imagination_rollout(wm_params, actor_params, posteriors, recurrents, key)
+        predicted_values = critic.apply({"params": critic_params}, traj)
+        predicted_rewards = wm_apply(wm_params, WorldModel.reward, traj)
+        if use_continues:
+            continues = jax.nn.sigmoid(wm_apply(wm_params, WorldModel.continues, traj)) * gamma
+        else:
+            continues = jnp.ones_like(sg(predicted_rewards)) * gamma
 
         lambda_values = compute_lambda_values(
-            predicted_rewards[1:], predicted_values[1:], continues[1:] * gamma, lmbda
+            predicted_rewards,
+            predicted_values,
+            continues,
+            last_values=predicted_values[-1],
+            lmbda=lmbda,
         )
-        discount = sg(jnp.cumprod(continues * gamma, axis=0) / gamma)
-
-        pre = actor.apply({"params": actor_params}, sg(traj))
-        policies = build_actor_dists(
-            pre, is_continuous, distribution, init_std, min_std, unimix
+        # (reference train :353) weighted down by how likely the imagined
+        # trajectory would have ended
+        discount = sg(
+            jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-2]], 0), 0)
         )
-
-        baseline = predicted_values[:-1]
-        new_moments, offset, invscale = update_moments(
-            moments_state, lambda_values, m_decay, m_max, m_low, m_high, axis_name=axis
-        )
-        advantage = (lambda_values - offset) / invscale - (baseline - offset) / invscale
-
-        if is_continuous:
-            objective = advantage
-        else:
-            per_head = [
-                p.log_prob(sg(a))[..., None][:-1]
-                for p, a in zip(policies, jnp.split(imagined_actions, splits, axis=-1))
-            ]
-            objective = sum(per_head) * sg(advantage)
-        entropy = ent_coef * actor_entropy(policies, distribution)
-        policy_loss = -jnp.mean(discount[:-1] * (objective + entropy[..., None][:-1]))
+        policy_loss = -jnp.mean(discount * lambda_values)
         aux = {
             "trajectories": sg(traj),
             "lambda_values": sg(lambda_values),
             "discount": discount,
-            "moments": new_moments,
             "Loss/policy_loss": policy_loss,
-            "User/LambdaValues": jnp.mean(sg(lambda_values)),
-            "User/Advantages": jnp.mean(sg(advantage)),
-            "User/Entropy": jnp.mean(sg(entropy)),
-            "User/PredictedRewards": jnp.mean(sg(predicted_rewards)),
-            "User/PredictedValues": jnp.mean(sg(predicted_values)),
         }
         return policy_loss, aux
 
     # ------------------------------------------------------------------
-    # critic loss (reference train :348-370)
+    # critic loss (reference train :369-395)
     # ------------------------------------------------------------------
 
-    def critic_loss_fn(critic_params, target_params, traj, lambda_values, discount):
-        qv = TwoHotEncodingDistribution(
-            critic.apply({"params": critic_params}, traj[:-1]), dims=1
-        )
-        target_values = TwoHotEncodingDistribution(
-            critic.apply({"params": target_params}, traj[:-1]), dims=1
-        ).mean
-        value_loss = -qv.log_prob(lambda_values) - qv.log_prob(sg(target_values))
-        return jnp.mean(value_loss * discount[:-1, ..., 0])
+    def critic_loss_fn(critic_params, traj, lambda_values, discount):
+        qv = Independent(Normal(critic.apply({"params": critic_params}, traj[:-1]), 1.0), 1)
+        return -jnp.mean(discount[..., 0] * qv.log_prob(lambda_values))
 
     # ------------------------------------------------------------------
     # the fused step
     # ------------------------------------------------------------------
 
-    def local_step(agent_state, data, key, tau):
-        # de-correlate sampling noise across shards: each device works on a
-        # different slice of the batch and must draw different latents
+    def local_step(agent_state, data, key):
         key = jax.random.fold_in(key, jax.lax.axis_index(axis))
         params = agent_state["params"]
         opt = agent_state["opt"]
 
-        # target critic EMA, dynamic cadence (reference main :731-735)
-        target = jax.tree_util.tree_map(
-            lambda c, t: tau * c + (1.0 - tau) * t,
-            params["critic"],
-            params["target_critic"],
-        )
-
         k_wm, k_img = jax.random.split(key)
 
-        # -- world model update
         (wm_loss, (wm_metrics, posteriors, recurrents)), wm_grads = jax.value_and_grad(
             wm_loss_fn, has_aux=True
         )(params["world_model"], data, k_wm)
@@ -329,27 +249,20 @@ def build_train_fn(
         wm_updates, wm_opt = world_tx.update(wm_grads, opt["world_model"], params["world_model"])
         wm_params = optax.apply_updates(params["world_model"], wm_updates)
 
-        # -- actor update (imagination from the *updated* world model, as the
-        # reference's in-place optimizer.step implies)
-        true_continue = (1.0 - data["dones"]).reshape(-1, 1)
         (actor_loss, aux), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
             params["actor"],
             wm_params,
             params["critic"],
             posteriors,
             recurrents,
-            true_continue,
-            agent_state["moments"],
             k_img,
         )
         actor_grads = jax.lax.pmean(actor_grads, axis)
         actor_updates, actor_opt = actor_tx.update(actor_grads, opt["actor"], params["actor"])
         actor_params = optax.apply_updates(params["actor"], actor_updates)
 
-        # -- critic update
         critic_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(
             params["critic"],
-            target,
             aux["trajectories"],
             aux["lambda_values"],
             aux["discount"],
@@ -359,13 +272,7 @@ def build_train_fn(
         critic_params = optax.apply_updates(params["critic"], critic_updates)
 
         metrics = dict(wm_metrics)
-        metrics.update(
-            {
-                k: v
-                for k, v in aux.items()
-                if k not in ("trajectories", "lambda_values", "discount", "moments")
-            }
-        )
+        metrics["Loss/policy_loss"] = aux["Loss/policy_loss"]
         metrics["Loss/value_loss"] = critic_loss
         metrics["Grads/world_model"] = optax.global_norm(wm_grads)
         metrics["Grads/actor"] = optax.global_norm(actor_grads)
@@ -377,17 +284,15 @@ def build_train_fn(
                 "world_model": wm_params,
                 "actor": actor_params,
                 "critic": critic_params,
-                "target_critic": target,
             },
             "opt": {"world_model": wm_opt, "actor": actor_opt, "critic": critic_opt},
-            "moments": aux["moments"],
         }
         return new_state, metrics
 
     shmapped = jax.shard_map(
         local_step,
         mesh=fabric.mesh,
-        in_specs=(P(), P(None, axis), P(), P()),
+        in_specs=(P(), P(None, axis), P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -399,10 +304,9 @@ def main(fabric, cfg: Dict[str, Any]):
     world_size = fabric.world_size
     root_key = fabric.seed_everything(cfg.seed)
 
-    # These arguments cannot be changed (reference main :394-396)
-    cfg.env.frame_stack = -1
-    if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
-        raise ValueError(f"The screen size must be a power of 2, got: {cfg.env.screen_size}")
+    # These arguments cannot be changed (reference main :410-412)
+    cfg.env.screen_size = 64
+    cfg.env.frame_stack = 1
 
     logger, log_dir = create_tensorboard_logger(cfg)
     fabric.logger = logger
@@ -411,9 +315,6 @@ def main(fabric, cfg: Dict[str, Any]):
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
 
-    # Environment setup — one process drives all devices (SPMD), so the vector
-    # env holds num_envs × world_size environments, each fault-tolerant via
-    # RestartOnException (reference main :408-423).
     n_envs = int(cfg.env.num_envs) * world_size
     from functools import partial
 
@@ -454,21 +355,6 @@ def main(fabric, cfg: Dict[str, Any]):
             "You should specify at least one CNN keys or MLP keys from the cli: "
             "`cnn_keys.encoder=[rgb]` or `mlp_keys.encoder=[state]`"
         )
-    if (
-        len(set(cfg.cnn_keys.encoder).intersection(set(cfg.cnn_keys.decoder))) == 0
-        and len(set(cfg.mlp_keys.encoder).intersection(set(cfg.mlp_keys.decoder))) == 0
-    ):
-        raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
-    if len(set(cfg.cnn_keys.decoder) - set(cfg.cnn_keys.encoder)) > 0:
-        raise RuntimeError(
-            "The CNN keys of the decoder must be contained in the encoder ones. "
-            f"Those keys are decoded without being encoded: {list(set(cfg.cnn_keys.decoder))}"
-        )
-    if len(set(cfg.mlp_keys.decoder) - set(cfg.mlp_keys.encoder)) > 0:
-        raise RuntimeError(
-            "The MLP keys of the decoder must be contained in the encoder ones. "
-            f"Those keys are decoded without being encoded: {list(set(cfg.mlp_keys.decoder))}"
-        )
     if cfg.metric.log_level > 0:
         fabric.print("Encoder CNN keys:", cfg.cnn_keys.encoder)
         fabric.print("Encoder MLP keys:", cfg.mlp_keys.encoder)
@@ -478,7 +364,6 @@ def main(fabric, cfg: Dict[str, Any]):
     mlp_keys = list(cfg.mlp_keys.encoder)
     obs_keys = cnn_keys + mlp_keys
 
-    # Agent + optimizers + train program
     root_key, build_key = jax.random.split(root_key)
     world_model, actor, critic, params = build_agent(
         cfg, actions_dim, is_continuous, observation_space, build_key
@@ -495,7 +380,6 @@ def main(fabric, cfg: Dict[str, Any]):
             "actor": actor_tx.init(params["actor"]),
             "critic": critic_tx.init(params["critic"]),
         },
-        "moments": init_moments(),
     }
 
     expl_decay_steps = 0
@@ -533,10 +417,10 @@ def main(fabric, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
-    # Buffer: per-env sequential sub-buffers (reference main :515-523)
-    buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 4
+    # Buffer: per-env sequential sub-buffers (reference main :520-531)
+    buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 8
     rb = EnvIndependentReplayBuffer(
-        max(buffer_size, 4),
+        max(buffer_size, 8),
         n_envs,
         obs_keys=obs_keys,
         memmap=cfg.buffer.memmap,
@@ -544,7 +428,6 @@ def main(fabric, cfg: Dict[str, Any]):
         buffer_cls=SequentialReplayBuffer,
     )
 
-    # Global counters (reference main :534-545)
     train_step = 0
     last_train = 0
     start_step = int(np.asarray(state["update"])) // world_size if state is not None else 1
@@ -586,16 +469,17 @@ def main(fabric, cfg: Dict[str, Any]):
             "policy_steps_per_update value."
         )
 
-    # Data sharding for the train batch [T, B_total, ...]
     data_sharding = fabric.sharding(None, fabric.data_axis)
 
-    # First observation (reference main :574-590)
+    # First observation: a zero-action row (reference main :578-587; V1 keeps
+    # no is_first column)
     o = envs.reset(seed=cfg.seed)[0]
     obs = prepare_obs(o, cnn_keys, mlp_keys, n_envs)
     step_data = {k: obs[k][None] for k in obs_keys}
     step_data["dones"] = np.zeros((1, n_envs, 1), np.float32)
+    step_data["actions"] = np.zeros((1, n_envs, int(np.sum(actions_dim))), np.float32)
     step_data["rewards"] = np.zeros((1, n_envs, 1), np.float32)
-    step_data["is_first"] = np.ones((1, n_envs, 1), np.float32)
+    rb.add(step_data)
     player_state = player_fns["init_states"](agent_state["params"]["world_model"], n_envs)
 
     per_rank_gradient_steps = 0
@@ -634,23 +518,10 @@ def main(fabric, cfg: Dict[str, Any]):
                         [np.argmax(np.asarray(a), axis=-1) for a in actions_j], axis=-1
                     )
 
-            step_data["actions"] = actions.reshape(1, n_envs, -1).astype(np.float32)
-            rb.add(step_data)
-
             o, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
             dones = np.logical_or(terminated, truncated).astype(np.float32)
-
-        step_data["is_first"] = np.zeros_like(step_data["dones"])
-        if "restart_on_exception" in infos:
-            for i, env_roe in enumerate(infos["restart_on_exception"]):
-                if env_roe and not dones[i]:
-                    sub = rb.buffer[i]
-                    last_idx = (sub._pos - 1) % sub.buffer_size
-                    sub["dones"][last_idx] = np.ones_like(sub["dones"][last_idx])
-                    sub["is_first"][last_idx] = np.zeros_like(sub["is_first"][last_idx])
-                    step_data["is_first"][0, i] = 1.0
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             fi = infos["final_info"]
@@ -665,8 +536,6 @@ def main(fabric, cfg: Dict[str, Any]):
                         aggregator.update("Game/ep_len_avg", ep_len)
                     fabric.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        # Save the real next observation: on autoreset steps the terminal
-        # observation lives in final_obs (reference main :663-668)
         next_obs_np = {k: np.asarray(o[k]) for k in o}
         dones_idxes = np.nonzero(dones.reshape(-1))[0].tolist()
         real_next_obs = {k: v.copy() for k, v in next_obs_np.items()}
@@ -678,32 +547,32 @@ def main(fabric, cfg: Dict[str, Any]):
                         if k in fo:
                             real_next_obs[k][idx] = np.asarray(fo[k])
 
-        obs = prepare_obs(next_obs_np, cnn_keys, mlp_keys, n_envs)
+        # Row t holds the action that led to observation t (reference :654-668)
+        obs_row = prepare_obs(real_next_obs, cnn_keys, mlp_keys, n_envs)
         for k in obs_keys:
-            step_data[k] = obs[k][None]
-
+            step_data[k] = obs_row[k][None]
         rewards = np.asarray(rewards, np.float32).reshape(n_envs, 1)
         step_data["dones"] = dones.reshape(1, n_envs, 1)
+        step_data["actions"] = actions.reshape(1, n_envs, -1).astype(np.float32)
         step_data["rewards"] = clip_rewards_fn(rewards)[None]
+        rb.add(step_data)
+
+        obs = prepare_obs(next_obs_np, cnn_keys, mlp_keys, n_envs)
 
         if len(dones_idxes) > 0:
             reset_obs = prepare_obs(
-                {k: real_next_obs[k][dones_idxes] for k in real_next_obs},
+                {k: next_obs_np[k][dones_idxes] for k in next_obs_np},
                 cnn_keys,
                 mlp_keys,
                 len(dones_idxes),
             )
             reset_data = {k: reset_obs[k][None] for k in obs_keys}
-            reset_data["dones"] = np.ones((1, len(dones_idxes), 1), np.float32)
+            reset_data["dones"] = np.zeros((1, len(dones_idxes), 1), np.float32)
             reset_data["actions"] = np.zeros((1, len(dones_idxes), int(np.sum(actions_dim))), np.float32)
-            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
-            reset_data["is_first"] = np.zeros_like(reset_data["dones"])
+            reset_data["rewards"] = np.zeros((1, len(dones_idxes), 1), np.float32)
             rb.add(reset_data, dones_idxes)
 
-            # Reset already-inserted step data (reference main :708-712)
-            step_data["rewards"][:, dones_idxes] = 0.0
             step_data["dones"][:, dones_idxes] = 0.0
-            step_data["is_first"][:, dones_idxes] = 1.0
             reset_mask = np.zeros((n_envs, 1), np.float32)
             reset_mask[dones_idxes] = 1.0
             player_state = player_fns["reset_states"](
@@ -712,13 +581,8 @@ def main(fabric, cfg: Dict[str, Any]):
 
         updates_before_training -= 1
 
-        # Train the agent (reference main :719-765)
         if update >= learning_starts and updates_before_training <= 0:
-            n_samples = (
-                cfg.algo.per_rank_pretrain_steps
-                if update == learning_starts
-                else cfg.algo.per_rank_gradient_steps
-            )
+            n_samples = cfg.algo.per_rank_gradient_steps
             local_data = rb.sample(
                 cfg.per_rank_batch_size * world_size,
                 sequence_length=cfg.per_rank_sequence_length,
@@ -727,19 +591,13 @@ def main(fabric, cfg: Dict[str, Any]):
             with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
                 metrics = None
                 for i in range(n_samples):
-                    if per_rank_gradient_steps % cfg.algo.critic.target_network_update_freq == 0:
-                        tau = 1.0 if per_rank_gradient_steps == 0 else cfg.algo.critic.tau
-                    else:
-                        tau = 0.0
                     batch = {
                         k: jnp.asarray(v[i], jnp.float32)
                         for k, v in local_data.items()
                     }
                     batch = jax.device_put(batch, data_sharding)
                     root_key, train_key = jax.random.split(root_key)
-                    agent_state, metrics = train_fn(
-                        agent_state, batch, train_key, jnp.float32(tau)
-                    )
+                    agent_state, metrics = train_fn(agent_state, batch, train_key)
                     per_rank_gradient_steps += 1
                 if metrics is not None:
                     metrics = jax.device_get(metrics)
@@ -761,7 +619,6 @@ def main(fabric, cfg: Dict[str, Any]):
                 if "Params/exploration_amount" in aggregator:
                     aggregator.update("Params/exploration_amount", expl_amount)
 
-        # Log metrics (reference main :768-800)
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or update == num_updates
         ):
@@ -797,7 +654,6 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        # Checkpoint (reference main :803-830)
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             update == num_updates and cfg.checkpoint.save_last
         ):
@@ -820,4 +676,12 @@ def main(fabric, cfg: Dict[str, Any]):
 
     envs.close()
     if fabric.is_global_zero:
-        test(player_fns, jax.device_get(agent_state["params"]), fabric, cfg, log_dir, sample_actions=True)
+        test(
+            player_fns,
+            jax.device_get(agent_state["params"]),
+            fabric,
+            cfg,
+            log_dir,
+            sample_actions=False,
+            normalize_fn=normalize_obs_jnp,
+        )

@@ -1,0 +1,61 @@
+"""DreamerV1 world-model loss (reference ``sheeprl/algos/dreamer_v1/loss.py``:
+reconstruction_loss :30-94).
+
+Eq. 10 of the DV1 paper: Gaussian NLL of observations/rewards (+ optional
+Bernoulli continue NLL) plus ``kl_regularizer · max(free_nats, KL(post ‖
+prior))`` where the free-nats clamp applies to the *mean* KL of the Gaussian
+latents.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.distributions import Independent, Normal, kl_divergence
+
+sg = jax.lax.stop_gradient
+
+
+def reconstruction_loss(
+    qo: Dict[str, Any],
+    observations: Dict[str, jnp.ndarray],
+    qr: Any,
+    rewards: jnp.ndarray,
+    posteriors_dist: Any,
+    priors_dist: Any,
+    kl_free_nats: float = 3.0,
+    kl_regularizer: float = 1.0,
+    qc: Optional[Any] = None,
+    continue_targets: Optional[jnp.ndarray] = None,
+    continue_scale_factor: float = 10.0,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """``posteriors_dist``/``priors_dist`` are Independent Normals over
+    ``[T, B, S]``. Returns ``(scalar_loss, metrics)``."""
+    observation_loss = -sum(jnp.mean(qo[k].log_prob(observations[k])) for k in qo)
+    reward_loss = -jnp.mean(qr.log_prob(rewards))
+    kl = jnp.mean(kl_divergence(posteriors_dist, priors_dist))
+    state_loss = jnp.maximum(jnp.asarray(kl_free_nats, kl.dtype), kl)
+    continue_loss = jnp.zeros(())
+    if qc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -jnp.mean(qc.log_prob(continue_targets))
+    total = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss
+    metrics = {
+        "Loss/world_model_loss": total,
+        "Loss/observation_loss": observation_loss,
+        "Loss/reward_loss": reward_loss,
+        "Loss/state_loss": state_loss,
+        "Loss/continue_loss": continue_loss,
+        "State/kl": kl,
+        "State/post_entropy": jnp.mean(posteriors_dist.entropy()),
+        "State/prior_entropy": jnp.mean(priors_dist.entropy()),
+    }
+    return total, metrics
+
+
+def gaussian_independent(mean: jnp.ndarray, std, ndims: int = 1) -> Independent:
+    """Independent unit-or-given-σ Normal helper for obs/reward/value heads."""
+    std_arr = jnp.broadcast_to(jnp.asarray(std, mean.dtype), mean.shape)
+    return Independent(Normal(mean, std_arr), ndims)

@@ -1,0 +1,68 @@
+"""DreamerV1 utilities (reference ``sheeprl/algos/dreamer_v1/utils.py``).
+
+- :data:`AGGREGATOR_KEYS` — the metric allow-list (reference :17-27).
+- :func:`compute_lambda_values` — the V1 recursion (reference :28-63):
+  ``H−1`` targets, the pre-terminal step bootstrapping with the *full* last
+  value while earlier steps mix ``(1−λ)·v_{t+1}``.
+- obs normalization: V1 pixels are scaled to ``[-0.5, 0.5]`` like V2.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v2.utils import normalize_obs_jnp  # noqa: F401
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Params/exploration_amount",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+
+
+def compute_lambda_values(
+    rewards: jnp.ndarray,
+    values: jnp.ndarray,
+    continues: jnp.ndarray,
+    last_values: jnp.ndarray,
+    lmbda: float = 0.95,
+) -> jnp.ndarray:
+    """V1 λ-targets over ``[H, ...]`` inputs → ``[H−1, ...]`` outputs
+    (reference dv1/utils.py:28-63): for t < H−2 the one-step bootstrap is
+    ``(1−λ)·v_{t+1}``; at t = H−2 it is the full ``last_values``; the running
+    λ-accumulator starts at 0."""
+    horizon = rewards.shape[0]
+    rewards = jnp.asarray(rewards)
+    values = jnp.asarray(values)
+    continues = jnp.asarray(continues)
+    next_values = values[1:] * (1 - lmbda)
+    next_values = next_values.at[-1].set(jnp.asarray(last_values))
+    inputs = rewards[: horizon - 1] + next_values * continues[: horizon - 1]
+
+    def step(last_lv, inp):
+        delta, cont = inp
+        lv = delta + lmbda * cont * last_lv
+        return lv, lv
+
+    _, lv = jax.lax.scan(
+        step,
+        jnp.zeros_like(values[0]),
+        (inputs, continues[: horizon - 1]),
+        reverse=True,
+    )
+    return lv

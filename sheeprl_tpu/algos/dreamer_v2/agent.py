@@ -1,0 +1,721 @@
+"""DreamerV2 agent — flax modules, functional player, Xavier init.
+
+Behavioral contract from the reference ``sheeprl/algos/dreamer_v2/agent.py``
+(CNNEncoder :33-76, MLPEncoder :78-123, CNNDecoder :125-193, MLPDecoder
+:196-240, RecurrentModel :243-291, RSSM :294-411, Actor :413-585,
+PlayerDV2 :620-770, build_agent :772-1030).
+
+Differences from the DV3 chassis (``algos/dreamer_v3/agent.py``) that define
+the V2 model family:
+
+- conv stages are k=4/s=2/**valid** padding (31→14→6→2 on 64×64) and the
+  decoder inverts them from a 1×1 map with kernels [5, 5, 6, 6];
+- ELU activations, no LayerNorm by default (except inside the GRU cell),
+  biases always on;
+- the categorical latent has **no** 1% uniform-mix, and an ``is_first`` reset
+  zeroes the carried state instead of re-initialising from the prior
+  (reference RSSM.dynamic :327-363);
+- observations are decoded as unit-variance Gaussians, rewards/values are
+  1-dim Gaussian heads (no two-hot), and every kernel gets Xavier-normal
+  init (reference init_weights, dreamer_v2/utils.py:62-79).
+
+The time loop still lives in the caller as ``jax.lax.scan`` and the player is
+an explicit state pytree — the TPU-native design notes in the DV3 module
+apply here unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# The actor trunk/head layout and the distribution/sampling/exploration
+# helpers are structurally identical between V2 and V3 (the reference's DV3
+# Actor subclasses the DV2 one); V2 passes unimix=0 and its own defaults.
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    Actor,
+    actor_entropy,
+    add_exploration_noise,
+    build_actor_dists,
+    resolve_actor_distribution,
+    sample_actor_actions,
+)
+from sheeprl_tpu.models import MLP, CNN, DeCNN, LayerNormGRUCell
+
+sg = jax.lax.stop_gradient
+
+__all__ = [
+    "Actor",
+    "CNNEncoder",
+    "MLPEncoder",
+    "CNNDecoder",
+    "MLPDecoder",
+    "RecurrentModel",
+    "RSSM",
+    "WorldModel",
+    "MLPHead",
+    "actor_entropy",
+    "add_exploration_noise",
+    "build_actor_dists",
+    "build_agent",
+    "build_player_fns",
+    "resolve_actor_distribution",
+    "sample_actor_actions",
+    "xavier_normal_initialization",
+]
+
+
+# ---------------------------------------------------------------------------
+# encoders / decoders
+# ---------------------------------------------------------------------------
+
+
+class CNNEncoder(nn.Module):
+    """Image encoder (reference agent.py:33-76): 4 conv stages of k=4/s=2
+    with *valid* padding and channels ``[1, 2, 4, 8] × multiplier``; optional
+    channel-last LayerNorm; flattened output. Input ``[..., C, H, W]``."""
+
+    keys: Sequence[str]
+    channels_multiplier: int
+    layer_norm: bool = False
+    activation: Any = "elu"
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-3)
+        return CNN(
+            channels=[m * self.channels_multiplier for m in (1, 2, 4, 8)],
+            kernel_sizes=4,
+            strides=2,
+            paddings=0,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            flatten=True,
+        )(x)
+
+
+def cnn_encoder_output_dim(image_size: Tuple[int, int], channels_multiplier: int) -> int:
+    """Static shape math replacing the reference's dummy-forward probe
+    (agent.py:70-71): four valid k=4/s=2 stages."""
+    h, w = image_size
+    for _ in range(4):
+        h = (h - 4) // 2 + 1
+        w = (w - 4) // 2 + 1
+    return 8 * channels_multiplier * h * w
+
+
+class MLPEncoder(nn.Module):
+    """Vector encoder (reference agent.py:78-123): N dense blocks, no symlog."""
+
+    keys: Sequence[str]
+    mlp_layers: int = 4
+    dense_units: int = 400
+    layer_norm: bool = False
+    activation: Any = "elu"
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+        )(x)
+
+
+class CNNDecoder(nn.Module):
+    """Pixel decoder (reference agent.py:125-193): Linear projection of the
+    latent to the encoder's flat feature size, reshaped to a 1×1 map, then
+    four transposed convs (k=[5,5,6,6], s=2) back to the image."""
+
+    output_channels: Sequence[int]
+    channels_multiplier: int
+    cnn_encoder_output_dim: int
+    layer_norm: bool = False
+    activation: Any = "elu"
+
+    @nn.compact
+    def __call__(self, latent: jnp.ndarray) -> jnp.ndarray:
+        total_c = sum(self.output_channels)
+        x = nn.Dense(self.cnn_encoder_output_dim)(latent)
+        lead = x.shape[:-1]
+        x = jnp.reshape(x, lead + (self.cnn_encoder_output_dim, 1, 1))
+        return DeCNN(
+            channels=[m * self.channels_multiplier for m in (4, 2, 1)] + [total_c],
+            kernel_sizes=[5, 5, 6, 6],
+            strides=2,
+            paddings=0,
+            activation=self.activation,
+            layer_norm=[self.layer_norm] * 3 + [False],
+        )(x)
+
+
+class MLPDecoder(nn.Module):
+    """Vector decoder (reference agent.py:196-240): dense trunk + one linear
+    head per key."""
+
+    keys: Sequence[str]
+    output_dims: Sequence[int]
+    mlp_layers: int = 4
+    dense_units: int = 400
+    layer_norm: bool = False
+    activation: Any = "elu"
+
+    @nn.compact
+    def __call__(self, latent: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        x = MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+        )(latent)
+        return {
+            k: nn.Dense(dim, name=f"head_{k}")(x)
+            for k, dim in zip(self.keys, self.output_dims)
+        }
+
+
+# ---------------------------------------------------------------------------
+# recurrent model / RSSM
+# ---------------------------------------------------------------------------
+
+
+class RecurrentModel(nn.Module):
+    """Dense pre-layer + LayerNorm GRU cell (reference agent.py:243-291; the
+    cell always norms, the pre-layer only if asked)."""
+
+    recurrent_state_size: int
+    dense_units: int
+    layer_norm: bool = False
+    activation: Any = "elu"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
+        feat = MLP(
+            hidden_sizes=[self.dense_units],
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+        )(x)
+        return LayerNormGRUCell(
+            self.recurrent_state_size, bias=True, layer_norm=True, norm_eps=1e-5, name="gru"
+        )(feat, h)
+
+
+class _StochasticModel(nn.Module):
+    """MLP trunk + logits head — shared shape of the transition (prior) and
+    representation (posterior) models (reference build_agent :857-886)."""
+
+    hidden_size: int
+    stoch_size: int  # stochastic_size * discrete_size
+    layer_norm: bool = False
+    activation: Any = "elu"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = MLP(
+            hidden_sizes=[self.hidden_size],
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+        )(x)
+        return nn.Dense(self.stoch_size, name="head")(x)
+
+
+def compute_stochastic_state(
+    logits: jnp.ndarray, discrete: int, key: Optional[jax.Array], sample: bool = True
+) -> jnp.ndarray:
+    """Sample (straight-through) or take the mode of the categorical latent
+    (reference dreamer_v2/utils.py:39-58). ``logits`` flat ``[..., S*D]`` →
+    flat state ``[..., S*D]``."""
+    from sheeprl_tpu.distributions import OneHotCategoricalStraightThrough
+
+    shape = logits.shape
+    logits = jnp.reshape(logits, shape[:-1] + (-1, discrete))
+    dist = OneHotCategoricalStraightThrough(logits=logits)
+    state = dist.rsample(key) if sample else dist.mode
+    return jnp.reshape(state, shape)
+
+
+class RSSM(nn.Module):
+    """Discrete-latent RSSM (reference agent.py:294-411): no unimix, and an
+    ``is_first`` step zeroes the carried action/posterior/recurrent state.
+
+    All methods are single-step over a batch; callers scan them over time.
+    The stochastic state is carried *flat* ``[..., S*D]``.
+    """
+
+    recurrent_state_size: int
+    stochastic_size: int
+    discrete_size: int
+    dense_units: int
+    hidden_size: int
+    representation_hidden_size: Optional[int] = None
+    layer_norm: bool = False
+    recurrent_layer_norm: bool = True
+    activation: Any = "elu"
+
+    def setup(self):
+        self.recurrent_model = RecurrentModel(
+            recurrent_state_size=self.recurrent_state_size,
+            dense_units=self.dense_units,
+            layer_norm=self.recurrent_layer_norm,
+            activation=self.activation,
+        )
+        stoch = self.stochastic_size * self.discrete_size
+        self.representation_model = _StochasticModel(
+            hidden_size=self.representation_hidden_size or self.hidden_size,
+            stoch_size=stoch,
+            layer_norm=self.layer_norm,
+            activation=self.activation,
+        )
+        self.transition_model = _StochasticModel(
+            hidden_size=self.hidden_size,
+            stoch_size=stoch,
+            layer_norm=self.layer_norm,
+            activation=self.activation,
+        )
+
+    def _transition(
+        self, recurrent_out: jnp.ndarray, key: Optional[jax.Array], sample_state: bool = True
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        logits = self.transition_model(recurrent_out)
+        return logits, compute_stochastic_state(logits, self.discrete_size, key, sample=sample_state)
+
+    def _representation(
+        self, recurrent_state: jnp.ndarray, embedded_obs: jnp.ndarray, key: jax.Array
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        logits = self.representation_model(
+            jnp.concatenate([recurrent_state, embedded_obs], -1)
+        )
+        return logits, compute_stochastic_state(logits, self.discrete_size, key)
+
+    def dynamic(
+        self,
+        posterior: jnp.ndarray,
+        recurrent_state: jnp.ndarray,
+        action: jnp.ndarray,
+        embedded_obs: jnp.ndarray,
+        is_first: jnp.ndarray,
+        key: jax.Array,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """One posterior step (reference :327-363): zero-mask resets, then
+        recurrent → prior → posterior. Returns ``(recurrent_state, posterior,
+        posterior_logits, prior_logits)``."""
+        action = (1.0 - is_first) * action
+        posterior = (1.0 - is_first) * posterior
+        recurrent_state = (1.0 - is_first) * recurrent_state
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        k1, k2 = jax.random.split(key)
+        prior_logits, _ = self._transition(recurrent_state, k1)
+        posterior_logits, posterior = self._representation(recurrent_state, embedded_obs, k2)
+        return recurrent_state, posterior, posterior_logits, prior_logits
+
+    def imagination(
+        self, prior: jnp.ndarray, recurrent_state: jnp.ndarray, actions: jnp.ndarray, key: jax.Array
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """One prior step in imagination (reference :396-411)."""
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([prior, actions], -1), recurrent_state
+        )
+        _, imagined_prior = self._transition(recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+    def __call__(self, posterior, recurrent_state, action, embedded_obs, is_first, key):
+        return self.dynamic(posterior, recurrent_state, action, embedded_obs, is_first, key)
+
+
+# ---------------------------------------------------------------------------
+# world model
+# ---------------------------------------------------------------------------
+
+
+class MLPHead(nn.Module):
+    """Dense trunk + single linear head (reward / continue / critic shape,
+    reference build_agent :888-921 — plain Gaussian/Bernoulli heads)."""
+
+    output_dim: int
+    mlp_layers: int
+    dense_units: int
+    layer_norm: bool = False
+    activation: Any = "elu"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+        )(x)
+        return nn.Dense(self.output_dim, name="head")(x)
+
+
+class WorldModel(nn.Module):
+    """Encoder + RSSM + observation/reward/[continue] heads (the canonical
+    container, reference agent.py:714-739). Methods are exposed for
+    ``apply(..., method=...)`` so train steps call exactly what they need
+    inside ``lax.scan``."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_channels: Sequence[int]
+    mlp_dims: Sequence[int]
+    image_size: Tuple[int, int]
+    channels_multiplier: int
+    encoder_mlp_layers: int
+    decoder_mlp_layers: int
+    dense_units: int
+    recurrent_state_size: int
+    stochastic_size: int
+    discrete_size: int
+    hidden_size: int
+    representation_hidden_size: Optional[int] = None
+    reward_mlp_layers: Optional[int] = None
+    reward_dense_units: Optional[int] = None
+    continue_mlp_layers: Optional[int] = None
+    continue_dense_units: Optional[int] = None
+    use_continues: bool = False
+    layer_norm: bool = False
+    cnn_act: Any = "elu"
+    dense_act: Any = "elu"
+
+    def setup(self):
+        if self.cnn_keys:
+            self.cnn_encoder = CNNEncoder(
+                keys=self.cnn_keys,
+                channels_multiplier=self.channels_multiplier,
+                layer_norm=self.layer_norm,
+                activation=self.cnn_act,
+            )
+            self.cnn_decoder = CNNDecoder(
+                output_channels=self.cnn_channels,
+                channels_multiplier=self.channels_multiplier,
+                cnn_encoder_output_dim=cnn_encoder_output_dim(
+                    self.image_size, self.channels_multiplier
+                ),
+                layer_norm=self.layer_norm,
+                activation=self.cnn_act,
+            )
+        if self.mlp_keys:
+            self.mlp_encoder = MLPEncoder(
+                keys=self.mlp_keys,
+                mlp_layers=self.encoder_mlp_layers,
+                dense_units=self.dense_units,
+                layer_norm=self.layer_norm,
+                activation=self.dense_act,
+            )
+            self.mlp_decoder = MLPDecoder(
+                keys=self.mlp_keys,
+                output_dims=self.mlp_dims,
+                mlp_layers=self.decoder_mlp_layers,
+                dense_units=self.dense_units,
+                layer_norm=self.layer_norm,
+                activation=self.dense_act,
+            )
+        self.rssm = RSSM(
+            recurrent_state_size=self.recurrent_state_size,
+            stochastic_size=self.stochastic_size,
+            discrete_size=self.discrete_size,
+            dense_units=self.dense_units,
+            hidden_size=self.hidden_size,
+            representation_hidden_size=self.representation_hidden_size,
+            layer_norm=self.layer_norm,
+            activation=self.dense_act,
+        )
+        self.reward_model = MLPHead(
+            output_dim=1,
+            mlp_layers=self.reward_mlp_layers or self.decoder_mlp_layers,
+            dense_units=self.reward_dense_units or self.dense_units,
+            layer_norm=self.layer_norm,
+            activation=self.dense_act,
+        )
+        if self.use_continues:
+            self.continue_model = MLPHead(
+                output_dim=1,
+                mlp_layers=self.continue_mlp_layers or self.decoder_mlp_layers,
+                dense_units=self.continue_dense_units or self.dense_units,
+                layer_norm=self.layer_norm,
+                activation=self.dense_act,
+            )
+
+    # -- methods for apply(..., method=...) --------------------------------
+
+    def encode(self, obs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        feats = []
+        if self.cnn_keys:
+            feats.append(self.cnn_encoder(obs))
+        if self.mlp_keys:
+            feats.append(self.mlp_encoder(obs))
+        return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
+
+    def dynamic(self, posterior, recurrent_state, action, embedded_obs, is_first, key):
+        return self.rssm.dynamic(posterior, recurrent_state, action, embedded_obs, is_first, key)
+
+    def imagination(self, prior, recurrent_state, actions, key):
+        return self.rssm.imagination(prior, recurrent_state, actions, key)
+
+    def recurrent_step(self, stochastic, actions, recurrent_state):
+        return self.rssm.recurrent_model(
+            jnp.concatenate([stochastic, actions], -1), recurrent_state
+        )
+
+    def representation(self, recurrent_state, embedded_obs, key):
+        return self.rssm._representation(recurrent_state, embedded_obs, key)
+
+    def decode(self, latent: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        out: Dict[str, jnp.ndarray] = {}
+        if self.cnn_keys:
+            rec = self.cnn_decoder(latent)
+            if len(self.cnn_keys) > 1:
+                parts = jnp.split(rec, np.cumsum(np.asarray(self.cnn_channels))[:-1], axis=-3)
+            else:
+                parts = [rec]
+            out.update({k: v for k, v in zip(self.cnn_keys, parts)})
+        if self.mlp_keys:
+            out.update(self.mlp_decoder(latent))
+        return out
+
+    def reward(self, latent: jnp.ndarray) -> jnp.ndarray:
+        return self.reward_model(latent)
+
+    def continues(self, latent: jnp.ndarray) -> jnp.ndarray:
+        return self.continue_model(latent)
+
+    def __call__(self, obs, posterior, recurrent_state, action, is_first, key):
+        """Init-path: touches every submodule once."""
+        embed = self.encode(obs)
+        recurrent_state, posterior, post_logits, prior_logits = self.rssm.dynamic(
+            posterior, recurrent_state, action, embed, is_first, key
+        )
+        latent = jnp.concatenate([posterior, recurrent_state], -1)
+        recon = self.decode(latent)
+        cont = self.continue_model(latent) if self.use_continues else None
+        return (
+            recurrent_state,
+            posterior,
+            post_logits,
+            prior_logits,
+            recon,
+            self.reward_model(latent),
+            cont,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Xavier-normal initialization (reference init_weights, dv2/utils.py:62-79)
+# ---------------------------------------------------------------------------
+
+
+from sheeprl_tpu.algos.dreamer_v3.agent import _fans  # noqa: E402
+
+
+def xavier_normal_initialization(params: Dict[str, Any], key: jax.Array) -> Dict[str, Any]:
+    """Re-initialize every kernel with Xavier normal, biases zero (the
+    reference applies ``nn.init.xavier_normal_`` to every Linear/Conv via
+    ``.apply(init_weights)``, build_agent :1008-1016)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    keys = jax.random.split(key, max(len(flat), 1))
+    leaves = []
+    for i, (path, leaf) in enumerate(flat):
+        name = "/".join(getattr(p, "key", str(p)) for p in path)
+        if name.endswith("kernel") and leaf.ndim >= 2:
+            fan_in, fan_out = _fans(leaf.shape)
+            std = float(np.sqrt(2.0 / (fan_in + fan_out)))
+            leaves.append(std * jax.random.normal(keys[i], leaf.shape, leaf.dtype))
+        elif name.endswith("bias"):
+            leaves.append(jnp.zeros_like(leaf))
+        else:
+            leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+
+def build_agent(
+    cfg,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    observation_space,
+    key: jax.Array,
+) -> Tuple[WorldModel, Actor, MLPHead, Dict[str, Any]]:
+    """Construct module defs + initialized params (reference build_agent,
+    agent.py:772-1030). Returns ``(world_model, actor, critic, params)`` with
+    ``params = {world_model, actor, critic, target_critic}``."""
+    wm_cfg = cfg.algo.world_model
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+    screen = int(cfg.env.screen_size)
+    cnn_channels = [int(np.prod(observation_space[k].shape[:-2])) for k in cnn_keys]
+    mlp_dims = [int(np.prod(observation_space[k].shape)) for k in mlp_keys]
+
+    world_model = WorldModel(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        cnn_channels=cnn_channels,
+        mlp_dims=mlp_dims,
+        image_size=(screen, screen),
+        channels_multiplier=int(wm_cfg.encoder.cnn_channels_multiplier),
+        encoder_mlp_layers=int(wm_cfg.encoder.mlp_layers),
+        decoder_mlp_layers=int(wm_cfg.observation_model.mlp_layers),
+        dense_units=int(wm_cfg.encoder.dense_units),
+        recurrent_state_size=int(wm_cfg.recurrent_model.recurrent_state_size),
+        stochastic_size=int(wm_cfg.stochastic_size),
+        discrete_size=int(wm_cfg.discrete_size),
+        hidden_size=int(wm_cfg.transition_model.hidden_size),
+        representation_hidden_size=int(wm_cfg.representation_model.hidden_size),
+        reward_mlp_layers=int(wm_cfg.reward_model.mlp_layers),
+        reward_dense_units=int(wm_cfg.reward_model.dense_units),
+        continue_mlp_layers=int(wm_cfg.discount_model.mlp_layers),
+        continue_dense_units=int(wm_cfg.discount_model.dense_units),
+        use_continues=bool(wm_cfg.use_continues),
+        layer_norm=bool(cfg.algo.layer_norm),
+        cnn_act=cfg.algo.cnn_act,
+        dense_act=cfg.algo.dense_act,
+    )
+    latent_size = (
+        int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+        + int(wm_cfg.recurrent_model.recurrent_state_size)
+    )
+    actor = Actor(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=resolve_actor_distribution(
+            cfg.distribution.get("type", "auto"), is_continuous
+        ),
+        dense_units=int(cfg.algo.actor.dense_units),
+        mlp_layers=int(cfg.algo.actor.mlp_layers),
+        layer_norm=bool(cfg.algo.actor.layer_norm),
+        activation=cfg.algo.actor.dense_act,
+    )
+    critic = MLPHead(
+        output_dim=1,
+        mlp_layers=int(cfg.algo.critic.mlp_layers),
+        dense_units=int(cfg.algo.critic.dense_units),
+        layer_norm=bool(cfg.algo.critic.layer_norm),
+        activation=cfg.algo.critic.dense_act,
+    )
+
+    k_wm, k_actor, k_critic, k_xw, k_xa, k_xc, k_s = jax.random.split(key, 7)
+    dummy_obs = {}
+    for k, ch in zip(cnn_keys, cnn_channels):
+        dummy_obs[k] = jnp.zeros((1, ch, screen, screen), jnp.float32)
+    for k, dim in zip(mlp_keys, mlp_dims):
+        dummy_obs[k] = jnp.zeros((1, dim), jnp.float32)
+    stoch = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    rec = int(wm_cfg.recurrent_model.recurrent_state_size)
+    act_dim = int(np.sum(actions_dim))
+
+    wm_params = world_model.init(
+        k_wm,
+        dummy_obs,
+        jnp.zeros((1, stoch)),
+        jnp.zeros((1, rec)),
+        jnp.zeros((1, act_dim)),
+        jnp.zeros((1, 1)),
+        k_s,
+    )["params"]
+    actor_params = actor.init(k_actor, jnp.zeros((1, latent_size)))["params"]
+    critic_params = critic.init(k_critic, jnp.zeros((1, latent_size)))["params"]
+
+    wm_params = xavier_normal_initialization(wm_params, k_xw)
+    actor_params = xavier_normal_initialization(actor_params, k_xa)
+    critic_params = xavier_normal_initialization(critic_params, k_xc)
+
+    params = {
+        "world_model": wm_params,
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": jax.tree_util.tree_map(jnp.copy, critic_params),
+    }
+    return world_model, actor, critic, params
+
+
+# ---------------------------------------------------------------------------
+# functional player (reference PlayerDV2, agent.py:620-770)
+# ---------------------------------------------------------------------------
+
+
+def build_player_fns(
+    world_model: WorldModel,
+    actor: Actor,
+    cfg,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+):
+    """Pure jitted player functions over an explicit state pytree
+    ``{"actions", "recurrent", "stochastic"}`` — reference PlayerDV2's
+    mutable attributes become ``jnp.where``-masked pytrees. All states
+    init to zeros (reference init_states :706-716)."""
+    distribution = resolve_actor_distribution(
+        cfg.distribution.get("type", "auto"), is_continuous
+    )
+    init_std = float(cfg.algo.actor.init_std)
+    min_std = float(cfg.algo.actor.min_std)
+    rec_size = int(cfg.algo.world_model.recurrent_model.recurrent_state_size)
+    stoch_flat = int(cfg.algo.world_model.stochastic_size) * int(
+        cfg.algo.world_model.discrete_size
+    )
+    act_dim = int(np.sum(actions_dim))
+
+    def init_states(wm_params, n_envs: int):
+        del wm_params  # V2 inits to zeros; signature shared with the V3 player
+        return {
+            "actions": jnp.zeros((n_envs, act_dim)),
+            "recurrent": jnp.zeros((n_envs, rec_size)),
+            "stochastic": jnp.zeros((n_envs, stoch_flat)),
+        }
+
+    def reset_states(wm_params, state, reset_mask):
+        del wm_params
+        return jax.tree_util.tree_map(lambda s: (1.0 - reset_mask) * s, state)
+
+    def _step(wm_params, actor_params, state, obs, key, is_training: bool):
+        embed = world_model.apply({"params": wm_params}, obs, method=WorldModel.encode)
+        recurrent = world_model.apply(
+            {"params": wm_params},
+            state["stochastic"],
+            state["actions"],
+            state["recurrent"],
+            method=WorldModel.recurrent_step,
+        )
+        k_repr, k_act = jax.random.split(key)
+        _, stochastic = world_model.apply(
+            {"params": wm_params}, recurrent, embed, k_repr, method=WorldModel.representation
+        )
+        latent = jnp.concatenate([stochastic, recurrent], -1)
+        pre_dist = actor.apply({"params": actor_params}, latent)
+        dists = build_actor_dists(
+            pre_dist, is_continuous, distribution, init_std, min_std, unimix=0.0
+        )
+        actions = sample_actor_actions(dists, is_continuous, k_act, is_training)
+        new_state = {
+            "actions": jnp.concatenate(actions, -1),
+            "recurrent": recurrent,
+            "stochastic": stochastic,
+        }
+        return actions, new_state
+
+    @jax.jit
+    def greedy_action(wm_params, actor_params, state, obs, key):
+        return _step(wm_params, actor_params, state, obs, key, is_training=False)
+
+    @jax.jit
+    def exploration_action(wm_params, actor_params, state, obs, key, expl_amount):
+        k_step, k_expl = jax.random.split(key)
+        actions, new_state = _step(wm_params, actor_params, state, obs, k_step, is_training=True)
+        expl = add_exploration_noise(actions, expl_amount, is_continuous, k_expl)
+        new_state = dict(new_state, actions=jnp.concatenate(expl, -1))
+        return expl, new_state
+
+    return {
+        "init_states": init_states,
+        "reset_states": jax.jit(reset_states),
+        "greedy_action": greedy_action,
+        "exploration_action": exploration_action,
+    }

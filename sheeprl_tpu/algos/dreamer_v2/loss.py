@@ -1,0 +1,80 @@
+"""DreamerV2 world-model loss (reference ``sheeprl/algos/dreamer_v2/loss.py``:
+reconstruction_loss :11-105).
+
+Eq. 2 of the DV2 paper: Gaussian NLL of observations/rewards (+ optional
+Bernoulli continue NLL) plus the KL-*balanced* categorical state loss —
+``alpha · KL(sg(post) ‖ prior) + (1−alpha) · KL(post ‖ sg(prior))`` with the
+free-nats clamp applied to the mean (``kl_free_avg``) or element-wise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.distributions import Independent, OneHotCategorical, kl_divergence
+
+sg = jax.lax.stop_gradient
+
+
+def categorical_kl(p_logits: jnp.ndarray, q_logits: jnp.ndarray) -> jnp.ndarray:
+    """KL( Cat(p) ‖ Cat(q) ) summed over the stochastic dim.
+    Logits ``[..., S, D]`` → ``[...]``."""
+    p = Independent(OneHotCategorical(logits=p_logits), 1)
+    q = Independent(OneHotCategorical(logits=q_logits), 1)
+    return kl_divergence(p, q)
+
+
+def reconstruction_loss(
+    po: Dict[str, Any],
+    observations: Dict[str, jnp.ndarray],
+    pr: Any,
+    rewards: jnp.ndarray,
+    priors_logits: jnp.ndarray,
+    posteriors_logits: jnp.ndarray,
+    kl_balancing_alpha: float = 0.8,
+    kl_free_nats: float = 0.0,
+    kl_free_avg: bool = True,
+    kl_regularizer: float = 1.0,
+    pc: Optional[Any] = None,
+    continue_targets: Optional[jnp.ndarray] = None,
+    discount_scale_factor: float = 1.0,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """``priors_logits``/``posteriors_logits``: ``[T, B, S, D]``.
+    Returns ``(scalar_loss, metrics)`` (the reference returns a 6-tuple)."""
+    observation_loss = -sum(jnp.mean(po[k].log_prob(observations[k])) for k in po)
+    reward_loss = -jnp.mean(pr.log_prob(rewards))
+
+    lhs = categorical_kl(sg(posteriors_logits), priors_logits)
+    rhs = categorical_kl(posteriors_logits, sg(priors_logits))
+    free = jnp.asarray(kl_free_nats, lhs.dtype)
+    if kl_free_avg:
+        loss_lhs = jnp.maximum(jnp.mean(lhs), free)
+        loss_rhs = jnp.maximum(jnp.mean(rhs), free)
+    else:
+        loss_lhs = jnp.mean(jnp.maximum(lhs, free))
+        loss_rhs = jnp.mean(jnp.maximum(rhs, free))
+    kl_loss = kl_balancing_alpha * loss_lhs + (1 - kl_balancing_alpha) * loss_rhs
+
+    continue_loss = jnp.zeros(())
+    if pc is not None and continue_targets is not None:
+        continue_loss = discount_scale_factor * -jnp.mean(pc.log_prob(continue_targets))
+
+    total = kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss
+    metrics = {
+        "Loss/world_model_loss": total,
+        "Loss/observation_loss": observation_loss,
+        "Loss/reward_loss": reward_loss,
+        "Loss/state_loss": kl_loss,
+        "Loss/continue_loss": continue_loss,
+        "State/kl": jnp.mean(lhs),
+        "State/post_entropy": jnp.mean(
+            Independent(OneHotCategorical(logits=sg(posteriors_logits)), 1).entropy()
+        ),
+        "State/prior_entropy": jnp.mean(
+            Independent(OneHotCategorical(logits=sg(priors_logits)), 1).entropy()
+        ),
+    }
+    return total, metrics

@@ -1,0 +1,71 @@
+"""DreamerV2 utilities (reference ``sheeprl/algos/dreamer_v2/utils.py``).
+
+- :data:`AGGREGATOR_KEYS` — the metric allow-list (reference :19-36).
+- :func:`compute_lambda_values` — the V2 TD(λ) recursion *with bootstrap*
+  (reference :82-99) as one reversed ``lax.scan``.
+- obs preparation/normalization: V2 pixels are scaled to ``[-0.5, 0.5]``
+  (reference train :112 — ``/255 − 0.5``).
+- :func:`test` re-exports the DV3 greedy-rollout helper (identical contract).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "State/kl",
+    "Params/exploration_amount",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+
+
+def compute_lambda_values(
+    rewards: jnp.ndarray,
+    values: jnp.ndarray,
+    continues: jnp.ndarray,
+    bootstrap: jnp.ndarray,
+    lmbda: float = 0.95,
+) -> jnp.ndarray:
+    """TD(λ) over ``[H, ...]`` with an explicit bootstrap row (reference
+    dv2/utils.py:82-99): ``lv_t = r_t + c_t·( (1−λ)·v_{t+1} + λ·lv_{t+1} )``
+    with ``lv_{H} = bootstrap``. ``bootstrap`` is ``[1, ...]``."""
+    next_values = jnp.concatenate([values[1:], bootstrap], axis=0)
+    inputs = rewards + continues * next_values * (1 - lmbda)
+
+    def step(agg, inp):
+        interm, cont = inp
+        agg = interm + cont * lmbda * agg
+        return agg, agg
+
+    _, lv = jax.lax.scan(step, bootstrap[0], (inputs, continues), reverse=True)
+    return lv
+
+
+def normalize_obs_jnp(obs: Dict[str, jnp.ndarray], cnn_keys) -> Dict[str, jnp.ndarray]:
+    """uint8 pixels → [-0.5, 0.5] floats on device (reference /255 − 0.5)."""
+    return {
+        k: (
+            jnp.asarray(v, jnp.float32) / 255.0 - 0.5
+            if k in cnn_keys
+            else jnp.asarray(v, jnp.float32)
+        )
+        for k, v in obs.items()
+    }

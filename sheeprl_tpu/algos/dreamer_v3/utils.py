@@ -130,11 +130,19 @@ def test(
     log_dir: str,
     test_name: str = "",
     sample_actions: bool = False,
+    normalize_fn=None,
 ):
-    """Greedy episode on a fresh single env (reference utils.py:86-137)."""
+    """Greedy episode on a fresh single env (reference utils.py:86-137).
+
+    ``normalize_fn(obs, cnn_keys)`` overrides the pixel normalization —
+    DV3 uses /255 (default), DV2/DV1 pass their /255−0.5 variant.
+    """
     import gymnasium as gym  # noqa: F401
 
     from sheeprl_tpu.utils.env import make_env
+
+    if normalize_fn is None:
+        normalize_fn = normalize_obs_jnp
 
     env = make_env(
         cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else "")
@@ -149,7 +157,7 @@ def test(
     act_fn = player_fns["exploration_action"] if sample_actions else player_fns["greedy_action"]
     while not done:
         prepared = prepare_obs(obs, cnn_keys, mlp_keys, 1)
-        norm = normalize_obs_jnp(prepared, cnn_keys)
+        norm = normalize_fn(prepared, cnn_keys)
         key, k = jax.random.split(key)
         if sample_actions:
             actions, state = act_fn(

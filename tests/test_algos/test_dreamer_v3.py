@@ -24,7 +24,7 @@ def dv3_args(tmp_path, extra=()):
         "exp=dreamer_v3",
         "fabric.accelerator=cpu",
         "per_rank_batch_size=2",
-        "per_rank_sequence_length=8",
+        "per_rank_sequence_length=1",
         "algo.horizon=4",
         "algo.dense_units=8",
         "algo.mlp_layers=1",
@@ -51,6 +51,27 @@ def devices(request):
 def test_dreamer_v3(tmp_path, devices, env_id, monkeypatch):
     monkeypatch.chdir(tmp_path)
     cli.run(dv3_args(tmp_path, [f"fabric.devices={devices}", f"env.id={env_id}"]))
+
+
+def test_dreamer_v3_temporal_train(tmp_path, monkeypatch):
+    """Non-dry run so the dynamic-learning scan sees T>1 sequences with real
+    action conditioning (the dry run trains on T=1 reset-only steps)."""
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        dv3_args(
+            tmp_path,
+            [
+                "fabric.devices=1",
+                "env.id=discrete_dummy",
+                "dry_run=False",
+                "total_steps=16",
+                "per_rank_sequence_length=4",
+                "buffer.size=128",
+                "algo.learning_starts=8",
+                "algo.train_every=4",
+            ],
+        )
+    )
 
 
 def test_dreamer_v3_checkpoint_resume(tmp_path, monkeypatch):
