@@ -427,6 +427,8 @@ def main(fabric, cfg: Dict[str, Any]):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
         buffer_cls=SequentialReplayBuffer,
     )
+    if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
+        rb.load_state_dict(state["rb"])
 
     train_step = 0
     last_train = 0

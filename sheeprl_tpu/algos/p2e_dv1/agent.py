@@ -1,0 +1,96 @@
+"""Plan2Explore-DV1 agent (reference ``sheeprl/algos/p2e_dv1/agent.py``
+build_agent :30-196 and the ensemble construction in
+``p2e_dv1_exploration.py:430-470``).
+
+DV1 chassis + the P2E additions: a vmapped ensemble predicting the next
+**observation embedding** (the encoder output — unlike V2/V3, which predict
+the next stochastic state), a dual actor, and an exploration critic (no
+target critics anywhere in V1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v1.agent import (
+    Actor,
+    MLPHead,
+    WorldModel,
+    build_player_fns,  # noqa: F401
+)
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    cnn_encoder_output_dim,
+    xavier_normal_initialization,
+)
+from sheeprl_tpu.algos.p2e_dv3.agent import (  # noqa: F401
+    EnsembleMember,
+    apply_ensemble,
+    init_ensemble,
+)
+
+
+def embedding_dim(cfg, cnn_keys, mlp_keys) -> int:
+    """Static size of the encoder output (cnn features ‖ mlp features)."""
+    dim = 0
+    if cnn_keys:
+        dim += cnn_encoder_output_dim(
+            (int(cfg.env.screen_size), int(cfg.env.screen_size)),
+            int(cfg.algo.world_model.encoder.cnn_channels_multiplier),
+        )
+    if mlp_keys:
+        dim += int(cfg.algo.world_model.encoder.dense_units)
+    return dim
+
+
+def build_agent(
+    cfg,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    observation_space,
+    key: jax.Array,
+) -> Tuple[WorldModel, Actor, MLPHead, EnsembleMember, Dict[str, Any]]:
+    """Returns ``(world_model, actor, critic, ensemble_member, params)`` with
+    ``params = {world_model, actor_task, critic_task, actor_exploration,
+    critic_exploration, ensembles}``."""
+    from sheeprl_tpu.algos.dreamer_v1.agent import build_agent as dv1_build_agent
+
+    k_dv1, k_expl_actor, k_expl_critic, k_ens, k_xa, k_xc = jax.random.split(key, 6)
+    world_model, actor, critic, dv1_params = dv1_build_agent(
+        cfg, actions_dim, is_continuous, observation_space, k_dv1
+    )
+    wm_cfg = cfg.algo.world_model
+    latent_size = int(wm_cfg.stochastic_size) + int(wm_cfg.recurrent_model.recurrent_state_size)
+    act_dim = int(np.sum(actions_dim))
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+
+    actor_expl_params = xavier_normal_initialization(
+        actor.init(k_expl_actor, jnp.zeros((1, latent_size)))["params"], k_xa
+    )
+    critic_expl_params = xavier_normal_initialization(
+        critic.init(k_expl_critic, jnp.zeros((1, latent_size)))["params"], k_xc
+    )
+
+    ens_cfg = cfg.algo.ensembles
+    ensemble_member = EnsembleMember(
+        output_dim=embedding_dim(cfg, cnn_keys, mlp_keys),
+        mlp_layers=int(ens_cfg.mlp_layers),
+        dense_units=int(ens_cfg.dense_units),
+        layer_norm=bool(ens_cfg.get("layer_norm", False)),
+        activation=ens_cfg.dense_act,
+    )
+    ensembles = init_ensemble(ensemble_member, int(ens_cfg.n), latent_size + act_dim, k_ens)
+
+    params = {
+        "world_model": dv1_params["world_model"],
+        "actor_task": dv1_params["actor"],
+        "critic_task": dv1_params["critic"],
+        "actor_exploration": actor_expl_params,
+        "critic_exploration": critic_expl_params,
+        "ensembles": ensembles,
+    }
+    return world_model, actor, critic, ensemble_member, params

@@ -1,0 +1,50 @@
+"""P2E-DV1 evaluation (reference ``sheeprl/algos/p2e_dv1/evaluate.py``):
+registered for both phases; always evaluates the **task** actor."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v1.utils import normalize_obs_jnp, test
+from sheeprl_tpu.algos.p2e_dv1.agent import build_agent, build_player_fns
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["p2e_dv1_exploration", "p2e_dv1_finetuning"])
+def evaluate_p2e_dv1(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger, log_dir = create_tensorboard_logger(cfg)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    env.close()
+
+    world_model, actor, critic, _, _ = build_agent(
+        cfg, actions_dim, is_continuous, observation_space, jax.random.PRNGKey(cfg.seed)
+    )
+    params = jax.tree_util.tree_map(np.asarray, state["agent"]["params"])
+    actor_params = params.get("actor_task", params.get("actor"))
+    player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
+    test(
+        player_fns,
+        {"world_model": params["world_model"], "actor": actor_params},
+        fabric, cfg, log_dir, normalize_fn=normalize_obs_jnp,
+    )

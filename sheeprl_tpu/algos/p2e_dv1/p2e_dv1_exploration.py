@@ -1,18 +1,20 @@
-"""DreamerV2 — discrete-latent world model with KL balancing.
+"""Plan2Explore-DV1, exploration phase.
 
-Behavioral contract from the reference ``sheeprl/algos/dreamer_v2/dreamer_v2.py``
-(train :43-426, main :429-870): sequence-replay world-model learning with
-KL-balanced categorical state loss, 15-step imagination with the action
-computed inside the rollout, reinforce/dynamics-mixed actor objective
-(``objective_mix``), Gaussian critic regressed on bootstrapped TD(λ) returns,
-and a hard-copied target critic every ``target_network_update_freq`` steps.
+Behavioral contract from the reference
+``sheeprl/algos/p2e_dv1/p2e_dv1_exploration.py`` (train :38-390, main
+:393-800): DV1 world-model learning, plus
 
-TPU-native design: identical chassis to ``dreamer_v3.py`` — one
-``shard_map``-ped jit per gradient step, ``lax.scan`` over T and H,
-``lax.pmean`` gradients, dynamic tau (here 0/1: hard copy) — with the V2
-losses. Data layout note (reference main :572-745): row *t* of the buffer
-holds the action that *led to* observation *t*, so the dynamic-learning scan
-consumes ``data["actions"]`` unshifted (unlike V3).
+- **ensemble learning** (:200-222): members regress the next *observation
+  embedding* with a unit-Gaussian NLL;
+- **exploration behaviour** (:224-330): DV1-style H-step imagination with
+  the exploration actor; intrinsic reward = ensemble disagreement ×
+  multiplier; pure dynamics-backprop actor loss
+  ``-mean(discount · λ-values)``; Gaussian exploration critic (V1 has no
+  target critics);
+- **task behaviour** (:332-390): the plain DV1 actor-critic update.
+
+TPU-native: one fused ``shard_map``-ped jit per gradient step; the shared
+behaviour closure is instantiated twice (intrinsic / extrinsic reward).
 """
 
 from __future__ import annotations
@@ -28,29 +30,23 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
-from sheeprl_tpu.algos.dreamer_v2.agent import (
+from sheeprl_tpu.algos.dreamer_v1.agent import (
     Actor,
     WorldModel,
-    actor_entropy,
     build_actor_dists,
-    build_agent,
-    build_player_fns,
     resolve_actor_distribution,
     sample_actor_actions,
 )
-from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v2.utils import (
+from sheeprl_tpu.algos.dreamer_v1.loss import gaussian_independent, reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v1.utils import (
     compute_lambda_values,
     normalize_obs_jnp,
     prepare_obs,
     test,
 )
+from sheeprl_tpu.algos.p2e_dv1.agent import apply_ensemble, build_agent, build_player_fns
 from sheeprl_tpu.config.instantiate import instantiate
-from sheeprl_tpu.data.buffers import (
-    EnvIndependentReplayBuffer,
-    EpisodeBuffer,
-    SequentialReplayBuffer,
-)
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.distributions import Bernoulli, Independent, Normal
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
@@ -66,280 +62,266 @@ def build_train_fn(
     world_model: WorldModel,
     actor: Actor,
     critic,
-    world_tx: optax.GradientTransformation,
-    actor_tx: optax.GradientTransformation,
-    critic_tx: optax.GradientTransformation,
+    ensemble_member,
+    txs: Dict[str, optax.GradientTransformation],
     cfg,
     fabric,
     actions_dim: Sequence[int],
     is_continuous: bool,
 ):
-    """Compile one full DreamerV2 gradient step as a single SPMD program.
-
-    Returns ``train_step(agent_state, data, key, tau) -> (agent_state,
-    metrics)``; ``tau`` is 1.0 on hard-copy steps, 0.0 otherwise.
-    """
+    """``train_step(agent_state, data, key) -> (agent_state, metrics)``."""
     axis = fabric.data_axis
     cnn_keys = tuple(cfg.cnn_keys.encoder)
     mlp_keys = tuple(cfg.mlp_keys.encoder)
     wm_cfg = cfg.algo.world_model
-    stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    stoch_size = int(wm_cfg.stochastic_size)
     rec_size = int(wm_cfg.recurrent_model.recurrent_state_size)
     horizon = int(cfg.algo.horizon)
     gamma = float(cfg.algo.gamma)
     lmbda = float(cfg.algo.lmbda)
-    kl_balancing_alpha = float(wm_cfg.kl_balancing_alpha)
-    kl_free_nats = float(wm_cfg.kl_free_nats)
-    kl_free_avg = bool(wm_cfg.kl_free_avg)
-    kl_regularizer = float(wm_cfg.kl_regularizer)
-    discount_scale = float(wm_cfg.discount_scale_factor)
     use_continues = bool(wm_cfg.use_continues)
-    ent_coef = float(cfg.algo.actor.ent_coef)
-    objective_mix = float(cfg.algo.actor.objective_mix)
+    intrinsic_mult = float(cfg.algo.intrinsic_reward_multiplier)
     distribution = resolve_actor_distribution(
         cfg.distribution.get("type", "auto"), is_continuous
     )
     init_std = float(cfg.algo.actor.init_std)
     min_std = float(cfg.algo.actor.min_std)
-    dims = tuple(int(d) for d in actions_dim)
-    splits = list(np.cumsum(dims)[:-1])
 
     def wm_apply(params, method, *args):
         return world_model.apply({"params": params}, *args, method=method)
 
-    # ------------------------------------------------------------------
-    # world-model loss (reference train :104-240)
-    # ------------------------------------------------------------------
+    # -- world model loss: identical to DV1, but the embeddings are also
+    # returned for ensemble training (reference :200-222) ------------------
 
     def wm_loss_fn(wm_params, data, key):
         T, B = data["rewards"].shape[:2]
         batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
         batch_obs.update({k: data[k] for k in mlp_keys})
-        is_first = data["is_first"].at[0].set(1.0)
         embedded = wm_apply(wm_params, WorldModel.encode, batch_obs)
 
         def step(carry, inp):
             posterior, recurrent = carry
-            action, embed, first, k = inp
-            recurrent, posterior, post_logits, prior_logits = world_model.apply(
+            action, embed, k = inp
+            recurrent, posterior, post_ms, prior_ms = world_model.apply(
                 {"params": wm_params},
-                posterior,
-                recurrent,
-                action,
-                embed,
-                first,
-                k,
+                posterior, recurrent, action, embed, k,
                 method=WorldModel.dynamic,
             )
-            return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
+            return (posterior, recurrent), (recurrent, posterior, post_ms, prior_ms)
 
         keys = jax.random.split(key, T)
-        (_, _), (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
+        (_, _), (recurrents, posteriors, post_ms, prior_ms) = jax.lax.scan(
             step,
-            (jnp.zeros((B, stoch_flat)), jnp.zeros((B, rec_size))),
-            (data["actions"], embedded, is_first, keys),
+            (jnp.zeros((B, stoch_size)), jnp.zeros((B, rec_size))),
+            (data["actions"], embedded, keys),
         )
         latents = jnp.concatenate([posteriors, recurrents], -1)
         recon = wm_apply(wm_params, WorldModel.decode, latents)
-        po = {
-            k: Independent(Normal(recon[k], jnp.ones_like(recon[k])), 3 if k in cnn_keys else 1)
-            for k in recon
-        }
-        pr = Independent(Normal(wm_apply(wm_params, WorldModel.reward, latents), 1.0), 1)
+        qo = {k: gaussian_independent(recon[k], 1.0, 3 if k in cnn_keys else 1) for k in recon}
+        qr = gaussian_independent(wm_apply(wm_params, WorldModel.reward, latents), 1.0, 1)
         if use_continues:
-            pc = Independent(Bernoulli(logits=wm_apply(wm_params, WorldModel.continues, latents)), 1)
-            continue_targets = (1.0 - data["dones"]) * gamma
+            qc = Independent(Bernoulli(logits=wm_apply(wm_params, WorldModel.continues, latents)), 1)
+            continue_targets = 1.0 - data["dones"]
         else:
-            pc = continue_targets = None
-        S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
+            qc = continue_targets = None
+        posteriors_dist = Independent(Normal(post_ms[0], post_ms[1]), 1)
+        priors_dist = Independent(Normal(prior_ms[0], prior_ms[1]), 1)
         loss, metrics = reconstruction_loss(
-            po,
-            batch_obs,
-            pr,
-            data["rewards"],
-            prior_logits.reshape(T, B, S, D),
-            post_logits.reshape(T, B, S, D),
-            kl_balancing_alpha,
-            kl_free_nats,
-            kl_free_avg,
-            kl_regularizer,
-            pc,
-            continue_targets,
-            discount_scale,
+            qo, batch_obs, qr, data["rewards"],
+            posteriors_dist, priors_dist,
+            float(wm_cfg.kl_free_nats), float(wm_cfg.kl_regularizer),
+            qc, continue_targets, float(wm_cfg.continue_scale_factor),
         )
-        return loss, (metrics, sg(posteriors), sg(recurrents))
+        return loss, (metrics, sg(posteriors), sg(recurrents), sg(embedded))
 
-    # ------------------------------------------------------------------
-    # actor loss via imagination (reference train :253-398)
-    # ------------------------------------------------------------------
+    # -- ensemble loss (reference :200-222) --------------------------------
+
+    def ensemble_loss_fn(ens_params, posteriors, recurrents, actions, embedded):
+        inp = jnp.concatenate([posteriors, recurrents, actions], -1)
+        out = apply_ensemble(ensemble_member, ens_params, inp)[:, :-1]
+        target = embedded[1:][None]
+        dist = Independent(Normal(out, jnp.ones_like(out)), 1)
+        return -jnp.sum(jnp.mean(dist.log_prob(target), axis=tuple(range(1, out.ndim - 1))))
+
+    # -- DV1 imagination with recorded actions (reference :224-245) --------
 
     def imagination_rollout(wm_params, actor_params, posteriors, recurrents, key):
-        """H-step prior rollout with the action computed inside the loop
-        (reference :299-320). Returns ``(trajectories [H+1, BT, L],
-        actions [H+1, BT, A])`` with ``actions[0] = 0``."""
-        prior = posteriors.reshape(-1, stoch_flat)
+        prior = posteriors.reshape(-1, stoch_size)
         recurrent = recurrents.reshape(-1, rec_size)
-        latent0 = jnp.concatenate([prior, recurrent], -1)
+        latent = jnp.concatenate([prior, recurrent], -1)
 
         def policy(latent, k):
             pre = actor.apply({"params": actor_params}, sg(latent))
-            dists = build_actor_dists(
-                pre, is_continuous, distribution, init_std, min_std, unimix=0.0
-            )
-            return jnp.concatenate(
-                sample_actor_actions(dists, is_continuous, k, True), -1
-            )
+            dists = build_actor_dists(pre, is_continuous, distribution, init_std, min_std, unimix=0.0)
+            return jnp.concatenate(sample_actor_actions(dists, is_continuous, k, True), -1)
 
         def step(carry, k):
             prior, recurrent, latent = carry
             k_img, k_act = jax.random.split(k)
             action = policy(latent, k_act)
             prior, recurrent = world_model.apply(
-                {"params": wm_params},
-                prior,
-                recurrent,
-                action,
-                k_img,
+                {"params": wm_params}, prior, recurrent, action, k_img,
                 method=WorldModel.imagination,
             )
             latent = jnp.concatenate([prior, recurrent], -1)
             return (prior, recurrent, latent), (latent, action)
 
         keys = jax.random.split(key, horizon)
-        _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, latent0), keys)
-        trajectories = jnp.concatenate([latent0[None], latents], 0)
-        actions = jnp.concatenate([jnp.zeros_like(acts[:1]), acts], 0)
-        return trajectories, actions
+        _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, latent), keys)
+        return latents, acts
 
-    def actor_loss_fn(actor_params, wm_params, target_params, posteriors, recurrents,
-                      true_continue, key):
+    # -- shared behaviour-learning actor loss (reference :224-330 / :332-390)
+
+    def behaviour_actor_loss(actor_params, wm_params, critic_params,
+                             posteriors, recurrents, key, reward_fn):
         traj, imagined_actions = imagination_rollout(
             wm_params, actor_params, posteriors, recurrents, key
         )
-        # values from the *target* critic (reference :322-327)
-        predicted_values = critic.apply({"params": target_params}, traj)
-        predicted_rewards = wm_apply(wm_params, WorldModel.reward, traj)
+        predicted_values = critic.apply({"params": critic_params}, traj)
+        reward = reward_fn(traj, imagined_actions)
         if use_continues:
-            continues = jax.nn.sigmoid(wm_apply(wm_params, WorldModel.continues, traj))
-            continues = jnp.concatenate([true_continue[None] * gamma, continues[1:]], 0)
+            continues = jax.nn.sigmoid(wm_apply(wm_params, WorldModel.continues, traj)) * gamma
         else:
-            continues = jnp.ones_like(sg(predicted_rewards)) * gamma
+            continues = jnp.ones_like(sg(reward)) * gamma
 
         lambda_values = compute_lambda_values(
-            predicted_rewards[:-1],
-            predicted_values[:-1],
-            continues[:-1],
-            bootstrap=predicted_values[-1:],
-            lmbda=lmbda,
+            reward, predicted_values, continues,
+            last_values=predicted_values[-1], lmbda=lmbda,
         )
         discount = sg(
-            jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], 0), 0)
+            jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-2]], 0), 0)
         )
-
-        pre = actor.apply({"params": actor_params}, sg(traj[:-2]))
-        policies = build_actor_dists(
-            pre, is_continuous, distribution, init_std, min_std, unimix=0.0
-        )
-
-        # dynamics backprop vs reinforce, mixed (reference :366-383)
-        dynamics = lambda_values[1:]
-        advantage = sg(lambda_values[1:] - predicted_values[:-2])
-        per_head = [
-            p.log_prob(sg(a[1:-1]))[..., None]
-            for p, a in zip(policies, jnp.split(imagined_actions, splits, axis=-1))
-        ]
-        reinforce = sum(per_head) * advantage
-        objective = objective_mix * reinforce + (1 - objective_mix) * dynamics
-        entropy = ent_coef * actor_entropy(policies, distribution)
-        policy_loss = -jnp.mean(discount[:-2] * (objective + entropy[..., None]))
+        policy_loss = -jnp.mean(discount * lambda_values)
         aux = {
             "trajectories": sg(traj),
             "lambda_values": sg(lambda_values),
             "discount": discount,
-            "Loss/policy_loss": policy_loss,
+            "reward_mean": jnp.mean(sg(reward)),
+            "values_mean": jnp.mean(sg(predicted_values)),
         }
         return policy_loss, aux
 
-    # ------------------------------------------------------------------
-    # critic loss (reference train :399-418)
-    # ------------------------------------------------------------------
-
     def critic_loss_fn(critic_params, traj, lambda_values, discount):
         qv = Independent(Normal(critic.apply({"params": critic_params}, traj[:-1]), 1.0), 1)
-        return -jnp.mean(discount[:-1, ..., 0] * qv.log_prob(lambda_values))
+        return -jnp.mean(discount[..., 0] * qv.log_prob(lambda_values))
 
-    # ------------------------------------------------------------------
-    # the fused step
-    # ------------------------------------------------------------------
+    # ----------------------------------------------------------------------
 
-    def local_step(agent_state, data, key, tau):
+    def local_step(agent_state, data, key):
         key = jax.random.fold_in(key, jax.lax.axis_index(axis))
         params = agent_state["params"]
         opt = agent_state["opt"]
 
-        # hard target copy on tau=1 steps (reference main :779-785)
-        target = jax.tree_util.tree_map(
-            lambda c, t: tau * c + (1.0 - tau) * t,
-            params["critic"],
-            params["target_critic"],
-        )
+        k_wm, k_expl, k_task = jax.random.split(key, 3)
 
-        k_wm, k_img = jax.random.split(key)
-
-        (wm_loss, (wm_metrics, posteriors, recurrents)), wm_grads = jax.value_and_grad(
+        (wm_loss, (wm_metrics, posteriors, recurrents, embedded)), wm_grads = jax.value_and_grad(
             wm_loss_fn, has_aux=True
         )(params["world_model"], data, k_wm)
         wm_grads = jax.lax.pmean(wm_grads, axis)
-        wm_updates, wm_opt = world_tx.update(wm_grads, opt["world_model"], params["world_model"])
+        wm_updates, wm_opt = txs["world_model"].update(wm_grads, opt["world_model"], params["world_model"])
         wm_params = optax.apply_updates(params["world_model"], wm_updates)
 
-        true_continue = (1.0 - data["dones"]).reshape(-1, 1)
-        (actor_loss, aux), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
-            params["actor"],
-            wm_params,
-            target,
-            posteriors,
-            recurrents,
-            true_continue,
-            k_img,
+        ens_loss, ens_grads = jax.value_and_grad(ensemble_loss_fn)(
+            params["ensembles"], posteriors, recurrents, data["actions"], embedded
         )
-        actor_grads = jax.lax.pmean(actor_grads, axis)
-        actor_updates, actor_opt = actor_tx.update(actor_grads, opt["actor"], params["actor"])
-        actor_params = optax.apply_updates(params["actor"], actor_updates)
+        ens_grads = jax.lax.pmean(ens_grads, axis)
+        ens_updates, ens_opt = txs["ensembles"].update(ens_grads, opt["ensembles"], params["ensembles"])
+        ens_params = optax.apply_updates(params["ensembles"], ens_updates)
 
-        critic_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(
-            params["critic"],
-            aux["trajectories"],
-            aux["lambda_values"],
-            aux["discount"],
+        def intrinsic_reward_fn(traj, imagined_actions):
+            ens_in = jnp.concatenate([sg(traj), sg(imagined_actions)], -1)
+            pred = apply_ensemble(ensemble_member, ens_params, ens_in)
+            return jnp.var(pred, axis=0).mean(-1, keepdims=True) * intrinsic_mult
+
+        def extrinsic_reward_fn(traj, imagined_actions):
+            del imagined_actions
+            return wm_apply(wm_params, WorldModel.reward, traj)
+
+        # exploration actor + critic
+        (pl_expl, aux_expl), a_expl_grads = jax.value_and_grad(
+            behaviour_actor_loss, has_aux=True
+        )(
+            params["actor_exploration"], wm_params, params["critic_exploration"],
+            posteriors, recurrents, k_expl, intrinsic_reward_fn,
         )
-        critic_grads = jax.lax.pmean(critic_grads, axis)
-        critic_updates, critic_opt = critic_tx.update(critic_grads, opt["critic"], params["critic"])
-        critic_params = optax.apply_updates(params["critic"], critic_updates)
+        a_expl_grads = jax.lax.pmean(a_expl_grads, axis)
+        a_expl_updates, a_expl_opt = txs["actor_exploration"].update(
+            a_expl_grads, opt["actor_exploration"], params["actor_exploration"]
+        )
+        actor_expl_params = optax.apply_updates(params["actor_exploration"], a_expl_updates)
+
+        ce_loss, ce_grads = jax.value_and_grad(critic_loss_fn)(
+            params["critic_exploration"],
+            aux_expl["trajectories"], aux_expl["lambda_values"], aux_expl["discount"],
+        )
+        ce_grads = jax.lax.pmean(ce_grads, axis)
+        ce_updates, ce_opt = txs["critic_exploration"].update(
+            ce_grads, opt["critic_exploration"], params["critic_exploration"]
+        )
+        critic_expl_params = optax.apply_updates(params["critic_exploration"], ce_updates)
+
+        # task actor + critic
+        (pl_task, aux_task), a_task_grads = jax.value_and_grad(
+            behaviour_actor_loss, has_aux=True
+        )(
+            params["actor_task"], wm_params, params["critic_task"],
+            posteriors, recurrents, k_task, extrinsic_reward_fn,
+        )
+        a_task_grads = jax.lax.pmean(a_task_grads, axis)
+        a_task_updates, a_task_opt = txs["actor_task"].update(
+            a_task_grads, opt["actor_task"], params["actor_task"]
+        )
+        actor_task_params = optax.apply_updates(params["actor_task"], a_task_updates)
+
+        ct_loss, ct_grads = jax.value_and_grad(critic_loss_fn)(
+            params["critic_task"],
+            aux_task["trajectories"], aux_task["lambda_values"], aux_task["discount"],
+        )
+        ct_grads = jax.lax.pmean(ct_grads, axis)
+        ct_updates, ct_opt = txs["critic_task"].update(ct_grads, opt["critic_task"], params["critic_task"])
+        critic_task_params = optax.apply_updates(params["critic_task"], ct_updates)
 
         metrics = dict(wm_metrics)
-        metrics["Loss/policy_loss"] = aux["Loss/policy_loss"]
-        metrics["Loss/value_loss"] = critic_loss
+        metrics["Loss/ensemble_loss"] = ens_loss
+        metrics["Loss/policy_loss_exploration"] = pl_expl
+        metrics["Loss/value_loss_exploration"] = ce_loss
+        metrics["Loss/policy_loss_task"] = pl_task
+        metrics["Loss/value_loss_task"] = ct_loss
+        metrics["Rewards/intrinsic"] = aux_expl["reward_mean"]
+        metrics["Values_exploration/predicted_values"] = aux_expl["values_mean"]
+        metrics["Values_exploration/lambda_values"] = jnp.mean(aux_expl["lambda_values"])
         metrics["Grads/world_model"] = optax.global_norm(wm_grads)
-        metrics["Grads/actor"] = optax.global_norm(actor_grads)
-        metrics["Grads/critic"] = optax.global_norm(critic_grads)
+        metrics["Grads/ensemble"] = optax.global_norm(ens_grads)
+        metrics["Grads/actor_exploration"] = optax.global_norm(a_expl_grads)
+        metrics["Grads/critic_exploration"] = optax.global_norm(ce_grads)
+        metrics["Grads/actor_task"] = optax.global_norm(a_task_grads)
+        metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
         metrics = jax.lax.pmean(metrics, axis)
 
         new_state = {
             "params": {
                 "world_model": wm_params,
-                "actor": actor_params,
-                "critic": critic_params,
-                "target_critic": target,
+                "actor_task": actor_task_params,
+                "critic_task": critic_task_params,
+                "actor_exploration": actor_expl_params,
+                "critic_exploration": critic_expl_params,
+                "ensembles": ens_params,
             },
-            "opt": {"world_model": wm_opt, "actor": actor_opt, "critic": critic_opt},
+            "opt": {
+                "world_model": wm_opt,
+                "ensembles": ens_opt,
+                "actor_task": a_task_opt,
+                "critic_task": ct_opt,
+                "actor_exploration": a_expl_opt,
+                "critic_exploration": ce_opt,
+            },
         }
         return new_state, metrics
 
     shmapped = jax.shard_map(
         local_step,
         mesh=fabric.mesh,
-        in_specs=(P(), P(None, axis), P(), P()),
+        in_specs=(P(), P(None, axis), P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -351,7 +333,7 @@ def main(fabric, cfg: Dict[str, Any]):
     world_size = fabric.world_size
     root_key = fabric.seed_everything(cfg.seed)
 
-    # These arguments cannot be changed (reference main :436-438)
+    cfg.algo.player.actor_type = "exploration"
     cfg.env.screen_size = 64
     cfg.env.frame_stack = 1
 
@@ -362,7 +344,6 @@ def main(fabric, cfg: Dict[str, Any]):
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
 
-    # Environment setup — one process drives all devices (SPMD)
     n_envs = int(cfg.env.num_envs) * world_size
     from functools import partial
 
@@ -374,12 +355,9 @@ def main(fabric, cfg: Dict[str, Any]):
         partial(
             RestartOnException,
             make_env(
-                cfg,
-                cfg.seed + i,
-                0,
+                cfg, cfg.seed + i, 0,
                 log_dir if fabric.is_global_zero else None,
-                "train",
-                vector_env_idx=i,
+                "train", vector_env_idx=i,
             ),
         )
         for i in range(n_envs)
@@ -403,45 +381,39 @@ def main(fabric, cfg: Dict[str, Any]):
             "You should specify at least one CNN keys or MLP keys from the cli: "
             "`cnn_keys.encoder=[rgb]` or `mlp_keys.encoder=[state]`"
         )
-    if (
-        len(set(cfg.cnn_keys.encoder).intersection(set(cfg.cnn_keys.decoder))) == 0
-        and len(set(cfg.mlp_keys.encoder).intersection(set(cfg.mlp_keys.decoder))) == 0
-    ):
-        raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
-    if len(set(cfg.cnn_keys.decoder) - set(cfg.cnn_keys.encoder)) > 0:
-        raise RuntimeError(
-            "The CNN keys of the decoder must be contained in the encoder ones. "
-            f"Those keys are decoded without being encoded: {list(set(cfg.cnn_keys.decoder))}"
-        )
-    if len(set(cfg.mlp_keys.decoder) - set(cfg.mlp_keys.encoder)) > 0:
-        raise RuntimeError(
-            "The MLP keys of the decoder must be contained in the encoder ones. "
-            f"Those keys are decoded without being encoded: {list(set(cfg.mlp_keys.decoder))}"
-        )
-    if cfg.metric.log_level > 0:
-        fabric.print("Encoder CNN keys:", cfg.cnn_keys.encoder)
-        fabric.print("Encoder MLP keys:", cfg.mlp_keys.encoder)
-        fabric.print("Decoder CNN keys:", cfg.cnn_keys.decoder)
-        fabric.print("Decoder MLP keys:", cfg.mlp_keys.decoder)
     cnn_keys = list(cfg.cnn_keys.encoder)
     mlp_keys = list(cfg.mlp_keys.encoder)
     obs_keys = cnn_keys + mlp_keys
 
     root_key, build_key = jax.random.split(root_key)
-    world_model, actor, critic, params = build_agent(
+    world_model, actor, critic, ensemble_member, params = build_agent(
         cfg, actions_dim, is_continuous, observation_space, build_key
     )
-    world_tx = instantiate(
-        cfg.algo.world_model.optimizer, max_grad_norm=cfg.algo.world_model.clip_gradients
-    )
-    actor_tx = instantiate(cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients)
-    critic_tx = instantiate(cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients)
+    txs = {
+        "world_model": instantiate(
+            cfg.algo.world_model.optimizer, max_grad_norm=cfg.algo.world_model.clip_gradients
+        ),
+        "ensembles": instantiate(
+            cfg.algo.ensembles.optimizer, max_grad_norm=cfg.algo.ensembles.clip_gradients
+        ),
+        "actor_task": instantiate(cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients),
+        "critic_task": instantiate(cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients),
+        "actor_exploration": instantiate(
+            cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients
+        ),
+        "critic_exploration": instantiate(
+            cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients
+        ),
+    }
     agent_state = {
         "params": params,
         "opt": {
-            "world_model": world_tx.init(params["world_model"]),
-            "actor": actor_tx.init(params["actor"]),
-            "critic": critic_tx.init(params["critic"]),
+            "world_model": txs["world_model"].init(params["world_model"]),
+            "ensembles": txs["ensembles"].init(params["ensembles"]),
+            "actor_task": txs["actor_task"].init(params["actor_task"]),
+            "critic_task": txs["critic_task"].init(params["critic_task"]),
+            "actor_exploration": txs["actor_exploration"].init(params["actor_exploration"]),
+            "critic_exploration": txs["critic_exploration"].init(params["critic_exploration"]),
         },
     }
 
@@ -463,50 +435,28 @@ def main(fabric, cfg: Dict[str, Any]):
     agent_state = jax.device_put(agent_state, fabric.replicated)
 
     train_fn = build_train_fn(
-        world_model,
-        actor,
-        critic,
-        world_tx,
-        actor_tx,
-        critic_tx,
-        cfg,
-        fabric,
-        actions_dim,
-        is_continuous,
+        world_model, actor, critic, ensemble_member, txs, cfg, fabric, actions_dim, is_continuous
     )
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
+
+    def player_actor_params():
+        if cfg.algo.player.actor_type == "exploration":
+            return agent_state["params"]["actor_exploration"]
+        return agent_state["params"]["actor_task"]
 
     aggregator = None
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
-    # Buffer: sequential (per-env sub-buffers) or whole-episode storage
-    # (reference main :545-564)
     buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 8
-    buffer_type = str(cfg.buffer.get("type", "sequential")).lower()
-    if buffer_type == "sequential":
-        rb = EnvIndependentReplayBuffer(
-            max(buffer_size, 8),
-            n_envs,
-            obs_keys=obs_keys,
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
-            buffer_cls=SequentialReplayBuffer,
-        )
-    elif buffer_type == "episode":
-        rb = EpisodeBuffer(
-            max(buffer_size, int(cfg.per_rank_sequence_length)),
-            sequence_length=int(cfg.per_rank_sequence_length),
-            n_envs=n_envs,
-            obs_keys=obs_keys,
-            prioritize_ends=bool(cfg.buffer.get("prioritize_ends", False)),
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
-        )
-    else:
-        raise ValueError(
-            f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
-        )
+    rb = EnvIndependentReplayBuffer(
+        max(buffer_size, 8),
+        n_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
+        buffer_cls=SequentialReplayBuffer,
+    )
     if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
         rb.load_state_dict(state["rb"])
 
@@ -539,28 +489,17 @@ def main(fabric, cfg: Dict[str, Any]):
     if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_update != 0:
         warnings.warn(
             f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
-            f"policy_steps_per_update value ({policy_steps_per_update}), so "
-            "the metrics will be logged at the nearest greater multiple of the "
-            "policy_steps_per_update value."
-        )
-    if cfg.checkpoint.every % policy_steps_per_update != 0:
-        warnings.warn(
-            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
-            f"policy_steps_per_update value ({policy_steps_per_update}), so "
-            "the checkpoint will be saved at the nearest greater multiple of the "
-            "policy_steps_per_update value."
+            f"policy_steps_per_update value ({policy_steps_per_update})."
         )
 
     data_sharding = fabric.sharding(None, fabric.data_axis)
 
-    # First observation: a zero-action is_first row (reference main :614-632)
     o = envs.reset(seed=cfg.seed)[0]
     obs = prepare_obs(o, cnn_keys, mlp_keys, n_envs)
     step_data = {k: obs[k][None] for k in obs_keys}
     step_data["dones"] = np.zeros((1, n_envs, 1), np.float32)
     step_data["actions"] = np.zeros((1, n_envs, int(np.sum(actions_dim))), np.float32)
     step_data["rewards"] = np.zeros((1, n_envs, 1), np.float32)
-    step_data["is_first"] = np.ones((1, n_envs, 1), np.float32)
     rb.add(step_data)
     player_state = player_fns["init_states"](agent_state["params"]["world_model"], n_envs)
 
@@ -569,7 +508,6 @@ def main(fabric, cfg: Dict[str, Any]):
         policy_step += n_envs
 
         with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
-            # Sample an action given the observation received by the environment
             if update <= learning_starts and cfg.checkpoint.resume_from is None:
                 real_actions = actions = np.array(envs.action_space.sample())
                 if not is_continuous:
@@ -587,7 +525,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 root_key, act_key = jax.random.split(root_key)
                 actions_j, player_state = player_fns["exploration_action"](
                     agent_state["params"]["world_model"],
-                    agent_state["params"]["actor"],
+                    player_actor_params(),
                     player_state,
                     norm_obs,
                     act_key,
@@ -601,23 +539,10 @@ def main(fabric, cfg: Dict[str, Any]):
                         [np.argmax(np.asarray(a), axis=-1) for a in actions_j], axis=-1
                     )
 
-            # The next row's is_first mirrors the previous dones
-            # (reference main :675)
-            step_data["is_first"] = step_data["dones"].copy()
             o, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
             dones = np.logical_or(terminated, truncated).astype(np.float32)
-
-        if "restart_on_exception" in infos:
-            for i, env_roe in enumerate(infos["restart_on_exception"]):
-                if env_roe and not dones[i]:
-                    if isinstance(rb, EnvIndependentReplayBuffer):
-                        sub = rb.buffer[i]
-                        last_idx = (sub._pos - 1) % sub.buffer_size
-                        sub["dones"][last_idx] = np.ones_like(sub["dones"][last_idx])
-                        sub["is_first"][last_idx] = np.zeros_like(sub["is_first"][last_idx])
-                    step_data["is_first"][0, i] = 1.0
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             fi = infos["final_info"]
@@ -632,7 +557,6 @@ def main(fabric, cfg: Dict[str, Any]):
                         aggregator.update("Game/ep_len_avg", ep_len)
                     fabric.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        # Save the real next observation (reference main :692-708)
         next_obs_np = {k: np.asarray(o[k]) for k in o}
         dones_idxes = np.nonzero(dones.reshape(-1))[0].tolist()
         real_next_obs = {k: v.copy() for k, v in next_obs_np.items()}
@@ -644,7 +568,6 @@ def main(fabric, cfg: Dict[str, Any]):
                         if k in fo:
                             real_next_obs[k][idx] = np.asarray(fo[k])
 
-        # Row t holds the action that led to observation t (reference :705-720)
         obs_row = prepare_obs(real_next_obs, cnn_keys, mlp_keys, n_envs)
         for k in obs_keys:
             step_data[k] = obs_row[k][None]
@@ -654,21 +577,17 @@ def main(fabric, cfg: Dict[str, Any]):
         step_data["rewards"] = clip_rewards_fn(rewards)[None]
         rb.add(step_data)
 
-        # The *player* continues from the autoreset observation
         obs = prepare_obs(next_obs_np, cnn_keys, mlp_keys, n_envs)
 
         if len(dones_idxes) > 0:
             reset_obs = prepare_obs(
                 {k: next_obs_np[k][dones_idxes] for k in next_obs_np},
-                cnn_keys,
-                mlp_keys,
-                len(dones_idxes),
+                cnn_keys, mlp_keys, len(dones_idxes),
             )
             reset_data = {k: reset_obs[k][None] for k in obs_keys}
             reset_data["dones"] = np.zeros((1, len(dones_idxes), 1), np.float32)
             reset_data["actions"] = np.zeros((1, len(dones_idxes), int(np.sum(actions_dim))), np.float32)
             reset_data["rewards"] = np.zeros((1, len(dones_idxes), 1), np.float32)
-            reset_data["is_first"] = np.ones_like(reset_data["dones"])
             rb.add(reset_data, dones_idxes)
 
             step_data["dones"][:, dones_idxes] = 0.0
@@ -680,13 +599,8 @@ def main(fabric, cfg: Dict[str, Any]):
 
         updates_before_training -= 1
 
-        # Train the agent (reference main :756-800)
         if update >= learning_starts and updates_before_training <= 0:
-            n_samples = (
-                cfg.algo.per_rank_pretrain_steps
-                if update == learning_starts
-                else cfg.algo.per_rank_gradient_steps
-            )
+            n_samples = cfg.algo.per_rank_gradient_steps
             local_data = rb.sample(
                 cfg.per_rank_batch_size * world_size,
                 sequence_length=cfg.per_rank_sequence_length,
@@ -695,20 +609,10 @@ def main(fabric, cfg: Dict[str, Any]):
             with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
                 metrics = None
                 for i in range(n_samples):
-                    tau = (
-                        1.0
-                        if per_rank_gradient_steps % cfg.algo.critic.target_network_update_freq == 0
-                        else 0.0
-                    )
-                    batch = {
-                        k: jnp.asarray(v[i], jnp.float32)
-                        for k, v in local_data.items()
-                    }
+                    batch = {k: jnp.asarray(v[i], jnp.float32) for k, v in local_data.items()}
                     batch = jax.device_put(batch, data_sharding)
                     root_key, train_key = jax.random.split(root_key)
-                    agent_state, metrics = train_fn(
-                        agent_state, batch, train_key, jnp.float32(tau)
-                    )
+                    agent_state, metrics = train_fn(agent_state, batch, train_key)
                     per_rank_gradient_steps += 1
                 if metrics is not None:
                     metrics = jax.device_get(metrics)
@@ -730,7 +634,6 @@ def main(fabric, cfg: Dict[str, Any]):
                 if "Params/exploration_amount" in aggregator:
                     aggregator.update("Params/exploration_amount", expl_amount)
 
-        # Log metrics
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or update == num_updates
         ):
@@ -754,9 +657,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         logger.log_metrics(
                             {
                                 "Time/sps_env_interaction": (
-                                    (policy_step - last_log)
-                                    / world_size
-                                    * cfg.env.action_repeat
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
                                 )
                                 / max(timer_metrics["Time/env_interaction_time"], 1e-9)
                             },
@@ -766,7 +667,6 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        # Checkpoint
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             update == num_updates and cfg.checkpoint.save_last
         ):
@@ -789,12 +689,10 @@ def main(fabric, cfg: Dict[str, Any]):
 
     envs.close()
     if fabric.is_global_zero:
+        final = jax.device_get(agent_state["params"])
         test(
             player_fns,
-            jax.device_get(agent_state["params"]),
-            fabric,
-            cfg,
-            log_dir,
-            sample_actions=False,
+            {"world_model": final["world_model"], "actor": final["actor_task"]},
+            fabric, cfg, log_dir, sample_actions=False,
             normalize_fn=normalize_obs_jnp,
         )

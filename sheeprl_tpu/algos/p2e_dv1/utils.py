@@ -1,0 +1,22 @@
+"""P2E-DV1 utilities (reference ``sheeprl/algos/p2e_dv1/utils.py``):
+metric allow-list for both phases."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.dreamer_v1.utils import AGGREGATOR_KEYS as _DV1_KEYS
+
+AGGREGATOR_KEYS = _DV1_KEYS | {
+    "Loss/ensemble_loss",
+    "Loss/policy_loss_exploration",
+    "Loss/value_loss_exploration",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Rewards/intrinsic",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+    "Grads/ensemble",
+    "Grads/actor_exploration",
+    "Grads/critic_exploration",
+    "Grads/actor_task",
+    "Grads/critic_task",
+}

@@ -1,0 +1,83 @@
+"""Plan2Explore-DV2 agent (reference ``sheeprl/algos/p2e_dv2/agent.py``
+build_agent :33-214 and the ensemble construction in
+``p2e_dv2_exploration.py:560-600``).
+
+DV2 chassis + the P2E additions: a vmapped next-state ensemble (predicting
+the flat posterior), a dual actor, and an exploration critic with its own
+hard-copied target. See ``p2e_dv3/agent.py`` for the stacked-ensemble
+design notes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    Actor,
+    MLPHead,
+    WorldModel,
+    build_player_fns,  # noqa: F401
+    xavier_normal_initialization,
+)
+from sheeprl_tpu.algos.p2e_dv3.agent import (  # noqa: F401
+    EnsembleMember,
+    apply_ensemble,
+    init_ensemble,
+)
+
+
+def build_agent(
+    cfg,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    observation_space,
+    key: jax.Array,
+) -> Tuple[WorldModel, Actor, MLPHead, EnsembleMember, Dict[str, Any]]:
+    """Returns ``(world_model, actor, critic, ensemble_member, params)`` with
+    ``params = {world_model, actor_task, critic_task, target_critic_task,
+    actor_exploration, critic_exploration, target_critic_exploration,
+    ensembles}``."""
+    from sheeprl_tpu.algos.dreamer_v2.agent import build_agent as dv2_build_agent
+
+    k_dv2, k_expl_actor, k_expl_critic, k_ens, k_xa, k_xc = jax.random.split(key, 6)
+    world_model, actor, critic, dv2_params = dv2_build_agent(
+        cfg, actions_dim, is_continuous, observation_space, k_dv2
+    )
+    wm_cfg = cfg.algo.world_model
+    stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    rec_size = int(wm_cfg.recurrent_model.recurrent_state_size)
+    latent_size = stoch_flat + rec_size
+    act_dim = int(np.sum(actions_dim))
+
+    actor_expl_params = xavier_normal_initialization(
+        actor.init(k_expl_actor, jnp.zeros((1, latent_size)))["params"], k_xa
+    )
+    critic_expl_params = xavier_normal_initialization(
+        critic.init(k_expl_critic, jnp.zeros((1, latent_size)))["params"], k_xc
+    )
+
+    ens_cfg = cfg.algo.ensembles
+    ensemble_member = EnsembleMember(
+        output_dim=stoch_flat,
+        mlp_layers=int(ens_cfg.mlp_layers),
+        dense_units=int(ens_cfg.dense_units),
+        layer_norm=bool(ens_cfg.get("layer_norm", False)),
+        activation=ens_cfg.dense_act,
+    )
+    ensembles = init_ensemble(ensemble_member, int(ens_cfg.n), latent_size + act_dim, k_ens)
+
+    params = {
+        "world_model": dv2_params["world_model"],
+        "actor_task": dv2_params["actor"],
+        "critic_task": dv2_params["critic"],
+        "target_critic_task": dv2_params["target_critic"],
+        "actor_exploration": actor_expl_params,
+        "critic_exploration": critic_expl_params,
+        "target_critic_exploration": jax.tree_util.tree_map(jnp.copy, critic_expl_params),
+        "ensembles": ensembles,
+    }
+    return world_model, actor, critic, ensemble_member, params

@@ -1,0 +1,421 @@
+"""Plan2Explore-DV2, finetuning phase (reference
+``sheeprl/algos/p2e_dv2/p2e_dv2_finetuning.py`` :30-500): reload the
+exploration checkpoint, inherit model hyper-parameters from the exploration
+config (done by the CLI), train with the plain DV2 step, and switch the
+player from the exploration actor to the task actor at ``learning_starts``.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import build_train_fn
+from sheeprl_tpu.algos.dreamer_v2.utils import normalize_obs_jnp, prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, build_player_fns
+from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+
+
+def _as_jnp_tree(tree):
+    return jax.tree_util.tree_map(jnp.asarray, tree)
+
+
+@register_algorithm()
+def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
+    world_size = fabric.world_size
+    root_key = fabric.seed_everything(cfg.seed)
+
+    resume_from_checkpoint = bool(cfg.checkpoint.resume_from)
+    ckpt_path = cfg.checkpoint.resume_from or cfg.checkpoint.exploration_ckpt_path
+    state = fabric.load(ckpt_path)
+
+    # All the models must be equal to the ones of the exploration phase
+    for k in ("gamma", "lmbda", "horizon", "layer_norm", "dense_units", "mlp_layers",
+              "dense_act", "cnn_act"):
+        cfg.algo[k] = exploration_cfg.algo[k]
+    cfg.algo.world_model = exploration_cfg.algo.world_model
+    cfg.algo.actor = exploration_cfg.algo.actor
+    cfg.algo.critic = exploration_cfg.algo.critic
+    cfg.algo.ensembles = exploration_cfg.algo.ensembles
+    cfg.env.clip_rewards = exploration_cfg.env.clip_rewards
+    cfg.cnn_keys = exploration_cfg.cnn_keys
+    cfg.mlp_keys = exploration_cfg.mlp_keys
+    cfg.env.screen_size = 64
+    cfg.env.frame_stack = 1
+    if cfg.buffer.get("load_from_exploration", False) and exploration_cfg.buffer.checkpoint:
+        cfg.env.num_envs = exploration_cfg.env.num_envs
+    if resume_from_checkpoint:
+        cfg.per_rank_batch_size = int(np.asarray(state["batch_size"])) // world_size
+
+    logger, log_dir = create_tensorboard_logger(cfg)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    n_envs = int(cfg.env.num_envs) * world_size
+    from functools import partial
+
+    from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+    from sheeprl_tpu.envs.wrappers import RestartOnException
+
+    thunks = [
+        partial(
+            RestartOnException,
+            make_env(
+                cfg, cfg.seed + i, 0,
+                log_dir if fabric.is_global_zero else None,
+                "train", vector_env_idx=i,
+            ),
+        )
+        for i in range(n_envs)
+    ]
+    envs = SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+
+    root_key, build_key = jax.random.split(root_key)
+    world_model, actor, critic, _, _ = build_agent(
+        cfg, actions_dim, is_continuous, observation_space, build_key
+    )
+
+    if resume_from_checkpoint:
+        params = _as_jnp_tree(state["agent"]["params"])
+        actor_expl_params = _as_jnp_tree(state["actor_exploration"])
+        expl_decay_steps = int(np.asarray(state["expl_decay_steps"]))
+    else:
+        expl = state["agent"]["params"]
+        params = _as_jnp_tree(
+            {
+                "world_model": expl["world_model"],
+                "actor": expl["actor_task"],
+                "critic": expl["critic_task"],
+                "target_critic": expl["target_critic_task"],
+            }
+        )
+        actor_expl_params = _as_jnp_tree(expl["actor_exploration"])
+        expl_decay_steps = int(np.asarray(state["expl_decay_steps"]))
+
+    world_tx = instantiate(
+        cfg.algo.world_model.optimizer, max_grad_norm=cfg.algo.world_model.clip_gradients
+    )
+    actor_tx = instantiate(cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients)
+    critic_tx = instantiate(cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients)
+    agent_state = {
+        "params": params,
+        "opt": {
+            "world_model": world_tx.init(params["world_model"]),
+            "actor": actor_tx.init(params["actor"]),
+            "critic": critic_tx.init(params["critic"]),
+        },
+    }
+    if resume_from_checkpoint:
+        from sheeprl_tpu.utils.utils import conform_pytree
+
+        agent_state["opt"] = _as_jnp_tree(
+            conform_pytree(jax.device_get(agent_state["opt"]), state["agent"]["opt"])
+        )
+    agent_state = jax.device_put(agent_state, fabric.replicated)
+    actor_expl_params = jax.device_put(actor_expl_params, fabric.replicated)
+
+    train_fn = build_train_fn(
+        world_model, actor, critic, world_tx, actor_tx, critic_tx,
+        cfg, fabric, actions_dim, is_continuous,
+    )
+    player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
+    player_actor_type = str(cfg.algo.player.actor_type)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 8
+    rb = EnvIndependentReplayBuffer(
+        max(buffer_size, 8),
+        n_envs,
+        obs_keys=obs_keys,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
+        buffer_cls=SequentialReplayBuffer,
+    )
+    if "rb" in state and (
+        (resume_from_checkpoint and cfg.buffer.get("checkpoint", False))
+        or (not resume_from_checkpoint and cfg.buffer.get("load_from_exploration", False))
+    ):
+        rb.load_state_dict(state["rb"])
+
+    train_step = 0
+    last_train = 0
+    start_step = int(np.asarray(state["update"])) // world_size if resume_from_checkpoint else 1
+    policy_step = int(np.asarray(state["update"])) * cfg.env.num_envs if resume_from_checkpoint else 0
+    last_log = int(np.asarray(state["last_log"])) if resume_from_checkpoint else 0
+    last_checkpoint = int(np.asarray(state["last_checkpoint"])) if resume_from_checkpoint else 0
+    policy_steps_per_update = int(n_envs)
+    updates_before_training = (
+        cfg.algo.train_every // policy_steps_per_update if not cfg.dry_run else 0
+    )
+    num_updates = int(cfg.total_steps // policy_steps_per_update) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_update if not cfg.dry_run else 0
+    if resume_from_checkpoint and not cfg.buffer.checkpoint:
+        learning_starts += start_step
+    max_step_expl_decay = cfg.algo.actor.max_step_expl_decay // (
+        cfg.algo.per_rank_gradient_steps * world_size
+    ) if cfg.algo.actor.max_step_expl_decay else 0
+    expl_amount = float(cfg.algo.actor.expl_amount)
+    if resume_from_checkpoint:
+        expl_amount = polynomial_decay(
+            expl_decay_steps,
+            initial=cfg.algo.actor.expl_amount,
+            final=cfg.algo.actor.expl_min,
+            max_decay_steps=max_step_expl_decay,
+        )
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_update != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_update value ({policy_steps_per_update})."
+        )
+
+    data_sharding = fabric.sharding(None, fabric.data_axis)
+
+    o = envs.reset(seed=cfg.seed)[0]
+    obs = prepare_obs(o, cnn_keys, mlp_keys, n_envs)
+    step_data = {k: obs[k][None] for k in obs_keys}
+    step_data["dones"] = np.zeros((1, n_envs, 1), np.float32)
+    step_data["actions"] = np.zeros((1, n_envs, int(np.sum(actions_dim))), np.float32)
+    step_data["rewards"] = np.zeros((1, n_envs, 1), np.float32)
+    step_data["is_first"] = np.ones((1, n_envs, 1), np.float32)
+    rb.add(step_data)
+    player_state = player_fns["init_states"](agent_state["params"]["world_model"], n_envs)
+
+    def player_actor_params():
+        if player_actor_type == "exploration":
+            return actor_expl_params
+        return agent_state["params"]["actor"]
+
+    per_rank_gradient_steps = 0
+    for update in range(start_step, num_updates + 1):
+        policy_step += n_envs
+
+        if update >= learning_starts and player_actor_type == "exploration":
+            player_actor_type = "task"
+
+        with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+            norm_obs = normalize_obs_jnp(obs, cnn_keys)
+            root_key, act_key = jax.random.split(root_key)
+            actions_j, player_state = player_fns["exploration_action"](
+                agent_state["params"]["world_model"],
+                player_actor_params(),
+                player_state,
+                norm_obs,
+                act_key,
+                jnp.float32(expl_amount),
+            )
+            actions = np.concatenate([np.asarray(a) for a in actions_j], -1)
+            if is_continuous:
+                real_actions = actions
+            else:
+                real_actions = np.stack(
+                    [np.argmax(np.asarray(a), axis=-1) for a in actions_j], axis=-1
+                )
+
+            step_data["is_first"] = step_data["dones"].copy()
+            o, rewards, terminated, truncated, infos = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated).astype(np.float32)
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            fi = infos["final_info"]
+            if isinstance(fi, dict) and "episode" in fi:
+                mask = np.asarray(fi.get("_episode", []), dtype=bool)
+                for i in np.nonzero(mask)[0]:
+                    ep_rew = float(fi["episode"]["r"][i])
+                    ep_len = float(fi["episode"]["l"][i])
+                    if aggregator and "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if aggregator and "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                    fabric.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        next_obs_np = {k: np.asarray(o[k]) for k in o}
+        dones_idxes = np.nonzero(dones.reshape(-1))[0].tolist()
+        real_next_obs = {k: v.copy() for k, v in next_obs_np.items()}
+        if "final_obs" in infos and len(dones_idxes) > 0:
+            for idx in dones_idxes:
+                fo = infos["final_obs"][idx]
+                if fo is not None:
+                    for k in real_next_obs:
+                        if k in fo:
+                            real_next_obs[k][idx] = np.asarray(fo[k])
+
+        obs_row = prepare_obs(real_next_obs, cnn_keys, mlp_keys, n_envs)
+        for k in obs_keys:
+            step_data[k] = obs_row[k][None]
+        rewards = np.asarray(rewards, np.float32).reshape(n_envs, 1)
+        step_data["dones"] = dones.reshape(1, n_envs, 1)
+        step_data["actions"] = actions.reshape(1, n_envs, -1).astype(np.float32)
+        step_data["rewards"] = clip_rewards_fn(rewards)[None]
+        rb.add(step_data)
+
+        obs = prepare_obs(next_obs_np, cnn_keys, mlp_keys, n_envs)
+
+        if len(dones_idxes) > 0:
+            reset_obs = prepare_obs(
+                {k: next_obs_np[k][dones_idxes] for k in next_obs_np},
+                cnn_keys, mlp_keys, len(dones_idxes),
+            )
+            reset_data = {k: reset_obs[k][None] for k in obs_keys}
+            reset_data["dones"] = np.zeros((1, len(dones_idxes), 1), np.float32)
+            reset_data["actions"] = np.zeros((1, len(dones_idxes), int(np.sum(actions_dim))), np.float32)
+            reset_data["rewards"] = np.zeros((1, len(dones_idxes), 1), np.float32)
+            reset_data["is_first"] = np.ones_like(reset_data["dones"])
+            rb.add(reset_data, dones_idxes)
+
+            step_data["dones"][:, dones_idxes] = 0.0
+            reset_mask = np.zeros((n_envs, 1), np.float32)
+            reset_mask[dones_idxes] = 1.0
+            player_state = player_fns["reset_states"](
+                agent_state["params"]["world_model"], player_state, jnp.asarray(reset_mask)
+            )
+
+        updates_before_training -= 1
+
+        if update >= learning_starts and updates_before_training <= 0:
+            n_samples = (
+                cfg.algo.per_rank_pretrain_steps
+                if update == learning_starts
+                else cfg.algo.per_rank_gradient_steps
+            )
+            local_data = rb.sample(
+                cfg.per_rank_batch_size * world_size,
+                sequence_length=cfg.per_rank_sequence_length,
+                n_samples=n_samples,
+            )
+            with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+                metrics = None
+                for i in range(n_samples):
+                    tau = (
+                        1.0
+                        if per_rank_gradient_steps % cfg.algo.critic.target_network_update_freq == 0
+                        else 0.0
+                    )
+                    batch = {k: jnp.asarray(v[i], jnp.float32) for k, v in local_data.items()}
+                    batch = jax.device_put(batch, data_sharding)
+                    root_key, train_key = jax.random.split(root_key)
+                    agent_state, metrics = train_fn(
+                        agent_state, batch, train_key, jnp.float32(tau)
+                    )
+                    per_rank_gradient_steps += 1
+                if metrics is not None:
+                    metrics = jax.device_get(metrics)
+                train_step += world_size
+            updates_before_training = cfg.algo.train_every // policy_steps_per_update
+            if cfg.algo.actor.expl_decay:
+                expl_decay_steps += 1
+                expl_amount = polynomial_decay(
+                    expl_decay_steps,
+                    initial=cfg.algo.actor.expl_amount,
+                    final=cfg.algo.actor.expl_min,
+                    max_decay_steps=max_step_expl_decay,
+                )
+            if aggregator and not aggregator.disabled:
+                if metrics is not None:
+                    for k, v in metrics.items():
+                        if k in aggregator:
+                            aggregator.update(k, float(np.asarray(v)))
+                if "Params/exploration_amount" in aggregator:
+                    aggregator.update("Params/exploration_amount", expl_amount)
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or update == num_updates
+        ):
+            if aggregator and not aggregator.disabled:
+                metrics_dict = aggregator.compute()
+                if logger is not None:
+                    logger.log_metrics(metrics_dict, policy_step)
+                aggregator.reset()
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if logger is not None:
+                    if timer_metrics.get("Time/train_time"):
+                        logger.log_metrics(
+                            {
+                                "Time/sps_train": (train_step - last_train)
+                                / max(timer_metrics["Time/train_time"], 1e-9)
+                            },
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time"):
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / max(timer_metrics["Time/env_interaction_time"], 1e-9)
+                            },
+                            policy_step,
+                        )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            update == num_updates and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": jax.device_get(agent_state),
+                "actor_exploration": jax.device_get(actor_expl_params),
+                "expl_decay_steps": expl_decay_steps,
+                "update": update * world_size,
+                "batch_size": cfg.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path_out = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{fabric.global_rank}")
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path_out,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
+            )
+
+    envs.close()
+    if fabric.is_global_zero:
+        final = jax.device_get(agent_state["params"])
+        test(
+            player_fns,
+            {"world_model": final["world_model"], "actor": final["actor"]},
+            fabric, cfg, log_dir, sample_actions=False,
+            normalize_fn=normalize_obs_jnp,
+        )

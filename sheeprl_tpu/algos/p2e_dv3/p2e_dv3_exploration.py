@@ -1,27 +1,27 @@
-"""DreamerV3 — the flagship model-based algorithm.
+"""Plan2Explore-DV3, exploration phase.
 
-Behavioral contract from the reference ``sheeprl/algos/dreamer_v3/dreamer_v3.py``
-(train :49-378, main :381-832): sequence-replay world-model learning
-(posterior scan over T=64), 15-step imagination for actor-critic learning with
-percentile-normalized λ-returns, two-hot critic with EMA target regularizer,
-ε-greedy env interaction gated by ``learning_starts``/``train_every``.
+Behavioral contract from the reference
+``sheeprl/algos/p2e_dv3/p2e_dv3_exploration.py`` (train :45-560, main
+:563-1125): DV3 world-model learning, plus
 
-TPU-native design (NOT a translation):
+- **ensemble learning** (:246-271): every member regresses the *next*
+  stochastic state from ``(posterior, recurrent, action)`` with an MSE
+  objective;
+- **exploration behaviour** (:276-421): imagination with the exploration
+  actor; per-critic rewards — ``intrinsic`` = ensemble-disagreement
+  (variance over members of the predicted next state, :318-333) ×
+  ``intrinsic_reward_multiplier``, ``task`` = the world-model reward head —
+  each with its own two-hot critic, EMA target, and Moments normalizer;
+  the actor objective sums the per-critic normalized advantages weighted by
+  ``weight / Σweights`` (:306-350);
+- **task behaviour** (:426-540): the plain DV3 actor-critic update so the
+  task policy is ready for finetuning.
 
-- **One jitted SPMD program per gradient step.** The reference runs three
-  separate backward/step passes plus a Python GRU loop per batch; here the
-  target-EMA, world-model update, imagination rollout, actor update, critic
-  update, and Moments state all live in a single ``shard_map``-ped jit with
-  the batch dim sharded over the mesh's ``data`` axis. Sequence (T) and
-  horizon (H) loops are ``lax.scan``; XLA fuses the GRU cell across steps.
-- **Gradient psum via shardings.** Each of the three losses takes
-  ``lax.pmean`` on its grads over the data axis — the DDP allreduce —
-  and the Moments percentile EMA all-gathers λ-returns across the mesh
-  (reference utils.py:61), keeping bitwise 1-vs-N invariance of the math.
-- **Stateless cadences.** Target-EMA cadence (tau ∈ {0, τ, 1}) and
-  exploration amount enter as dynamic scalars: no recompiles.
-- The whole agent (3 param trees + target + 3 optax states + moments) is one
-  pytree, donated through the step: params stay resident in HBM.
+TPU-native design: ONE fused ``shard_map``-ped jit per gradient step covering
+all six updates (world model, ensembles, exploration actor, N exploration
+critics, task actor, task critic); the ensemble runs as a single vmapped
+apply (see ``agent.py``); batch dim sharded over the mesh with ``pmean``
+grads; Moments all-gather per critic.
 """
 
 from __future__ import annotations
@@ -40,10 +40,9 @@ from jax.sharding import PartitionSpec as P
 from sheeprl_tpu.algos.dreamer_v3.agent import (
     Actor,
     WorldModel,
-    build_actor_dists,
-    build_agent,
-    build_player_fns,
     actor_entropy,
+    build_actor_dists,
+    resolve_actor_distribution,
     sample_actor_actions,
 )
 from sheeprl_tpu.algos.dreamer_v3.loss import continue_distribution, reconstruction_loss
@@ -55,6 +54,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     test,
     update_moments,
 )
+from sheeprl_tpu.algos.p2e_dv3.agent import apply_ensemble, build_agent, build_player_fns
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.distributions import MSEDistribution, SymlogDistribution, TwoHotEncodingDistribution
@@ -72,19 +72,16 @@ def build_train_fn(
     world_model: WorldModel,
     actor: Actor,
     critic,
-    world_tx: optax.GradientTransformation,
-    actor_tx: optax.GradientTransformation,
-    critic_tx: optax.GradientTransformation,
+    ensemble_member,
+    txs: Dict[str, optax.GradientTransformation],
     cfg,
     fabric,
     actions_dim: Sequence[int],
     is_continuous: bool,
 ):
-    """Compile one full DreamerV3 gradient step as a single SPMD program.
+    """One fused SPMD gradient step for the exploration phase.
 
-    Returns ``train_step(agent_state, data, key, tau) -> (agent_state,
-    metrics)`` where ``data`` leaves are ``[T, B_total, ...]`` (B sharded over
-    the mesh) and ``tau`` is the dynamic target-EMA coefficient (0 = skip).
+    ``train_step(agent_state, data, key, tau) -> (agent_state, metrics)``.
     """
     axis = fabric.data_axis
     cnn_keys = tuple(cfg.cnn_keys.encoder)
@@ -97,14 +94,8 @@ def build_train_fn(
     horizon = int(cfg.algo.horizon)
     gamma = float(cfg.algo.gamma)
     lmbda = float(cfg.algo.lmbda)
-    kl_dynamic = float(wm_cfg.kl_dynamic)
-    kl_representation = float(wm_cfg.kl_representation)
-    kl_free_nats = float(wm_cfg.kl_free_nats)
-    kl_regularizer = float(wm_cfg.kl_regularizer)
-    continue_scale = float(wm_cfg.continue_scale_factor)
     ent_coef = float(cfg.algo.actor.ent_coef)
-    from sheeprl_tpu.algos.dreamer_v3.agent import resolve_actor_distribution
-
+    intrinsic_mult = float(cfg.algo.intrinsic_reward_multiplier)
     distribution = resolve_actor_distribution(
         cfg.distribution.get("type", "auto"), is_continuous
     )
@@ -112,26 +103,30 @@ def build_train_fn(
     min_std = float(cfg.algo.actor.min_std)
     unimix = float(cfg.algo.unimix)
     moments_cfg = cfg.algo.actor.moments
-    m_decay = float(moments_cfg.decay)
-    m_max = float(moments_cfg.max)
-    m_low = float(moments_cfg.percentile.low)
-    m_high = float(moments_cfg.percentile.high)
+    m_args = (
+        float(moments_cfg.decay),
+        float(moments_cfg.max),
+        float(moments_cfg.percentile.low),
+        float(moments_cfg.percentile.high),
+    )
     dims = tuple(int(d) for d in actions_dim)
     splits = list(np.cumsum(dims)[:-1])
+    critics_cfg = {
+        k: {"weight": float(v["weight"]), "reward_type": str(v["reward_type"])}
+        for k, v in cfg.algo.critics_exploration.items()
+    }
+    weights_sum = sum(c["weight"] for c in critics_cfg.values())
 
     def wm_apply(params, method, *args):
         return world_model.apply({"params": params}, *args, method=method)
 
-    # ------------------------------------------------------------------
-    # world-model loss (reference train :104-194)
-    # ------------------------------------------------------------------
+    # -- world model loss: identical to DV3 (reference train :121-245) -----
 
     def wm_loss_fn(wm_params, data, key):
         T, B = data["rewards"].shape[:2]
         batch_obs = {k: data[k] / 255.0 for k in cnn_keys}
         batch_obs.update({k: data[k] for k in mlp_keys})
         is_first = data["is_first"].at[0].set(1.0)
-        # shift: the action column becomes "action that led here"
         batch_actions = jnp.concatenate(
             [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
         )
@@ -142,12 +137,7 @@ def build_train_fn(
             action, embed, first, k = inp
             recurrent, posterior, post_logits, prior_logits = world_model.apply(
                 {"params": wm_params},
-                posterior,
-                recurrent,
-                action,
-                embed,
-                first,
-                k,
+                posterior, recurrent, action, embed, first, k,
                 method=WorldModel.dynamic,
             )
             return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
@@ -165,47 +155,37 @@ def build_train_fn(
         pr = TwoHotEncodingDistribution(
             wm_apply(wm_params, WorldModel.reward_logits, latents), dims=1
         )
-        pc = continue_distribution(
-            wm_apply(wm_params, WorldModel.continue_logits, latents)
-        )
+        pc = continue_distribution(wm_apply(wm_params, WorldModel.continue_logits, latents))
         S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
         loss, metrics = reconstruction_loss(
-            po,
-            batch_obs,
-            pr,
-            data["rewards"],
-            prior_logits.reshape(T, B, S, D),
-            post_logits.reshape(T, B, S, D),
-            kl_dynamic,
-            kl_representation,
-            kl_free_nats,
-            kl_regularizer,
-            pc,
-            1.0 - data["dones"],
-            continue_scale,
+            po, batch_obs, pr, data["rewards"],
+            prior_logits.reshape(T, B, S, D), post_logits.reshape(T, B, S, D),
+            float(wm_cfg.kl_dynamic), float(wm_cfg.kl_representation),
+            float(wm_cfg.kl_free_nats), float(wm_cfg.kl_regularizer),
+            pc, 1.0 - data["dones"], float(wm_cfg.continue_scale_factor),
         )
         return loss, (metrics, sg(posteriors), sg(recurrents))
 
-    # ------------------------------------------------------------------
-    # actor loss via imagination (reference train :230-345)
-    # ------------------------------------------------------------------
+    # -- ensemble loss (reference train :246-271) --------------------------
+
+    def ensemble_loss_fn(ens_params, posteriors, recurrents, actions):
+        inp = jnp.concatenate([posteriors, recurrents, actions], -1)
+        out = apply_ensemble(ensemble_member, ens_params, inp)[:, :-1]
+        target = posteriors[1:][None]
+        dist = MSEDistribution(out, dims=1)
+        return -jnp.sum(jnp.mean(dist.log_prob(target), axis=tuple(range(1, out.ndim - 1))))
+
+    # -- imagination with a given actor (reference :276-303 / :426-455) ----
 
     def imagination_rollout(wm_params, actor_params, posteriors, recurrents, key):
-        """15-step prior rollout from every (t, b) posterior. Returns
-        ``(trajectories [H+1, BT, L], actions [H+1, BT, A])`` with gradients
-        flowing through the actor's straight-through/rsample actions."""
         prior = posteriors.reshape(-1, stoch_flat)
         recurrent = recurrents.reshape(-1, rec_size)
         latent0 = jnp.concatenate([prior, recurrent], -1)
 
         def policy(latent, k):
             pre = actor.apply({"params": actor_params}, sg(latent))
-            dists = build_actor_dists(
-                pre, is_continuous, distribution, init_std, min_std, unimix
-            )
-            return jnp.concatenate(
-                sample_actor_actions(dists, is_continuous, k, True), -1
-            )
+            dists = build_actor_dists(pre, is_continuous, distribution, init_std, min_std, unimix)
+            return jnp.concatenate(sample_actor_actions(dists, is_continuous, k, True), -1)
 
         k0, key = jax.random.split(key)
         a0 = policy(latent0, k0)
@@ -214,11 +194,7 @@ def build_train_fn(
             prior, recurrent, action = carry
             k_img, k_act = jax.random.split(k)
             prior, recurrent = world_model.apply(
-                {"params": wm_params},
-                prior,
-                recurrent,
-                action,
-                k_img,
+                {"params": wm_params}, prior, recurrent, action, k_img,
                 method=WorldModel.imagination,
             )
             latent = jnp.concatenate([prior, recurrent], -1)
@@ -227,19 +203,96 @@ def build_train_fn(
 
         keys = jax.random.split(key, horizon)
         _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, a0), keys)
-        trajectories = jnp.concatenate([latent0[None], latents], 0)
-        actions = jnp.concatenate([a0[None], acts], 0)
-        return trajectories, actions
+        return (
+            jnp.concatenate([latent0[None], latents], 0),
+            jnp.concatenate([a0[None], acts], 0),
+        )
 
-    def actor_loss_fn(actor_params, wm_params, critic_params, posteriors, recurrents,
-                      true_continue, moments_state, key):
+    def _discrete_objective(policies, imagined_actions, advantage):
+        per_head = [
+            p.log_prob(sg(a))[..., None][:-1]
+            for p, a in zip(policies, jnp.split(imagined_actions, splits, axis=-1))
+        ]
+        return sum(per_head) * sg(advantage)
+
+    # -- exploration actor loss (reference :276-395) ------------------------
+
+    def actor_expl_loss_fn(actor_params, wm_params, ens_params, critics_params,
+                           posteriors, recurrents, true_continue, moments_expl, key):
         traj, imagined_actions = imagination_rollout(
             wm_params, actor_params, posteriors, recurrents, key
         )
-        predicted_values = TwoHotEncodingDistribution(
+        continues = continue_distribution(
+            wm_apply(wm_params, WorldModel.continue_logits, traj)
+        ).base.mode
+        continues = jnp.concatenate([true_continue[None], continues[1:]], 0)
+        discount = sg(jnp.cumprod(continues * gamma, axis=0) / gamma)
+
+        # intrinsic reward: variance over members of the predicted next state
+        ens_in = jnp.concatenate([sg(traj), sg(imagined_actions)], -1)
+        next_state_pred = apply_ensemble(ensemble_member, ens_params, ens_in)
+        intrinsic_reward = (
+            jnp.var(next_state_pred, axis=0).mean(-1, keepdims=True) * intrinsic_mult
+        )
+
+        advantage = 0.0
+        new_moments = {}
+        aux_critic = {}
+        metrics = {}
+        for k, ccfg in critics_cfg.items():
+            values = TwoHotEncodingDistribution(
+                critic.apply({"params": critics_params[k]["module"]}, traj), dims=1
+            ).mean
+            if ccfg["reward_type"] == "intrinsic":
+                reward = intrinsic_reward
+                metrics[f"Rewards/intrinsic_{k}"] = jnp.mean(sg(reward))
+            else:
+                reward = TwoHotEncodingDistribution(
+                    wm_apply(wm_params, WorldModel.reward_logits, traj), dims=1
+                ).mean
+            lambda_values = compute_lambda_values(
+                reward[1:], values[1:], continues[1:] * gamma, lmbda
+            )
+            nm, offset, invscale = update_moments(
+                moments_expl[k], lambda_values, *m_args, axis_name=axis
+            )
+            new_moments[k] = nm
+            advantage = advantage + (
+                (lambda_values - offset) / invscale - (values[:-1] - offset) / invscale
+            ) * (ccfg["weight"] / weights_sum)
+            aux_critic[k] = {"lambda_values": sg(lambda_values)}
+            metrics[f"Values_exploration/predicted_values_{k}"] = jnp.mean(sg(values))
+            metrics[f"Values_exploration/lambda_values_{k}"] = jnp.mean(sg(lambda_values))
+
+        pre = actor.apply({"params": actor_params}, sg(traj))
+        policies = build_actor_dists(pre, is_continuous, distribution, init_std, min_std, unimix)
+        if is_continuous:
+            objective = advantage
+        else:
+            objective = _discrete_objective(policies, imagined_actions, advantage)
+        entropy = ent_coef * actor_entropy(policies, distribution)
+        policy_loss = -jnp.mean(discount[:-1] * (objective + entropy[..., None][:-1]))
+        aux = {
+            "trajectories": sg(traj),
+            "discount": discount,
+            "critics": aux_critic,
+            "moments": new_moments,
+            "metrics": metrics,
+            "Loss/policy_loss_exploration": policy_loss,
+        }
+        return policy_loss, aux
+
+    # -- task actor loss: plain DV3 (reference :426-521) ---------------------
+
+    def actor_task_loss_fn(actor_params, wm_params, critic_params, posteriors, recurrents,
+                           true_continue, moments_task, key):
+        traj, imagined_actions = imagination_rollout(
+            wm_params, actor_params, posteriors, recurrents, key
+        )
+        values = TwoHotEncodingDistribution(
             critic.apply({"params": critic_params}, traj), dims=1
         ).mean
-        predicted_rewards = TwoHotEncodingDistribution(
+        rewards = TwoHotEncodingDistribution(
             wm_apply(wm_params, WorldModel.reward_logits, traj), dims=1
         ).mean
         continues = continue_distribution(
@@ -248,29 +301,20 @@ def build_train_fn(
         continues = jnp.concatenate([true_continue[None], continues[1:]], 0)
 
         lambda_values = compute_lambda_values(
-            predicted_rewards[1:], predicted_values[1:], continues[1:] * gamma, lmbda
+            rewards[1:], values[1:], continues[1:] * gamma, lmbda
         )
         discount = sg(jnp.cumprod(continues * gamma, axis=0) / gamma)
+        new_moments, offset, invscale = update_moments(
+            moments_task, lambda_values, *m_args, axis_name=axis
+        )
+        advantage = (lambda_values - offset) / invscale - (values[:-1] - offset) / invscale
 
         pre = actor.apply({"params": actor_params}, sg(traj))
-        policies = build_actor_dists(
-            pre, is_continuous, distribution, init_std, min_std, unimix
-        )
-
-        baseline = predicted_values[:-1]
-        new_moments, offset, invscale = update_moments(
-            moments_state, lambda_values, m_decay, m_max, m_low, m_high, axis_name=axis
-        )
-        advantage = (lambda_values - offset) / invscale - (baseline - offset) / invscale
-
+        policies = build_actor_dists(pre, is_continuous, distribution, init_std, min_std, unimix)
         if is_continuous:
             objective = advantage
         else:
-            per_head = [
-                p.log_prob(sg(a))[..., None][:-1]
-                for p, a in zip(policies, jnp.split(imagined_actions, splits, axis=-1))
-            ]
-            objective = sum(per_head) * sg(advantage)
+            objective = _discrete_objective(policies, imagined_actions, advantage)
         entropy = ent_coef * actor_entropy(policies, distribution)
         policy_loss = -jnp.mean(discount[:-1] * (objective + entropy[..., None][:-1]))
         aux = {
@@ -278,18 +322,11 @@ def build_train_fn(
             "lambda_values": sg(lambda_values),
             "discount": discount,
             "moments": new_moments,
-            "Loss/policy_loss": policy_loss,
-            "User/LambdaValues": jnp.mean(sg(lambda_values)),
-            "User/Advantages": jnp.mean(sg(advantage)),
-            "User/Entropy": jnp.mean(sg(entropy)),
-            "User/PredictedRewards": jnp.mean(sg(predicted_rewards)),
-            "User/PredictedValues": jnp.mean(sg(predicted_values)),
+            "Loss/policy_loss_task": policy_loss,
         }
         return policy_loss, aux
 
-    # ------------------------------------------------------------------
-    # critic loss (reference train :348-370)
-    # ------------------------------------------------------------------
+    # -- two-hot critic loss with EMA-target regularizer (reference :396-560)
 
     def critic_loss_fn(critic_params, target_params, traj, lambda_values, discount):
         qv = TwoHotEncodingDistribution(
@@ -301,86 +338,142 @@ def build_train_fn(
         value_loss = -qv.log_prob(lambda_values) - qv.log_prob(sg(target_values))
         return jnp.mean(value_loss * discount[:-1, ..., 0])
 
-    # ------------------------------------------------------------------
-    # the fused step
-    # ------------------------------------------------------------------
+    # ----------------------------------------------------------------------
 
     def local_step(agent_state, data, key, tau):
-        # de-correlate sampling noise across shards: each device works on a
-        # different slice of the batch and must draw different latents
         key = jax.random.fold_in(key, jax.lax.axis_index(axis))
         params = agent_state["params"]
         opt = agent_state["opt"]
-
-        # target critic EMA, dynamic cadence (reference main :731-735)
-        target = jax.tree_util.tree_map(
-            lambda c, t: tau * c + (1.0 - tau) * t,
-            params["critic"],
-            params["target_critic"],
+        ema = lambda c, t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a, b: tau * a + (1.0 - tau) * b, c, t
         )
 
-        k_wm, k_img = jax.random.split(key)
+        target_task = ema(params["critic_task"], params["target_critic_task"])
+        targets_expl = {
+            k: ema(params["critics_exploration"][k]["module"], params["critics_exploration"][k]["target"])
+            for k in critics_cfg
+        }
 
-        # -- world model update
+        k_wm, k_expl, k_task = jax.random.split(key, 3)
+
+        # 1. world model
         (wm_loss, (wm_metrics, posteriors, recurrents)), wm_grads = jax.value_and_grad(
             wm_loss_fn, has_aux=True
         )(params["world_model"], data, k_wm)
         wm_grads = jax.lax.pmean(wm_grads, axis)
-        wm_updates, wm_opt = world_tx.update(wm_grads, opt["world_model"], params["world_model"])
+        wm_updates, wm_opt = txs["world_model"].update(
+            wm_grads, opt["world_model"], params["world_model"]
+        )
         wm_params = optax.apply_updates(params["world_model"], wm_updates)
 
-        # -- actor update (imagination from the *updated* world model, as the
-        # reference's in-place optimizer.step implies)
-        true_continue = (1.0 - data["dones"]).reshape(-1, 1)
-        (actor_loss, aux), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
-            params["actor"],
-            wm_params,
-            params["critic"],
-            posteriors,
-            recurrents,
-            true_continue,
-            agent_state["moments"],
-            k_img,
+        # 2. ensembles (actions unshifted: action[t] leads out of state t)
+        ens_loss, ens_grads = jax.value_and_grad(ensemble_loss_fn)(
+            params["ensembles"], posteriors, recurrents, data["actions"]
         )
-        actor_grads = jax.lax.pmean(actor_grads, axis)
-        actor_updates, actor_opt = actor_tx.update(actor_grads, opt["actor"], params["actor"])
-        actor_params = optax.apply_updates(params["actor"], actor_updates)
+        ens_grads = jax.lax.pmean(ens_grads, axis)
+        ens_updates, ens_opt = txs["ensembles"].update(
+            ens_grads, opt["ensembles"], params["ensembles"]
+        )
+        ens_params = optax.apply_updates(params["ensembles"], ens_updates)
 
-        # -- critic update
-        critic_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(
-            params["critic"],
-            target,
-            aux["trajectories"],
-            aux["lambda_values"],
-            aux["discount"],
+        true_continue = (1.0 - data["dones"]).reshape(-1, 1)
+
+        # 3. exploration actor
+        (pl_expl, aux_expl), a_expl_grads = jax.value_and_grad(
+            actor_expl_loss_fn, has_aux=True
+        )(
+            params["actor_exploration"], wm_params, ens_params,
+            params["critics_exploration"], posteriors, recurrents,
+            true_continue, agent_state["moments"]["exploration"], k_expl,
         )
-        critic_grads = jax.lax.pmean(critic_grads, axis)
-        critic_updates, critic_opt = critic_tx.update(critic_grads, opt["critic"], params["critic"])
-        critic_params = optax.apply_updates(params["critic"], critic_updates)
+        a_expl_grads = jax.lax.pmean(a_expl_grads, axis)
+        a_expl_updates, a_expl_opt = txs["actor_exploration"].update(
+            a_expl_grads, opt["actor_exploration"], params["actor_exploration"]
+        )
+        actor_expl_params = optax.apply_updates(params["actor_exploration"], a_expl_updates)
+
+        # 4. exploration critics
+        new_critics_expl = {}
+        critics_expl_opt = {}
+        critic_metrics = {}
+        for k in critics_cfg:
+            c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
+                params["critics_exploration"][k]["module"],
+                targets_expl[k],
+                aux_expl["trajectories"],
+                aux_expl["critics"][k]["lambda_values"],
+                aux_expl["discount"],
+            )
+            c_grads = jax.lax.pmean(c_grads, axis)
+            c_updates, c_opt = txs["critics_exploration"].update(
+                c_grads, opt["critics_exploration"][k],
+                params["critics_exploration"][k]["module"],
+            )
+            new_critics_expl[k] = {
+                "module": optax.apply_updates(params["critics_exploration"][k]["module"], c_updates),
+                "target": targets_expl[k],
+            }
+            critics_expl_opt[k] = c_opt
+            critic_metrics[f"Loss/value_loss_exploration_{k}"] = c_loss
+
+        # 5. task actor
+        (pl_task, aux_task), a_task_grads = jax.value_and_grad(
+            actor_task_loss_fn, has_aux=True
+        )(
+            params["actor_task"], wm_params, params["critic_task"],
+            posteriors, recurrents, true_continue,
+            agent_state["moments"]["task"], k_task,
+        )
+        a_task_grads = jax.lax.pmean(a_task_grads, axis)
+        a_task_updates, a_task_opt = txs["actor_task"].update(
+            a_task_grads, opt["actor_task"], params["actor_task"]
+        )
+        actor_task_params = optax.apply_updates(params["actor_task"], a_task_updates)
+
+        # 6. task critic
+        ct_loss, ct_grads = jax.value_and_grad(critic_loss_fn)(
+            params["critic_task"], target_task,
+            aux_task["trajectories"], aux_task["lambda_values"], aux_task["discount"],
+        )
+        ct_grads = jax.lax.pmean(ct_grads, axis)
+        ct_updates, ct_opt = txs["critic_task"].update(
+            ct_grads, opt["critic_task"], params["critic_task"]
+        )
+        critic_task_params = optax.apply_updates(params["critic_task"], ct_updates)
 
         metrics = dict(wm_metrics)
-        metrics.update(
-            {
-                k: v
-                for k, v in aux.items()
-                if k not in ("trajectories", "lambda_values", "discount", "moments")
-            }
-        )
-        metrics["Loss/value_loss"] = critic_loss
+        metrics.update(aux_expl["metrics"])
+        metrics.update(critic_metrics)
+        metrics["Loss/ensemble_loss"] = ens_loss
+        metrics["Loss/policy_loss_exploration"] = pl_expl
+        metrics["Loss/policy_loss_task"] = pl_task
+        metrics["Loss/value_loss_task"] = ct_loss
         metrics["Grads/world_model"] = optax.global_norm(wm_grads)
-        metrics["Grads/actor"] = optax.global_norm(actor_grads)
-        metrics["Grads/critic"] = optax.global_norm(critic_grads)
+        metrics["Grads/ensemble"] = optax.global_norm(ens_grads)
+        metrics["Grads/actor_exploration"] = optax.global_norm(a_expl_grads)
+        metrics["Grads/actor_task"] = optax.global_norm(a_task_grads)
+        metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
         metrics = jax.lax.pmean(metrics, axis)
 
         new_state = {
             "params": {
                 "world_model": wm_params,
-                "actor": actor_params,
-                "critic": critic_params,
-                "target_critic": target,
+                "actor_task": actor_task_params,
+                "critic_task": critic_task_params,
+                "target_critic_task": target_task,
+                "actor_exploration": actor_expl_params,
+                "critics_exploration": new_critics_expl,
+                "ensembles": ens_params,
             },
-            "opt": {"world_model": wm_opt, "actor": actor_opt, "critic": critic_opt},
-            "moments": aux["moments"],
+            "opt": {
+                "world_model": wm_opt,
+                "ensembles": ens_opt,
+                "actor_task": a_task_opt,
+                "critic_task": ct_opt,
+                "actor_exploration": a_expl_opt,
+                "critics_exploration": critics_expl_opt,
+            },
+            "moments": {"task": aux_task["moments"], "exploration": aux_expl["moments"]},
         }
         return new_state, metrics
 
@@ -399,7 +492,9 @@ def main(fabric, cfg: Dict[str, Any]):
     world_size = fabric.world_size
     root_key = fabric.seed_everything(cfg.seed)
 
-    # These arguments cannot be changed (reference main :394-396)
+    # The exploration phase always acts with the exploration actor
+    # (reference main :570)
+    cfg.algo.player.actor_type = "exploration"
     cfg.env.frame_stack = -1
     if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
         raise ValueError(f"The screen size must be a power of 2, got: {cfg.env.screen_size}")
@@ -411,9 +506,6 @@ def main(fabric, cfg: Dict[str, Any]):
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
 
-    # Environment setup — one process drives all devices (SPMD), so the vector
-    # env holds num_envs × world_size environments, each fault-tolerant via
-    # RestartOnException (reference main :408-423).
     n_envs = int(cfg.env.num_envs) * world_size
     from functools import partial
 
@@ -425,12 +517,9 @@ def main(fabric, cfg: Dict[str, Any]):
         partial(
             RestartOnException,
             make_env(
-                cfg,
-                cfg.seed + i,
-                0,
+                cfg, cfg.seed + i, 0,
                 log_dir if fabric.is_global_zero else None,
-                "train",
-                vector_env_idx=i,
+                "train", vector_env_idx=i,
             ),
         )
         for i in range(n_envs)
@@ -454,48 +543,47 @@ def main(fabric, cfg: Dict[str, Any]):
             "You should specify at least one CNN keys or MLP keys from the cli: "
             "`cnn_keys.encoder=[rgb]` or `mlp_keys.encoder=[state]`"
         )
-    if (
-        len(set(cfg.cnn_keys.encoder).intersection(set(cfg.cnn_keys.decoder))) == 0
-        and len(set(cfg.mlp_keys.encoder).intersection(set(cfg.mlp_keys.decoder))) == 0
-    ):
-        raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
-    if len(set(cfg.cnn_keys.decoder) - set(cfg.cnn_keys.encoder)) > 0:
-        raise RuntimeError(
-            "The CNN keys of the decoder must be contained in the encoder ones. "
-            f"Those keys are decoded without being encoded: {list(set(cfg.cnn_keys.decoder))}"
-        )
-    if len(set(cfg.mlp_keys.decoder) - set(cfg.mlp_keys.encoder)) > 0:
-        raise RuntimeError(
-            "The MLP keys of the decoder must be contained in the encoder ones. "
-            f"Those keys are decoded without being encoded: {list(set(cfg.mlp_keys.decoder))}"
-        )
-    if cfg.metric.log_level > 0:
-        fabric.print("Encoder CNN keys:", cfg.cnn_keys.encoder)
-        fabric.print("Encoder MLP keys:", cfg.mlp_keys.encoder)
-        fabric.print("Decoder CNN keys:", cfg.cnn_keys.decoder)
-        fabric.print("Decoder MLP keys:", cfg.mlp_keys.decoder)
     cnn_keys = list(cfg.cnn_keys.encoder)
     mlp_keys = list(cfg.mlp_keys.encoder)
     obs_keys = cnn_keys + mlp_keys
 
-    # Agent + optimizers + train program
     root_key, build_key = jax.random.split(root_key)
-    world_model, actor, critic, params = build_agent(
+    world_model, actor, critic, ensemble_member, params = build_agent(
         cfg, actions_dim, is_continuous, observation_space, build_key
     )
-    world_tx = instantiate(
-        cfg.algo.world_model.optimizer, max_grad_norm=cfg.algo.world_model.clip_gradients
-    )
-    actor_tx = instantiate(cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients)
-    critic_tx = instantiate(cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients)
+    txs = {
+        "world_model": instantiate(
+            cfg.algo.world_model.optimizer, max_grad_norm=cfg.algo.world_model.clip_gradients
+        ),
+        "ensembles": instantiate(
+            cfg.algo.ensembles.optimizer, max_grad_norm=cfg.algo.ensembles.clip_gradients
+        ),
+        "actor_task": instantiate(cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients),
+        "critic_task": instantiate(cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients),
+        "actor_exploration": instantiate(
+            cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients
+        ),
+        "critics_exploration": instantiate(
+            cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients
+        ),
+    }
     agent_state = {
         "params": params,
         "opt": {
-            "world_model": world_tx.init(params["world_model"]),
-            "actor": actor_tx.init(params["actor"]),
-            "critic": critic_tx.init(params["critic"]),
+            "world_model": txs["world_model"].init(params["world_model"]),
+            "ensembles": txs["ensembles"].init(params["ensembles"]),
+            "actor_task": txs["actor_task"].init(params["actor_task"]),
+            "critic_task": txs["critic_task"].init(params["critic_task"]),
+            "actor_exploration": txs["actor_exploration"].init(params["actor_exploration"]),
+            "critics_exploration": {
+                k: txs["critics_exploration"].init(params["critics_exploration"][k]["module"])
+                for k in params["critics_exploration"]
+            },
         },
-        "moments": init_moments(),
+        "moments": {
+            "task": init_moments(),
+            "exploration": {k: init_moments() for k in params["critics_exploration"]},
+        },
     }
 
     expl_decay_steps = 0
@@ -516,24 +604,19 @@ def main(fabric, cfg: Dict[str, Any]):
     agent_state = jax.device_put(agent_state, fabric.replicated)
 
     train_fn = build_train_fn(
-        world_model,
-        actor,
-        critic,
-        world_tx,
-        actor_tx,
-        critic_tx,
-        cfg,
-        fabric,
-        actions_dim,
-        is_continuous,
+        world_model, actor, critic, ensemble_member, txs, cfg, fabric, actions_dim, is_continuous
     )
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
+
+    def player_actor_params():
+        if cfg.algo.player.actor_type == "exploration":
+            return agent_state["params"]["actor_exploration"]
+        return agent_state["params"]["actor_task"]
 
     aggregator = None
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
-    # Buffer: per-env sequential sub-buffers (reference main :515-523)
     buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 4
     rb = EnvIndependentReplayBuffer(
         max(buffer_size, 4),
@@ -546,7 +629,6 @@ def main(fabric, cfg: Dict[str, Any]):
     if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
         rb.load_state_dict(state["rb"])
 
-    # Global counters (reference main :534-545)
     train_step = 0
     last_train = 0
     start_step = int(np.asarray(state["update"])) // world_size if state is not None else 1
@@ -576,22 +658,11 @@ def main(fabric, cfg: Dict[str, Any]):
     if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_update != 0:
         warnings.warn(
             f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
-            f"policy_steps_per_update value ({policy_steps_per_update}), so "
-            "the metrics will be logged at the nearest greater multiple of the "
-            "policy_steps_per_update value."
-        )
-    if cfg.checkpoint.every % policy_steps_per_update != 0:
-        warnings.warn(
-            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
-            f"policy_steps_per_update value ({policy_steps_per_update}), so "
-            "the checkpoint will be saved at the nearest greater multiple of the "
-            "policy_steps_per_update value."
+            f"policy_steps_per_update value ({policy_steps_per_update})."
         )
 
-    # Data sharding for the train batch [T, B_total, ...]
     data_sharding = fabric.sharding(None, fabric.data_axis)
 
-    # First observation (reference main :574-590)
     o = envs.reset(seed=cfg.seed)[0]
     obs = prepare_obs(o, cnn_keys, mlp_keys, n_envs)
     step_data = {k: obs[k][None] for k in obs_keys}
@@ -622,7 +693,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 root_key, act_key = jax.random.split(root_key)
                 actions_j, player_state = player_fns["exploration_action"](
                     agent_state["params"]["world_model"],
-                    agent_state["params"]["actor"],
+                    player_actor_params(),
                     player_state,
                     norm_obs,
                     act_key,
@@ -645,15 +716,6 @@ def main(fabric, cfg: Dict[str, Any]):
             dones = np.logical_or(terminated, truncated).astype(np.float32)
 
         step_data["is_first"] = np.zeros_like(step_data["dones"])
-        if "restart_on_exception" in infos:
-            for i, env_roe in enumerate(infos["restart_on_exception"]):
-                if env_roe and not dones[i]:
-                    sub = rb.buffer[i]
-                    last_idx = (sub._pos - 1) % sub.buffer_size
-                    sub["dones"][last_idx] = np.ones_like(sub["dones"][last_idx])
-                    sub["is_first"][last_idx] = np.zeros_like(sub["is_first"][last_idx])
-                    step_data["is_first"][0, i] = 1.0
-
         if cfg.metric.log_level > 0 and "final_info" in infos:
             fi = infos["final_info"]
             if isinstance(fi, dict) and "episode" in fi:
@@ -667,8 +729,6 @@ def main(fabric, cfg: Dict[str, Any]):
                         aggregator.update("Game/ep_len_avg", ep_len)
                     fabric.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        # Save the real next observation: on autoreset steps the terminal
-        # observation lives in final_obs (reference main :663-668)
         next_obs_np = {k: np.asarray(o[k]) for k in o}
         dones_idxes = np.nonzero(dones.reshape(-1))[0].tolist()
         real_next_obs = {k: v.copy() for k, v in next_obs_np.items()}
@@ -691,9 +751,7 @@ def main(fabric, cfg: Dict[str, Any]):
         if len(dones_idxes) > 0:
             reset_obs = prepare_obs(
                 {k: real_next_obs[k][dones_idxes] for k in real_next_obs},
-                cnn_keys,
-                mlp_keys,
-                len(dones_idxes),
+                cnn_keys, mlp_keys, len(dones_idxes),
             )
             reset_data = {k: reset_obs[k][None] for k in obs_keys}
             reset_data["dones"] = np.ones((1, len(dones_idxes), 1), np.float32)
@@ -702,7 +760,6 @@ def main(fabric, cfg: Dict[str, Any]):
             reset_data["is_first"] = np.zeros_like(reset_data["dones"])
             rb.add(reset_data, dones_idxes)
 
-            # Reset already-inserted step data (reference main :708-712)
             step_data["rewards"][:, dones_idxes] = 0.0
             step_data["dones"][:, dones_idxes] = 0.0
             step_data["is_first"][:, dones_idxes] = 1.0
@@ -714,7 +771,6 @@ def main(fabric, cfg: Dict[str, Any]):
 
         updates_before_training -= 1
 
-        # Train the agent (reference main :719-765)
         if update >= learning_starts and updates_before_training <= 0:
             n_samples = (
                 cfg.algo.per_rank_pretrain_steps
@@ -733,10 +789,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         tau = 1.0 if per_rank_gradient_steps == 0 else cfg.algo.critic.tau
                     else:
                         tau = 0.0
-                    batch = {
-                        k: jnp.asarray(v[i], jnp.float32)
-                        for k, v in local_data.items()
-                    }
+                    batch = {k: jnp.asarray(v[i], jnp.float32) for k, v in local_data.items()}
                     batch = jax.device_put(batch, data_sharding)
                     root_key, train_key = jax.random.split(root_key)
                     agent_state, metrics = train_fn(
@@ -763,7 +816,6 @@ def main(fabric, cfg: Dict[str, Any]):
                 if "Params/exploration_amount" in aggregator:
                     aggregator.update("Params/exploration_amount", expl_amount)
 
-        # Log metrics (reference main :768-800)
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or update == num_updates
         ):
@@ -787,9 +839,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         logger.log_metrics(
                             {
                                 "Time/sps_env_interaction": (
-                                    (policy_step - last_log)
-                                    / world_size
-                                    * cfg.env.action_repeat
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
                                 )
                                 / max(timer_metrics["Time/env_interaction_time"], 1e-9)
                             },
@@ -799,7 +849,6 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        # Checkpoint (reference main :803-830)
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             update == num_updates and cfg.checkpoint.save_last
         ):
@@ -821,5 +870,11 @@ def main(fabric, cfg: Dict[str, Any]):
             )
 
     envs.close()
+    # Final greedy test runs the *task* policy (reference main :1124)
     if fabric.is_global_zero:
-        test(player_fns, jax.device_get(agent_state["params"]), fabric, cfg, log_dir, sample_actions=True)
+        final = jax.device_get(agent_state["params"])
+        test(
+            player_fns,
+            {"world_model": final["world_model"], "actor": final["actor_task"]},
+            fabric, cfg, log_dir, sample_actions=True,
+        )

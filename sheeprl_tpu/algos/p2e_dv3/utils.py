@@ -1,0 +1,25 @@
+"""P2E-DV3 utilities (reference ``sheeprl/algos/p2e_dv3/utils.py``):
+the metric allow-list covering both phases, including the per-critic
+exploration keys for the default ``intrinsic``/``extrinsic`` critics."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.dreamer_v3.utils import AGGREGATOR_KEYS as _DV3_KEYS
+
+AGGREGATOR_KEYS = _DV3_KEYS | {
+    "Loss/ensemble_loss",
+    "Loss/policy_loss_exploration",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Grads/ensemble",
+    "Grads/actor_exploration",
+    "Grads/actor_task",
+    "Grads/critic_task",
+    "Rewards/intrinsic_intrinsic",
+    "Values_exploration/predicted_values_intrinsic",
+    "Values_exploration/lambda_values_intrinsic",
+    "Values_exploration/predicted_values_extrinsic",
+    "Values_exploration/lambda_values_extrinsic",
+    "Loss/value_loss_exploration_intrinsic",
+    "Loss/value_loss_exploration_extrinsic",
+}

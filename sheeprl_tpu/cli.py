@@ -118,6 +118,40 @@ def _prune_metric_keys(cfg, algo_module: str) -> None:
             metrics_cfg.pop(name)
 
 
+def _load_exploration_cfg(cfg) -> Any:
+    """P2E finetuning: re-read the exploration run's persisted config and
+    inherit its env settings (reference cli.py:106-137)."""
+    ckpt_path = cfg.checkpoint.exploration_ckpt_path
+    if not ckpt_path:
+        raise ValueError(
+            "P2E finetuning requires checkpoint.exploration_ckpt_path pointing at an "
+            "exploration-phase checkpoint"
+        )
+    exploration_cfg, _ = _load_run_config(ckpt_path)
+    if exploration_cfg.env.id != cfg.env.id:
+        raise ValueError(
+            "This experiment is run with a different environment from "
+            "the one of the exploration you want to finetune. "
+            f"Got '{cfg.env.id}', but the environment used during exploration was "
+            f"{exploration_cfg.env.id}. Set properly the environment for finetuning "
+            "the experiment."
+        )
+    # Take environment configs from exploration
+    for k in (
+        "frame_stack",
+        "screen_size",
+        "action_repeat",
+        "grayscale",
+        "clip_rewards",
+        "frame_stack_dilation",
+        "max_episode_steps",
+        "reward_as_observation",
+    ):
+        if k in exploration_cfg.env:
+            cfg.env[k] = exploration_cfg.env[k]
+    return exploration_cfg
+
+
 def run_algorithm(cfg) -> None:
     """Registry lookup → Fabric → entrypoint (reference cli.py:48-156)."""
     entry = find_algorithm(cfg.algo.name)
@@ -129,6 +163,10 @@ def run_algorithm(cfg) -> None:
     module = importlib.import_module(entry["module"])
     entrypoint = getattr(module, entry["entrypoint"])
 
+    kwargs = {}
+    if "finetuning" in cfg.algo.name and "p2e" in entry["module"]:
+        kwargs["exploration_cfg"] = _load_exploration_cfg(cfg)
+
     fabric = instantiate(cfg.fabric)
 
     # Observability gates (reference cli.py:141-155)
@@ -138,7 +176,7 @@ def run_algorithm(cfg) -> None:
     ) == 0
     timer.disabled = cfg.metric.log_level == 0 or cfg.metric.get("disable_timer", False)
 
-    fabric.launch(entrypoint, cfg)
+    fabric.launch(entrypoint, cfg, **kwargs)
 
 
 def eval_algorithm(cfg) -> None:

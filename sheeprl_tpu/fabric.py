@@ -224,16 +224,24 @@ class Fabric:
             ckptr.save(path, state, force=True)
 
     def load(self, path: str, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-        """Restore a checkpoint pytree; with ``state`` given, restores
-        structure/dtypes against it (reference fabric.load semantics)."""
+        """Restore a checkpoint pytree (reference fabric.load semantics).
+
+        With ``state`` given, the raw restore is conformed to its structure
+        (NamedTuple optimizer states rebuilt, extra on-disk keys like the
+        optional replay-buffer snapshot kept raw at top level)."""
         import orbax.checkpoint as ocp
+
+        from sheeprl_tpu.utils.utils import conform_pytree
 
         path = os.path.abspath(path)
         with ocp.PyTreeCheckpointer() as ckptr:
-            if state is not None:
-                restored = ckptr.restore(path, item=jax.device_get(state))
-            else:
-                restored = ckptr.restore(path)
+            restored = ckptr.restore(path)
+        if state is not None:
+            out = conform_pytree(state, restored)
+            for k in restored:
+                if isinstance(restored, dict) and k not in out:
+                    out[k] = restored[k]
+            return out
         return restored
 
     # ------------------------------------------------------------------

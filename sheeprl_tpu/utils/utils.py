@@ -199,3 +199,28 @@ def two_hot_encoder(x: jnp.ndarray, support: jnp.ndarray) -> jnp.ndarray:
 def unwrap_fabric(module):  # pragma: no cover - parity shim
     """Parity shim with the reference API: params are already plain pytrees."""
     return module
+
+
+def conform_pytree(template: Any, restored: Any) -> Any:
+    """Rebuild ``restored`` (raw containers from an orbax template-less
+    restore: dicts and lists) in the *structure* of ``template`` — NamedTuples
+    (optax states) are reconstructed from lists or field dicts, tuples from
+    lists, and dict keys present on disk but absent from the template are
+    dropped. Leaves come from ``restored``.
+    """
+    if isinstance(template, dict):
+        return type(template)(
+            {k: conform_pytree(template[k], restored[k]) for k in template}
+        )
+    if isinstance(template, tuple) and hasattr(template, "_fields"):  # NamedTuple
+        if len(template) == 0 or restored is None:  # e.g. optax EmptyState
+            return template
+        vals = restored
+        if isinstance(restored, dict):
+            vals = [restored[f] for f in template._fields]
+        return type(template)(*(conform_pytree(t, r) for t, r in zip(template, vals)))
+    if isinstance(template, (list, tuple)):
+        if restored is None:
+            return template
+        return type(template)(conform_pytree(t, r) for t, r in zip(template, restored))
+    return restored

@@ -95,6 +95,40 @@ def test_dreamer_v3_checkpoint_resume(tmp_path, monkeypatch):
     )
 
 
+def test_dreamer_v3_resume_with_buffer_checkpoint(tmp_path, monkeypatch):
+    """buffer.checkpoint=True round-trip: the replay buffer is embedded in the
+    checkpoint and restored on resume (reference callback.py:32-64)."""
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        dv3_args(
+            tmp_path,
+            [
+                "fabric.devices=1",
+                "env.id=discrete_dummy",
+                "checkpoint.every=1",
+                "checkpoint.save_last=True",
+                "buffer.checkpoint=True",
+            ],
+        )
+    )
+    import glob
+    import os
+
+    ckpts = glob.glob(f"{tmp_path}/logs/**/checkpoint/ckpt_*", recursive=True)
+    assert ckpts, "no checkpoint written"
+    cli.run(
+        dv3_args(
+            tmp_path,
+            [
+                "fabric.devices=1",
+                "env.id=discrete_dummy",
+                "buffer.checkpoint=True",
+                f"checkpoint.resume_from={os.path.abspath(ckpts[-1])}",
+            ],
+        )
+    )
+
+
 def test_compute_lambda_values_matches_reference_recursion():
     from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values
 

@@ -1,0 +1,176 @@
+"""P2E-DV1/DV2 tests: exploration dry runs and the exploration→finetuning
+handoff on each chassis (reference ``tests/test_algos/test_algos.py``
+p2e_dv1/p2e_dv2 cases)."""
+
+import glob
+import os
+
+import pytest
+
+from sheeprl_tpu import cli
+
+
+def base_args(tmp_path):
+    return [
+        "dry_run=True",
+        "env=dummy",
+        "env.sync_env=True",
+        "checkpoint.every=1000000",
+        "metric.log_every=1000000",
+        "metric.log_level=0",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+        f"root_dir={tmp_path}/logs",
+        "run_name=test",
+        "fabric.accelerator=cpu",
+        "per_rank_batch_size=2",
+        "per_rank_sequence_length=2",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.ensembles.n=3",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4",
+        "algo.learning_starts=0",
+        "cnn_keys.encoder=[rgb]",
+    ]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_p2e_dv1_exploration(tmp_path, env_id, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        base_args(tmp_path)
+        + [
+            "exp=p2e_dv1_exploration",
+            "algo.per_rank_gradient_steps=1",
+            "fabric.devices=1",
+            f"env.id={env_id}",
+        ]
+    )
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_p2e_dv2_exploration(tmp_path, env_id, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        base_args(tmp_path)
+        + [
+            "exp=p2e_dv2_exploration",
+            "algo.per_rank_pretrain_steps=1",
+            "algo.world_model.discrete_size=4",
+            "fabric.devices=1",
+            f"env.id={env_id}",
+        ]
+    )
+
+
+def test_p2e_dv2_exploration_two_devices(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        base_args(tmp_path)
+        + [
+            "exp=p2e_dv2_exploration",
+            "algo.per_rank_pretrain_steps=1",
+            "algo.world_model.discrete_size=4",
+            "fabric.devices=2",
+            "env.id=discrete_dummy",
+        ]
+    )
+
+
+def _finetune(tmp_path, monkeypatch, exp_expl, exp_fine, extra):
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        base_args(tmp_path)
+        + [
+            f"exp={exp_expl}",
+            "fabric.devices=1",
+            "env.id=discrete_dummy",
+            "checkpoint.every=1",
+            "checkpoint.save_last=True",
+            *extra,
+        ]
+    )
+    ckpts = glob.glob(f"{tmp_path}/logs/**/checkpoint/ckpt_*", recursive=True)
+    assert ckpts, "no exploration checkpoint written"
+    cli.run(
+        base_args(tmp_path)
+        + [
+            f"exp={exp_fine}",
+            "fabric.devices=1",
+            "env.id=discrete_dummy",
+            f"checkpoint.exploration_ckpt_path={os.path.abspath(ckpts[-1])}",
+            "run_name=test_finetune",
+            *extra,
+        ]
+    )
+
+
+def test_p2e_dv1_finetuning_from_exploration(tmp_path, monkeypatch):
+    _finetune(
+        tmp_path, monkeypatch,
+        "p2e_dv1_exploration", "p2e_dv1_finetuning",
+        ["algo.per_rank_gradient_steps=1"],
+    )
+
+
+def test_p2e_dv2_finetuning_from_exploration(tmp_path, monkeypatch):
+    _finetune(
+        tmp_path, monkeypatch,
+        "p2e_dv2_exploration", "p2e_dv2_finetuning",
+        ["algo.per_rank_pretrain_steps=1", "algo.world_model.discrete_size=4"],
+    )
+
+
+def test_p2e_dv1_finetuning_resume(tmp_path, monkeypatch):
+    """Resuming an interrupted finetuning run restores the optax states
+    (conformed NamedTuples) and keeps the task-actor player."""
+    monkeypatch.chdir(tmp_path)
+    extra = ["algo.per_rank_gradient_steps=1"]
+    cli.run(
+        base_args(tmp_path)
+        + [
+            "exp=p2e_dv1_exploration",
+            "fabric.devices=1",
+            "env.id=discrete_dummy",
+            "checkpoint.every=1",
+            "checkpoint.save_last=True",
+            *extra,
+        ]
+    )
+    expl_ckpts = sorted(glob.glob(f"{tmp_path}/logs/**/checkpoint/ckpt_*", recursive=True))
+    assert expl_ckpts
+    cli.run(
+        base_args(tmp_path)
+        + [
+            "exp=p2e_dv1_finetuning",
+            "fabric.devices=1",
+            "env.id=discrete_dummy",
+            f"checkpoint.exploration_ckpt_path={os.path.abspath(expl_ckpts[-1])}",
+            "run_name=test_finetune",
+            "checkpoint.every=1",
+            "checkpoint.save_last=True",
+            *extra,
+        ]
+    )
+    fine_ckpts = sorted(
+        glob.glob(f"{tmp_path}/logs/**/test_finetune/**/checkpoint/ckpt_*", recursive=True)
+    )
+    assert fine_ckpts, "no finetuning checkpoint written"
+    cli.run(
+        base_args(tmp_path)
+        + [
+            "exp=p2e_dv1_finetuning",
+            "fabric.devices=1",
+            "env.id=discrete_dummy",
+            f"checkpoint.exploration_ckpt_path={os.path.abspath(expl_ckpts[-1])}",
+            f"checkpoint.resume_from={os.path.abspath(fine_ckpts[-1])}",
+            "run_name=test_finetune_resume",
+            *extra,
+        ]
+    )

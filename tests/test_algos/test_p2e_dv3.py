@@ -1,0 +1,106 @@
+"""P2E-DV3 tests: exploration dry runs over action types and the
+exploration→finetuning handoff (reference ``tests/test_algos/test_algos.py``
+p2e_dv3 cases)."""
+
+import glob
+import os
+
+import pytest
+
+from sheeprl_tpu import cli
+
+
+def p2e_args(tmp_path, extra=()):
+    return [
+        "dry_run=True",
+        "env=dummy",
+        "env.sync_env=True",
+        "checkpoint.every=1000000",
+        "metric.log_every=1000000",
+        "metric.log_level=0",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+        f"root_dir={tmp_path}/logs",
+        "run_name=test",
+        "exp=p2e_dv3_exploration",
+        "fabric.accelerator=cpu",
+        "per_rank_batch_size=2",
+        "per_rank_sequence_length=1",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.ensembles.n=3",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4",
+        "algo.world_model.discrete_size=4",
+        "algo.learning_starts=0",
+        "cnn_keys.encoder=[rgb]",
+        *extra,
+    ]
+
+
+@pytest.fixture(params=["1", "2"])
+def devices(request):
+    return request.param
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_p2e_dv3_exploration(tmp_path, devices, env_id, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(p2e_args(tmp_path, [f"fabric.devices={devices}", f"env.id={env_id}"]))
+
+
+def test_p2e_dv3_finetuning_from_exploration(tmp_path, monkeypatch):
+    """Exploration → checkpoint → finetuning handoff (reference cli.py:106-137)."""
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        p2e_args(
+            tmp_path,
+            [
+                "fabric.devices=1",
+                "env.id=discrete_dummy",
+                "checkpoint.every=1",
+                "checkpoint.save_last=True",
+            ],
+        )
+    )
+    ckpts = glob.glob(f"{tmp_path}/logs/**/checkpoint/ckpt_*", recursive=True)
+    assert ckpts, "no exploration checkpoint written"
+
+    finetune_args = [
+        a for a in p2e_args(tmp_path, ["fabric.devices=1", "env.id=discrete_dummy"])
+        if not a.startswith("exp=")
+    ] + [
+        "exp=p2e_dv3_finetuning",
+        f"checkpoint.exploration_ckpt_path={os.path.abspath(ckpts[-1])}",
+        "run_name=test_finetune",
+    ]
+    cli.run(finetune_args)
+
+
+def test_ensemble_disagreement_is_zero_for_identical_members():
+    """Intrinsic reward must vanish when all members agree (variance 0)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.p2e_dv3.agent import (
+        EnsembleMember,
+        apply_ensemble,
+        init_ensemble,
+    )
+
+    member = EnsembleMember(output_dim=6, mlp_layers=1, dense_units=8)
+    stacked = init_ensemble(member, 4, 10, jax.random.PRNGKey(0))
+    # force identical members
+    first = jax.tree_util.tree_map(lambda x: jnp.broadcast_to(x[:1], x.shape), stacked)
+    out = apply_ensemble(member, first, jnp.ones((5, 10)))
+    assert out.shape == (4, 5, 6)
+    disagreement = jnp.var(out, axis=0).mean()
+    assert float(disagreement) < 1e-12
+    # distinct seeds → nonzero disagreement
+    out2 = apply_ensemble(member, stacked, jnp.ones((5, 10)))
+    assert float(jnp.var(out2, axis=0).mean()) > 1e-8
