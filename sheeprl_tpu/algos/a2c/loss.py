@@ -1,0 +1,28 @@
+"""A2C losses (upstream sheeprl ``algos/a2c/loss.py``), pure jnp: a plain
+advantage-weighted policy gradient (no ratio clipping) and an MSE value
+loss."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _reduce(x: jnp.ndarray, reduction: str) -> jnp.ndarray:
+    reduction = reduction.lower()
+    if reduction == "none":
+        return x
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    raise ValueError(f"Unrecognized reduction: {reduction}")
+
+
+def policy_loss(
+    logprobs: jnp.ndarray, advantages: jnp.ndarray, reduction: str = "mean"
+) -> jnp.ndarray:
+    return _reduce(-(advantages * logprobs), reduction)
+
+
+def value_loss(values: jnp.ndarray, returns: jnp.ndarray, reduction: str = "mean") -> jnp.ndarray:
+    return _reduce((values - returns) ** 2, reduction)

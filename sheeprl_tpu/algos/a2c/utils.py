@@ -1,0 +1,12 @@
+"""A2C helpers — same metric surface and greedy test as PPO."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+}

@@ -1,0 +1,215 @@
+"""Recurrent PPO agent — LSTM policy over observation/action sequences.
+
+Behavioral contract from the reference ``sheeprl/algos/ppo_recurrent/agent.py``
+(RecurrentModel :15-74, RecurrentPPOAgent :76-290): a MultiEncoder feature
+extractor, an optional pre-RNN MLP, an LSTM over ``features ‖ prev_actions``,
+an optional post-RNN MLP, then the standard PPO actor heads + critic on the
+recurrent output.
+
+TPU-native design: the time loop is an ``nn.scan`` over a reset-aware LSTM
+cell — per-step ``is_first`` flags zero the carried ``(c, h)`` inside the
+scanned cell (the reference instead splits episodes, pads, and masks;
+resetting inside a contiguous scan is the branchless equivalent when
+``reset_recurrent_state_on_done`` is on, and avoids ragged/padded batches
+entirely). All shapes are ``[T, B, ...]``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import (  # noqa: F401
+    evaluate_actions,
+    greedy_actions,
+    sample_actions,
+)
+from sheeprl_tpu.models import MLP, NatureCNN
+
+
+class _ResetLSTMCell(nn.Module):
+    """LSTM cell whose carry is zeroed where ``is_first`` is set."""
+
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, carry, inp):
+        c, h = carry
+        x, first = inp
+        c = (1.0 - first) * c
+        h = (1.0 - first) * h
+        (c, h), y = nn.OptimizedLSTMCell(self.hidden_size)((c, h), x)
+        return (c, h), y
+
+
+class RecurrentPPOAgent(nn.Module):
+    """Encoder → [pre-RNN MLP] → reset-aware LSTM scan → [post-RNN MLP] →
+    actor heads + critic. Sequence-first shapes ``[T, B, ...]``."""
+
+    actions_dim: Tuple[int, ...]
+    is_continuous: bool
+    cnn_keys: Tuple[str, ...]
+    mlp_keys: Tuple[str, ...]
+    screen_size: int
+    cnn_features_dim: int = 512
+    mlp_features_dim: int = 64
+    encoder_dense_units: int = 64
+    encoder_mlp_layers: int = 2
+    encoder_dense_act: str = "relu"
+    encoder_layer_norm: bool = True
+    rnn_hidden_size: int = 64
+    pre_rnn_apply: bool = False
+    pre_rnn_dense_units: int = 64
+    pre_rnn_act: str = "relu"
+    pre_rnn_layer_norm: bool = True
+    post_rnn_apply: bool = False
+    post_rnn_dense_units: int = 64
+    post_rnn_act: str = "relu"
+    post_rnn_layer_norm: bool = True
+    actor_dense_units: int = 128
+    actor_mlp_layers: int = 1
+    actor_dense_act: str = "relu"
+    actor_layer_norm: bool = True
+    critic_dense_units: int = 128
+    critic_mlp_layers: int = 1
+    critic_dense_act: str = "relu"
+    critic_layer_norm: bool = True
+
+    def setup(self) -> None:
+        if self.cnn_keys:
+            self.cnn_encoder = NatureCNN(
+                features_dim=self.cnn_features_dim, screen_size=self.screen_size
+            )
+        if self.mlp_keys:
+            self.mlp_encoder = MLP(
+                hidden_sizes=(self.encoder_dense_units,) * self.encoder_mlp_layers,
+                output_dim=self.mlp_features_dim,
+                activation=self.encoder_dense_act,
+                layer_norm=self.encoder_layer_norm,
+            )
+        if self.pre_rnn_apply:
+            self.pre_rnn = MLP(
+                hidden_sizes=(self.pre_rnn_dense_units,),
+                activation=self.pre_rnn_act,
+                layer_norm=self.pre_rnn_layer_norm,
+            )
+        if self.post_rnn_apply:
+            self.post_rnn = MLP(
+                hidden_sizes=(self.post_rnn_dense_units,),
+                activation=self.post_rnn_act,
+                layer_norm=self.post_rnn_layer_norm,
+            )
+        self.rnn = nn.scan(
+            _ResetLSTMCell,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )(self.rnn_hidden_size)
+        self.actor_backbone = MLP(
+            hidden_sizes=(self.actor_dense_units,) * self.actor_mlp_layers,
+            activation=self.actor_dense_act,
+            layer_norm=self.actor_layer_norm,
+        )
+        if self.is_continuous:
+            self.actor_heads = [nn.Dense(int(sum(self.actions_dim)) * 2)]
+        else:
+            self.actor_heads = [nn.Dense(int(d)) for d in self.actions_dim]
+        self.critic = MLP(
+            hidden_sizes=(self.critic_dense_units,) * self.critic_mlp_layers,
+            output_dim=1,
+            activation=self.critic_dense_act,
+            layer_norm=self.critic_layer_norm,
+        )
+
+    def features(self, obs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        feats = []
+        if self.cnn_keys:
+            x = jnp.concatenate([obs[k] for k in self.cnn_keys], axis=-3)
+            feats.append(self.cnn_encoder(x))
+        if self.mlp_keys:
+            x = jnp.concatenate([obs[k] for k in self.mlp_keys], axis=-1)
+            feats.append(self.mlp_encoder(x))
+        return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
+
+    def __call__(
+        self,
+        obs: Dict[str, jnp.ndarray],
+        prev_actions: jnp.ndarray,
+        is_first: jnp.ndarray,
+        hc: Tuple[jnp.ndarray, jnp.ndarray],
+    ) -> Tuple[List[jnp.ndarray], jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+        """``obs[k]``: [T, B, ...]; ``prev_actions``: [T, B, A]; ``is_first``:
+        [T, B, 1]; ``hc``: ((c [B, H]), (h [B, H])). Returns
+        ``(pre_dist, values, (c, h))``."""
+        feat = self.features(obs)
+        x = jnp.concatenate([feat, prev_actions], -1)
+        if self.pre_rnn_apply:
+            x = self.pre_rnn(x)
+        hc, outs = self.rnn(hc, (x, is_first))
+        if self.post_rnn_apply:
+            outs = self.post_rnn(outs)
+        trunk = self.actor_backbone(outs)
+        pre_dist = [head(trunk) for head in self.actor_heads]
+        values = self.critic(outs)
+        return pre_dist, values, hc
+
+    def initial_hc(self, batch: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        z = jnp.zeros((batch, self.rnn_hidden_size), jnp.float32)
+        return (z, z)
+
+
+def build_agent(cfg, actions_dim, is_continuous, cnn_keys, mlp_keys) -> RecurrentPPOAgent:
+    rnn_cfg = cfg.algo.rnn
+    return RecurrentPPOAgent(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        screen_size=int(cfg.env.screen_size),
+        cnn_features_dim=int(cfg.algo.encoder.cnn_features_dim),
+        mlp_features_dim=int(cfg.algo.encoder.mlp_features_dim),
+        encoder_dense_units=int(cfg.algo.encoder.dense_units),
+        encoder_mlp_layers=int(cfg.algo.encoder.mlp_layers),
+        encoder_dense_act=cfg.algo.encoder.dense_act,
+        encoder_layer_norm=bool(cfg.algo.encoder.layer_norm),
+        rnn_hidden_size=int(rnn_cfg.lstm.hidden_size),
+        pre_rnn_apply=bool(rnn_cfg.pre_rnn_mlp.apply),
+        pre_rnn_dense_units=int(rnn_cfg.pre_rnn_mlp.dense_units),
+        pre_rnn_act=rnn_cfg.pre_rnn_mlp.activation,
+        pre_rnn_layer_norm=bool(rnn_cfg.pre_rnn_mlp.layer_norm),
+        post_rnn_apply=bool(rnn_cfg.post_rnn_mlp.apply),
+        post_rnn_dense_units=int(rnn_cfg.post_rnn_mlp.dense_units),
+        post_rnn_act=rnn_cfg.post_rnn_mlp.activation,
+        post_rnn_layer_norm=bool(rnn_cfg.post_rnn_mlp.layer_norm),
+        actor_dense_units=int(cfg.algo.actor.dense_units),
+        actor_mlp_layers=int(cfg.algo.actor.mlp_layers),
+        actor_dense_act=cfg.algo.actor.dense_act,
+        actor_layer_norm=bool(cfg.algo.actor.layer_norm),
+        critic_dense_units=int(cfg.algo.critic.dense_units),
+        critic_mlp_layers=int(cfg.algo.critic.mlp_layers),
+        critic_dense_act=cfg.algo.critic.dense_act,
+        critic_layer_norm=bool(cfg.algo.critic.layer_norm),
+    )
+
+
+def init_agent_params(agent: RecurrentPPOAgent, observation_space, cnn_keys, mlp_keys, key):
+    dummy_obs = {}
+    for k in list(cnn_keys) + list(mlp_keys):
+        shape = observation_space[k].shape
+        if k in cnn_keys:
+            dummy_obs[k] = jnp.zeros((1, 1, int(np.prod(shape[:-2])), *shape[-2:]), jnp.float32)
+        else:
+            dummy_obs[k] = jnp.zeros((1, 1, int(np.prod(shape))), jnp.float32)
+    act_dim = int(sum(agent.actions_dim))
+    return agent.init(
+        key,
+        dummy_obs,
+        jnp.zeros((1, 1, act_dim), jnp.float32),
+        jnp.zeros((1, 1, 1), jnp.float32),
+        agent.initial_hc(1),
+    )["params"]

@@ -1,0 +1,44 @@
+"""Recurrent-PPO evaluation entrypoint (reference
+``sheeprl/algos/ppo_recurrent/evaluate.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import numpy as np
+
+from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
+from sheeprl_tpu.algos.ppo_recurrent.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["ppo_recurrent"])
+def evaluate_ppo_recurrent(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger, log_dir = create_tensorboard_logger(cfg)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    env.close()
+
+    agent = build_agent(
+        cfg, actions_dim, is_continuous, list(cfg.cnn_keys.encoder), list(cfg.mlp_keys.encoder)
+    )
+    params = jax.tree_util.tree_map(np.asarray, state["params"])
+    test(agent, params, fabric, cfg, log_dir)

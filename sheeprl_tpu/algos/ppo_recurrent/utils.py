@@ -1,0 +1,69 @@
+"""Recurrent-PPO helpers (reference ``sheeprl/algos/ppo_recurrent/utils.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
+from sheeprl_tpu.algos.ppo_recurrent.agent import greedy_actions
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+
+
+def test(agent, params, fabric, cfg, log_dir: str) -> None:
+    """Greedy single-env episode carrying the LSTM state
+    (reference utils.py:14-63)."""
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+    obs_keys = mlp_keys + cnn_keys
+    act_dim = int(sum(agent.actions_dim))
+
+    @jax.jit
+    def act(params, obs, prev_actions, is_first, hc):
+        norm = normalize_obs(obs, cnn_keys, obs_keys)
+        seq_obs = {k: v[None] for k, v in norm.items()}
+        pre_dist, _, hc = agent.apply(
+            {"params": params}, seq_obs, prev_actions[None], is_first[None], hc
+        )
+        return greedy_actions([p[0] for p in pre_dist], agent.is_continuous), hc
+
+    done = False
+    cumulative_rew = 0.0
+    o = env.reset(seed=cfg.seed)[0]
+    hc = agent.initial_hc(1)
+    prev_actions = jnp.zeros((1, act_dim), jnp.float32)
+    is_first = jnp.ones((1, 1), jnp.float32)
+    while not done:
+        obs = prepare_obs(o, cnn_keys, 1)
+        real_actions, hc = act(params, obs, prev_actions, is_first, hc)
+        real = np.asarray(real_actions)
+        if agent.is_continuous:
+            prev_actions = jnp.asarray(real, jnp.float32).reshape(1, -1)
+        else:
+            onehots = [
+                jax.nn.one_hot(jnp.asarray(real[..., i]), d)
+                for i, d in enumerate(agent.actions_dim)
+            ]
+            prev_actions = jnp.concatenate(onehots, -1).reshape(1, -1)
+        is_first = jnp.zeros((1, 1), jnp.float32)
+        o, reward, terminated, truncated, _ = env.step(
+            real.reshape(env.action_space.shape)
+        )
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    fabric.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

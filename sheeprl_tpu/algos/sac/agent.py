@@ -143,7 +143,14 @@ def build_agent_state(
 
 
 def action_bounds(action_space) -> Tuple[np.ndarray, np.ndarray]:
-    """(scale, bias) from the env action bounds (reference buffers :86-88)."""
+    """(scale, bias) from the env action bounds (reference buffers :86-88).
+    Unbounded dims fall back to scale 1 / bias 0 (tanh range) so the
+    squashed log-prob stays finite."""
     low = np.asarray(action_space.low, np.float32).reshape(-1)
     high = np.asarray(action_space.high, np.float32).reshape(-1)
-    return (high - low) / 2.0, (high + low) / 2.0
+    unbounded = ~(np.isfinite(low) & np.isfinite(high))
+    low = np.where(unbounded, -1.0, low)
+    high = np.where(unbounded, 1.0, high)
+    scale = (high - low) / 2.0
+    bias = (high + low) / 2.0
+    return scale.astype(np.float32), bias.astype(np.float32)

@@ -1,0 +1,397 @@
+"""SAC-AE agent — pixel SAC with a convolutional autoencoder.
+
+Behavioral contract from the reference ``sheeprl/algos/sac_ae/agent.py``
+(CNNEncoder :19-77, MLPEncoder :79-107, MLPDecoder :109-138, CNNDecoder
+:140-189, SACAEQFunction :191-211, SACAECritic :213-225,
+SACAEContinuousActor :227-320, SACAEAgent :323-520):
+
+- the conv encoder is 4×(k=3 convs, stride [2,1,1,1]) + a
+  ``Dense→LayerNorm→tanh`` projection; ``detach_encoder_features`` stops
+  gradients *between* the convs and the projection (reference :70-77);
+- the critic owns the encoder (so the Q loss trains it); the actor reuses
+  the critic's encoder but only its own trunk/head parameters receive
+  gradients;
+- the decoder inverts the convs and regresses 5-bit-quantized pixels with an
+  L2 penalty on the latent (reference sac_ae.py:115-131);
+- separate EMA taus for the target Q heads (``algo.tau``) and the target
+  encoder (``algo.encoder.tau``);
+- delta-orthogonal init for convs, orthogonal for linears (reference
+  utils.py weight_init :74-93).
+
+TPU-native: the twin-Q ensemble is stacked params under ``jax.vmap`` (one
+batched matmul), targets are plain pytrees EMA'd inside the jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models.models import MLP, resolve_activation
+
+LOG_STD_MAX = 2.0
+LOG_STD_MIN = -10.0
+
+sg = jax.lax.stop_gradient
+
+
+def conv_output_hw(screen: int) -> int:
+    """Spatial size after the k=3 stride-[2,1,1,1] encoder stack."""
+    h = (screen - 3) // 2 + 1
+    for _ in range(3):
+        h = h - 2
+    return h
+
+
+class SACAECNNEncoder(nn.Module):
+    """Conv stack + Dense/LayerNorm/tanh projection (reference :19-77).
+    Input ``[..., C, H, W]``; ``detach_conv`` stops gradients before the
+    projection."""
+
+    keys: Sequence[str]
+    features_dim: int
+    channels_multiplier: int = 1
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jnp.ndarray], detach_conv: bool = False) -> jnp.ndarray:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-3)
+        lead = x.shape[:-3]
+        x = jnp.reshape(x, (-1,) + x.shape[-3:])
+        x = jnp.moveaxis(x, -3, -1)  # NHWC
+        for i, stride in enumerate((2, 1, 1, 1)):
+            x = nn.Conv(32 * self.channels_multiplier, (3, 3), strides=(stride, stride), padding="VALID")(x)
+            x = nn.relu(x)
+        x = jnp.reshape(x, (x.shape[0], -1))
+        if detach_conv:
+            x = sg(x)
+        x = nn.Dense(self.features_dim)(x)
+        x = nn.LayerNorm()(x)
+        x = jnp.tanh(x)
+        return jnp.reshape(x, lead + (self.features_dim,))
+
+
+class SACAEMLPEncoder(nn.Module):
+    """Dense stack over the vector keys (reference :79-107)."""
+
+    keys: Sequence[str]
+    dense_units: int = 64
+    mlp_layers: int = 2
+    activation: Any = "relu"
+    layer_norm: bool = False
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jnp.ndarray], detach_conv: bool = False) -> jnp.ndarray:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        x = MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+        )(x)
+        if detach_conv:
+            x = sg(x)
+        return x
+
+
+class SACAEEncoder(nn.Module):
+    """Concat of cnn/mlp sub-encoders (reference MultiEncoder wiring,
+    sac_ae.py :216-243)."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    features_dim: int
+    channels_multiplier: int = 1
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: Any = "relu"
+    layer_norm: bool = False
+
+    @property
+    def output_dim(self) -> int:
+        dim = self.features_dim if self.cnn_keys else 0
+        dim += self.dense_units if self.mlp_keys else 0
+        return dim
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jnp.ndarray], detach_conv: bool = False) -> jnp.ndarray:
+        feats = []
+        if self.cnn_keys:
+            feats.append(
+                SACAECNNEncoder(
+                    keys=self.cnn_keys,
+                    features_dim=self.features_dim,
+                    channels_multiplier=self.channels_multiplier,
+                    name="cnn_encoder",
+                )(obs, detach_conv)
+            )
+        if self.mlp_keys:
+            feats.append(
+                SACAEMLPEncoder(
+                    keys=self.mlp_keys,
+                    dense_units=self.dense_units,
+                    mlp_layers=self.mlp_layers,
+                    activation=self.dense_act,
+                    layer_norm=self.layer_norm,
+                    name="mlp_encoder",
+                )(obs, detach_conv)
+            )
+        return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
+
+
+class SACAECNNDecoder(nn.Module):
+    """Inverse of the conv encoder (reference :140-189): Dense back to the
+    conv map, 3×(k=3, s=1) transposed convs, then a final k=3/s=2 transposed
+    conv with output-padding 1 back to ``screen×screen``."""
+
+    output_channels: Sequence[int]
+    conv_hw: int
+    channels_multiplier: int = 1
+
+    @nn.compact
+    def __call__(self, latent: jnp.ndarray) -> jnp.ndarray:
+        c = 32 * self.channels_multiplier
+        lead = latent.shape[:-1]
+        x = nn.Dense(c * self.conv_hw * self.conv_hw)(latent)
+        x = jnp.reshape(x, (-1, self.conv_hw, self.conv_hw, c))
+        for _ in range(3):
+            x = nn.ConvTranspose(c, (3, 3), strides=(1, 1), padding="VALID", transpose_kernel=True)(x)
+            x = nn.relu(x)
+        x = nn.ConvTranspose(
+            sum(self.output_channels), (3, 3), strides=(2, 2), padding="VALID", transpose_kernel=True
+        )(x)
+        # torch output_padding=1: one extra row/col at bottom/right
+        x = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
+        x = jnp.moveaxis(x, -1, -3)  # back to CHW
+        return jnp.reshape(x, lead + x.shape[1:])
+
+
+class SACAEMLPDecoder(nn.Module):
+    """Dense trunk + per-key heads (reference :109-138)."""
+
+    keys: Sequence[str]
+    output_dims: Sequence[int]
+    dense_units: int = 64
+    mlp_layers: int = 2
+    activation: Any = "relu"
+
+    @nn.compact
+    def __call__(self, latent: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        x = MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation=self.activation,
+        )(latent)
+        return {
+            k: nn.Dense(dim, name=f"head_{k}")(x)
+            for k, dim in zip(self.keys, self.output_dims)
+        }
+
+
+class SACAEDecoder(nn.Module):
+    """Per-key reconstructions from the encoder latent."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_channels: Sequence[int]
+    mlp_dims: Sequence[int]
+    conv_hw: int
+    channels_multiplier: int = 1
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: Any = "relu"
+
+    @nn.compact
+    def __call__(self, latent: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        out: Dict[str, jnp.ndarray] = {}
+        if self.cnn_keys:
+            rec = SACAECNNDecoder(
+                output_channels=self.cnn_channels,
+                conv_hw=self.conv_hw,
+                channels_multiplier=self.channels_multiplier,
+                name="cnn_decoder",
+            )(latent)
+            if len(self.cnn_keys) > 1:
+                parts = jnp.split(rec, np.cumsum(np.asarray(self.cnn_channels))[:-1], axis=-3)
+            else:
+                parts = [rec]
+            out.update({k: v for k, v in zip(self.cnn_keys, parts)})
+        if self.mlp_keys:
+            out.update(
+                SACAEMLPDecoder(
+                    keys=self.mlp_keys,
+                    output_dims=self.mlp_dims,
+                    dense_units=self.dense_units,
+                    mlp_layers=self.mlp_layers,
+                    activation=self.dense_act,
+                    name="mlp_decoder",
+                )(latent)
+            )
+        return out
+
+
+class SACAEQFunction(nn.Module):
+    """Q(features, action) MLP (reference :191-211); applied under vmap over
+    the stacked twin-critic axis."""
+
+    hidden_size: int = 1024
+
+    @nn.compact
+    def __call__(self, features: jnp.ndarray, action: jnp.ndarray) -> jnp.ndarray:
+        x = jnp.concatenate([features, action], -1)
+        x = MLP(hidden_sizes=(self.hidden_size, self.hidden_size), activation="relu")(x)
+        return nn.Dense(1)(x)
+
+
+class SACAEActorTrunk(nn.Module):
+    """Actor trunk + (mean, log_std) heads over encoder features
+    (reference SACAEContinuousActor :227-320): the log-std is tanh-scaled
+    into [LOG_STD_MIN, LOG_STD_MAX]."""
+
+    action_dim: int
+    hidden_size: int = 1024
+
+    @nn.compact
+    def __call__(self, features: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = MLP(hidden_sizes=(self.hidden_size, self.hidden_size), activation="relu")(features)
+        mean = nn.Dense(self.action_dim, name="fc_mean")(x)
+        log_std = nn.Dense(self.action_dim, name="fc_logstd")(x)
+        log_std = jnp.tanh(log_std)
+        log_std = LOG_STD_MIN + 0.5 * (LOG_STD_MAX - LOG_STD_MIN) * (log_std + 1.0)
+        return mean, jnp.exp(log_std)
+
+
+# ---------------------------------------------------------------------------
+# ensemble helpers (same stacked-params pattern as sac/agent.py)
+# ---------------------------------------------------------------------------
+
+
+def init_qf_ensemble(qf: SACAEQFunction, n: int, feat_dim: int, act_dim: int, key: jax.Array):
+    keys = jax.random.split(key, n)
+    trees = [
+        qf.init(k, jnp.zeros((1, feat_dim)), jnp.zeros((1, act_dim)))["params"] for k in keys
+    ]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, 0), *trees)
+
+
+def ensemble_q(qf: SACAEQFunction, stacked, features, action) -> jnp.ndarray:
+    out = jax.vmap(lambda p: qf.apply({"params": p}, features, action))(stacked)
+    return jnp.moveaxis(out[..., 0], 0, -1)  # [..., n_critics]
+
+
+# ---------------------------------------------------------------------------
+# init (reference utils.py weight_init :74-93)
+# ---------------------------------------------------------------------------
+
+
+def _orthogonal(key: jax.Array, shape, dtype=jnp.float32) -> jnp.ndarray:
+    return nn.initializers.orthogonal()(key, shape, dtype)
+
+
+def sac_ae_weight_init(params: Dict[str, Any], key: jax.Array) -> Dict[str, Any]:
+    """Orthogonal init for dense kernels; delta-orthogonal for convs (the
+    center spatial tap is orthogonal, the rest zero); biases zero."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    keys = jax.random.split(key, max(len(flat), 1))
+    leaves = []
+    for i, (path, leaf) in enumerate(flat):
+        name = "/".join(getattr(p, "key", str(p)) for p in path)
+        if name.endswith("kernel") and leaf.ndim == 2:
+            leaves.append(_orthogonal(keys[i], leaf.shape, leaf.dtype))
+        elif name.endswith("kernel") and leaf.ndim == 4:
+            kh, kw = leaf.shape[:2]
+            center = jnp.zeros_like(leaf)
+            tap = _orthogonal(keys[i], leaf.shape[2:], leaf.dtype)
+            leaves.append(center.at[kh // 2, kw // 2].set(tap))
+        elif name.endswith("bias"):
+            leaves.append(jnp.zeros_like(leaf))
+        else:
+            leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+
+def build_agent(cfg, act_dim: int, observation_space, key: jax.Array):
+    """Construct module defs + initialized params.
+
+    Returns ``(encoder, decoder, qf, actor_trunk, params)`` with ``params =
+    {encoder, target_encoder, qfs, target_qfs, actor, decoder, log_alpha}``.
+    """
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+    screen = int(cfg.env.screen_size)
+    cnn_channels = [int(np.prod(observation_space[k].shape[:-2])) for k in cnn_keys]
+    mlp_dims = [int(np.prod(observation_space[k].shape)) for k in mlp_keys]
+
+    encoder = SACAEEncoder(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        features_dim=int(cfg.algo.encoder.features_dim),
+        channels_multiplier=int(cfg.algo.encoder.cnn_channels_multiplier),
+        dense_units=int(cfg.algo.encoder.dense_units),
+        mlp_layers=int(cfg.algo.encoder.mlp_layers),
+        dense_act=cfg.algo.encoder.dense_act,
+        layer_norm=bool(cfg.algo.encoder.layer_norm),
+    )
+    decoder = SACAEDecoder(
+        cnn_keys=list(cfg.cnn_keys.decoder),
+        mlp_keys=list(cfg.mlp_keys.decoder),
+        cnn_channels=[int(np.prod(observation_space[k].shape[:-2])) for k in cfg.cnn_keys.decoder],
+        mlp_dims=[int(np.prod(observation_space[k].shape)) for k in cfg.mlp_keys.decoder],
+        conv_hw=conv_output_hw(screen),
+        channels_multiplier=int(cfg.algo.decoder.cnn_channels_multiplier),
+        dense_units=int(cfg.algo.decoder.dense_units),
+        mlp_layers=int(cfg.algo.decoder.mlp_layers),
+        dense_act=cfg.algo.decoder.dense_act,
+    )
+    qf = SACAEQFunction(hidden_size=int(cfg.algo.critic.hidden_size))
+    actor_trunk = SACAEActorTrunk(
+        action_dim=act_dim, hidden_size=int(cfg.algo.actor.hidden_size)
+    )
+
+    k_enc, k_qf, k_actor, k_dec, k_i1, k_i2, k_i3, k_i4 = jax.random.split(key, 8)
+    dummy_obs = {}
+    for k, ch in zip(cnn_keys, cnn_channels):
+        dummy_obs[k] = jnp.zeros((1, ch, screen, screen), jnp.float32)
+    for k, dim in zip(mlp_keys, mlp_dims):
+        dummy_obs[k] = jnp.zeros((1, dim), jnp.float32)
+
+    enc_params = encoder.init(k_enc, dummy_obs)["params"]
+    feat_dim = encoder.output_dim
+    qfs = init_qf_ensemble(qf, int(cfg.algo.critic.n), feat_dim, act_dim, k_qf)
+    actor_params = actor_trunk.init(k_actor, jnp.zeros((1, feat_dim)))["params"]
+    dec_params = decoder.init(k_dec, jnp.zeros((1, feat_dim)))["params"]
+
+    enc_params = sac_ae_weight_init(enc_params, k_i1)
+    qfs = sac_ae_weight_init(qfs, k_i2)
+    actor_params = sac_ae_weight_init(actor_params, k_i3)
+    dec_params = sac_ae_weight_init(dec_params, k_i4)
+
+    params = {
+        "encoder": enc_params,
+        "target_encoder": jax.tree_util.tree_map(jnp.copy, enc_params),
+        "qfs": qfs,
+        "target_qfs": jax.tree_util.tree_map(jnp.copy, qfs),
+        "actor": actor_params,
+        "decoder": dec_params,
+        "log_alpha": jnp.log(jnp.float32(cfg.algo.alpha.alpha)),
+    }
+    return encoder, decoder, qf, actor_trunk, params
+
+
+def preprocess_obs(obs: jnp.ndarray, bits: int = 8, key=None) -> jnp.ndarray:
+    """Bit-quantized pixel target (reference utils.py:63-71,
+    https://arxiv.org/abs/1807.03039): floor to ``bits`` bits, rescale to
+    [−0.5, 0.5] with uniform dequantization noise when a key is given."""
+    bins = 2**bits
+    if bits < 8:
+        obs = jnp.floor(obs / 2 ** (8 - bits))
+    obs = obs / bins
+    if key is not None:
+        obs = obs + jax.random.uniform(key, obs.shape, obs.dtype) / bins
+    return obs - 0.5

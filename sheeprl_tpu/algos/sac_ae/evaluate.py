@@ -1,0 +1,46 @@
+"""SAC-AE evaluation entrypoint (reference ``sheeprl/algos/sac_ae/evaluate.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import action_bounds
+from sheeprl_tpu.algos.sac_ae.agent import build_agent
+from sheeprl_tpu.algos.sac_ae.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["sac_ae"])
+def evaluate_sac_ae(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger, log_dir = create_tensorboard_logger(cfg)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC-AE agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    act_dim = int(np.prod(action_space.shape))
+    action_scale, action_bias = action_bounds(action_space)
+    env.close()
+
+    encoder, decoder, qf, actor_trunk, _ = build_agent(
+        cfg, act_dim, observation_space, jax.random.PRNGKey(cfg.seed)
+    )
+    params = jax.tree_util.tree_map(np.asarray, state["agent"])
+    test(
+        encoder, actor_trunk, params,
+        jnp.asarray(action_scale), jnp.asarray(action_bias),
+        fabric, cfg, log_dir,
+    )

@@ -1,0 +1,59 @@
+"""SAC-AE helpers (reference ``sheeprl/algos/sac_ae/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs  # noqa: F401  (same dict-obs shaping)
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+    "Loss/reconstruction_loss",
+}
+
+
+def normalize_obs_jnp(obs: Dict[str, np.ndarray], cnn_keys) -> Dict[str, jnp.ndarray]:
+    """uint8 pixels → [0, 1] floats on device (reference train :67-75)."""
+    return {
+        k: (jnp.asarray(v, jnp.float32) / 255.0 if k in cnn_keys else jnp.asarray(v, jnp.float32))
+        for k, v in obs.items()
+    }
+
+
+def test(encoder, actor_trunk, params, action_scale, action_bias, fabric, cfg, log_dir: str) -> None:
+    """Greedy single-env evaluation episode (reference utils.py:23-50)."""
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+
+    @jax.jit
+    def act(p, obs):
+        feat = encoder.apply({"params": p["encoder"]}, obs)
+        mean, _ = actor_trunk.apply({"params": p["actor"]}, feat)
+        return jnp.tanh(mean) * action_scale + action_bias
+
+    done = False
+    cumulative_rew = 0.0
+    o = env.reset(seed=cfg.seed)[0]
+    while not done:
+        obs = prepare_obs(o, cnn_keys, mlp_keys, 1)
+        norm = normalize_obs_jnp(obs, cnn_keys)
+        action = np.asarray(act(params, norm))
+        o, reward, terminated, truncated, _ = env.step(action.reshape(env.action_space.shape))
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    fabric.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

@@ -238,9 +238,10 @@ class Fabric:
             restored = ckptr.restore(path)
         if state is not None:
             out = conform_pytree(state, restored)
-            for k in restored:
-                if isinstance(restored, dict) and k not in out:
-                    out[k] = restored[k]
+            if isinstance(restored, dict):
+                for k in restored:
+                    if k not in out:
+                        out[k] = restored[k]
             return out
         return restored
 

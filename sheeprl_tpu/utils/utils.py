@@ -222,5 +222,11 @@ def conform_pytree(template: Any, restored: Any) -> Any:
     if isinstance(template, (list, tuple)):
         if restored is None:
             return template
+        if len(template) != len(restored):
+            raise ValueError(
+                f"conform_pytree: structure length mismatch — template has "
+                f"{len(template)} entries, restored has {len(restored)} "
+                "(checkpoint saved with a different optimizer/transform chain?)"
+            )
         return type(template)(conform_pytree(t, r) for t, r in zip(template, restored))
     return restored

@@ -1,0 +1,68 @@
+"""A2C tests: CLI dry runs (the reference's newer test snapshot exercises
+``exp=a2c``, tests/test_algos/test_algos.py:146-161)."""
+
+import pytest
+
+from sheeprl_tpu import cli
+
+
+def a2c_args(tmp_path, extra=()):
+    return [
+        "dry_run=True",
+        "env=dummy",
+        "env.sync_env=True",
+        "checkpoint.every=1000000",
+        "metric.log_every=1000000",
+        "metric.log_level=0",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+        f"root_dir={tmp_path}/logs",
+        "run_name=test",
+        "exp=a2c",
+        "fabric.accelerator=cpu",
+        "algo.rollout_steps=4",
+        "per_rank_batch_size=4",
+        "algo.dense_units=8",
+        *extra,
+    ]
+
+
+@pytest.fixture(params=["1", "2"])
+def devices(request):
+    return request.param
+
+
+@pytest.mark.parametrize(
+    "env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"]
+)
+def test_a2c(tmp_path, devices, env_id, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        a2c_args(
+            tmp_path,
+            [
+                f"fabric.devices={devices}",
+                f"env.id={env_id}",
+                "cnn_keys.encoder=[rgb]",
+                "mlp_keys.encoder=[]",
+                "algo.encoder.cnn_features_dim=16",
+            ],
+        )
+    )
+
+
+def test_a2c_mlp_obs(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        a2c_args(
+            tmp_path,
+            [
+                "fabric.devices=1",
+                "env=gym",
+                "env.id=CartPole-v1",
+                "env.sync_env=True",
+                "env.capture_video=False",
+            ],
+        )
+    )

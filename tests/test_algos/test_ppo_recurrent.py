@@ -1,0 +1,123 @@
+"""Recurrent-PPO tests: CLI dry runs over action types + LSTM-reset unit
+(reference ``tests/test_algos/test_algos.py`` ppo_recurrent case)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu import cli
+
+
+def ppo_rec_args(tmp_path, extra=()):
+    return [
+        "dry_run=True",
+        "env=dummy",
+        "env.sync_env=True",
+        "checkpoint.every=1000000",
+        "metric.log_every=1000000",
+        "metric.log_level=0",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+        f"root_dir={tmp_path}/logs",
+        "run_name=test",
+        "exp=ppo_recurrent",
+        "env.mask_velocities=False",
+        "fabric.accelerator=cpu",
+        "algo.rollout_steps=8",
+        "per_rank_sequence_length=4",
+        "per_rank_num_batches=2",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.rnn.lstm.hidden_size=8",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "cnn_keys.encoder=[rgb]",
+        "mlp_keys.encoder=[]",
+        *extra,
+    ]
+
+
+@pytest.fixture(params=["1", "2"])
+def devices(request):
+    return request.param
+
+
+@pytest.mark.parametrize(
+    "env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"]
+)
+def test_ppo_recurrent(tmp_path, devices, env_id, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(ppo_rec_args(tmp_path, [f"fabric.devices={devices}", f"env.id={env_id}"]))
+
+
+def test_ppo_recurrent_mlp_obs(tmp_path, monkeypatch):
+    """Vector path incl. the MaskVelocityWrapper (reference exp sets
+    env.mask_velocities=True on CartPole)."""
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        ppo_rec_args(
+            tmp_path,
+            [
+                "fabric.devices=1",
+                "env=gym",
+                "env.id=CartPole-v1",
+                "env.mask_velocities=True",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "cnn_keys.encoder=[]",
+                "mlp_keys.encoder=[state]",
+            ],
+        )
+    )
+
+
+def test_ppo_recurrent_checkpoint_resume(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        ppo_rec_args(
+            tmp_path,
+            [
+                "fabric.devices=1",
+                "env.id=discrete_dummy",
+                "checkpoint.every=1",
+                "checkpoint.save_last=True",
+            ],
+        )
+    )
+    import glob
+    import os
+
+    ckpts = glob.glob(f"{tmp_path}/logs/**/checkpoint/ckpt_*", recursive=True)
+    assert ckpts, "no checkpoint written"
+    cli.run(
+        ppo_rec_args(
+            tmp_path,
+            [
+                "fabric.devices=1",
+                "env.id=discrete_dummy",
+                f"checkpoint.resume_from={os.path.abspath(ckpts[-1])}",
+            ],
+        )
+    )
+
+
+def test_reset_lstm_cell_zeroes_state_at_episode_starts():
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.ppo_recurrent.agent import _ResetLSTMCell
+
+    cell = _ResetLSTMCell(hidden_size=4)
+    x = jnp.ones((3, 2))
+    carry = (jnp.ones((3, 4)), jnp.ones((3, 4)))
+    params = cell.init(jax.random.PRNGKey(0), carry, (x, jnp.zeros((3, 1))))["params"]
+
+    # no reset: carried state influences the output
+    (_, _), y_keep = cell.apply({"params": params}, carry, (x, jnp.zeros((3, 1))))
+    # full reset: output must equal a fresh-state step
+    (_, _), y_reset = cell.apply({"params": params}, carry, (x, jnp.ones((3, 1))))
+    zero_carry = (jnp.zeros((3, 4)), jnp.zeros((3, 4)))
+    (_, _), y_fresh = cell.apply({"params": params}, zero_carry, (x, jnp.zeros((3, 1))))
+
+    np.testing.assert_allclose(np.asarray(y_reset), np.asarray(y_fresh), atol=1e-6)
+    assert not np.allclose(np.asarray(y_keep), np.asarray(y_fresh))
