@@ -1,21 +1,13 @@
 """A2C losses (upstream sheeprl ``algos/a2c/loss.py``), pure jnp: a plain
-advantage-weighted policy gradient (no ratio clipping) and an MSE value
-loss."""
+advantage-weighted policy gradient (no ratio clipping) and an MSE value loss
+(PPO's value loss with clipping off)."""
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-
-def _reduce(x: jnp.ndarray, reduction: str) -> jnp.ndarray:
-    reduction = reduction.lower()
-    if reduction == "none":
-        return x
-    if reduction == "mean":
-        return x.mean()
-    if reduction == "sum":
-        return x.sum()
-    raise ValueError(f"Unrecognized reduction: {reduction}")
+from sheeprl_tpu.algos.ppo.loss import _reduce
+from sheeprl_tpu.algos.ppo.loss import value_loss as _ppo_value_loss
 
 
 def policy_loss(
@@ -25,4 +17,4 @@ def policy_loss(
 
 
 def value_loss(values: jnp.ndarray, returns: jnp.ndarray, reduction: str = "mean") -> jnp.ndarray:
-    return _reduce((values - returns) ** 2, reduction)
+    return _ppo_value_loss(values, values, returns, 0.0, clip_vloss=False, reduction=reduction)

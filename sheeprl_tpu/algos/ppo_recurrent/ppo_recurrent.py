@@ -209,13 +209,14 @@ def main(fabric, cfg: Dict[str, Any]):
             "params": params,
             "opt_state": opt_state,
             "update": 0,
-            "batch_size": 0,
+            "num_batches": 0,
             "last_log": 0,
             "last_checkpoint": 0,
         }
         state = fabric.load(cfg.checkpoint.resume_from, template)
         params = state["params"]
         opt_state = state["opt_state"]
+        cfg.per_rank_num_batches = int(np.asarray(state["num_batches"]))
     params = jax.device_put(params, fabric.replicated)
     opt_state = jax.device_put(opt_state, fabric.replicated)
 
@@ -513,7 +514,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 "params": jax.device_get(params),
                 "opt_state": jax.device_get(opt_state),
                 "update": update * world_size,
-                "batch_size": int(cfg.get("per_rank_num_batches", 1) or 1),
+                "num_batches": int(cfg.get("per_rank_num_batches", 1) or 1),
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
             }

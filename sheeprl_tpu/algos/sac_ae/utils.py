@@ -8,7 +8,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs  # noqa: F401  (same dict-obs shaping)
+from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401  (same dict-obs pipeline)
+    normalize_obs_jnp,
+    prepare_obs,
+)
 from sheeprl_tpu.utils.env import make_env
 
 AGGREGATOR_KEYS = {
@@ -19,14 +22,6 @@ AGGREGATOR_KEYS = {
     "Loss/alpha_loss",
     "Loss/reconstruction_loss",
 }
-
-
-def normalize_obs_jnp(obs: Dict[str, np.ndarray], cnn_keys) -> Dict[str, jnp.ndarray]:
-    """uint8 pixels → [0, 1] floats on device (reference train :67-75)."""
-    return {
-        k: (jnp.asarray(v, jnp.float32) / 255.0 if k in cnn_keys else jnp.asarray(v, jnp.float32))
-        for k, v in obs.items()
-    }
 
 
 def test(encoder, actor_trunk, params, action_scale, action_bias, fabric, cfg, log_dir: str) -> None:

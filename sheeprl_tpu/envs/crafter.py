@@ -1,0 +1,55 @@
+"""Crafter adapter (reference ``sheeprl/envs/crafter.py`` :17-65):
+``crafter_reward`` / ``crafter_nonreward`` variants behind the gymnasium API
+with a single ``rgb`` dict key. Import-gated on ``crafter``."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_CRAFTER_AVAILABLE
+
+if not _IS_CRAFTER_AVAILABLE:
+    raise ModuleNotFoundError("crafter is required: pip install crafter")
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import crafter
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+class CrafterWrapper(gym.Wrapper):
+    def __init__(self, id: str, screen_size: Union[int, Tuple[int, int]], seed: Optional[int] = None):
+        if id not in ("crafter_reward", "crafter_nonreward"):
+            raise ValueError(f"Unknown crafter id: {id}")
+        if isinstance(screen_size, int):
+            screen_size = (screen_size, screen_size)
+        env = crafter.Env(size=screen_size, seed=seed, reward=(id == "crafter_reward"))
+        super().__init__(env)
+        inner = self.env.observation_space
+        self.observation_space = spaces.Dict(
+            {"rgb": spaces.Box(inner.low, inner.high, inner.shape, inner.dtype)}
+        )
+        self.action_space = spaces.Discrete(self.env.action_space.n)
+        self.reward_range = self.env.reward_range or (-np.inf, np.inf)
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+        self._render_mode = "rgb_array"
+        self._metadata = {"render_fps": 30}
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def step(self, action: Any):
+        obs, reward, done, info = self.env.step(action)
+        return {"rgb": obs}, reward, done, False, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs = self.env.reset()
+        return {"rgb": obs}, {}
+
+    def render(self):
+        return self.env.render()
+
+    def close(self) -> None:
+        return

@@ -1,0 +1,134 @@
+"""DIAMBRA Arena adapter (reference ``sheeprl/envs/diambra.py`` :23-138):
+arcade fighting games with discrete / multi-discrete action spaces; every
+non-Box observation key is normalized to an integer Box. Import-gated on
+``diambra`` + ``diambra.arena``."""
+
+from __future__ import annotations
+
+import warnings
+
+from sheeprl_tpu.utils.imports import (
+    _IS_DIAMBRA_ARENA_AVAILABLE,
+    _IS_DIAMBRA_AVAILABLE,
+)
+
+if not _IS_DIAMBRA_AVAILABLE or not _IS_DIAMBRA_ARENA_AVAILABLE:
+    raise ModuleNotFoundError(
+        "diambra and diambra-arena are required: pip install diambra diambra-arena"
+    )
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import diambra
+import diambra.arena
+import gymnasium as gym
+import numpy as np
+from diambra.arena import EnvironmentSettings, SpaceTypes, WrappersSettings
+
+
+def _resolve_space_type(name: str):
+    # the config carries the reference's dotted string form
+    return SpaceTypes.DISCRETE if name.rsplit(".", 1)[-1] == "DISCRETE" else SpaceTypes.MULTI_DISCRETE
+
+
+class DiambraWrapper(gym.Wrapper):
+    def __init__(
+        self,
+        id: str,
+        action_space: str = "diambra.arena.SpaceTypes.DISCRETE",
+        screen_size: Union[int, Tuple[int, int]] = 64,
+        grayscale: bool = False,
+        repeat_action: int = 1,
+        rank: int = 0,
+        diambra_settings: Optional[Dict[str, Any]] = None,
+        diambra_wrappers: Optional[Dict[str, Any]] = None,
+        render_mode: str = "rgb_array",
+        log_level: int = 0,
+        increase_performance: bool = True,
+    ) -> None:
+        if isinstance(screen_size, int):
+            screen_size = (screen_size, screen_size)
+        diambra_settings = dict(diambra_settings or {})
+        diambra_wrappers = dict(diambra_wrappers or {})
+
+        for disabled in ("frame_shape", "n_players"):
+            if diambra_settings.pop(disabled, None) is not None:
+                warnings.warn(f"The DIAMBRA {disabled} setting is disabled")
+        role = diambra_settings.pop("role", None)
+        self._action_type = (
+            "discrete" if _resolve_space_type(action_space) == SpaceTypes.DISCRETE else "multi-discrete"
+        )
+        settings = EnvironmentSettings(
+            **diambra_settings,
+            game_id=id,
+            action_space=_resolve_space_type(action_space),
+            n_players=1,
+            role=role,
+            render_mode=render_mode,
+        )
+        if repeat_action > 1:
+            if getattr(settings, "step_ratio", 1) > 1:
+                warnings.warn(
+                    f"step_ratio modified to 1 because the sticky action is active ({repeat_action})"
+                )
+            settings.step_ratio = 1
+        for disabled in ("frame_shape", "stack_frames", "dilation", "flatten"):
+            if diambra_wrappers.pop(disabled, None) is not None:
+                warnings.warn(f"The DIAMBRA {disabled} wrapper is disabled")
+        wrappers = WrappersSettings(
+            **diambra_wrappers,
+            flatten=True,
+            repeat_action=repeat_action,
+        )
+        # resize in the engine (fast) or in the wrapper (reference :79-83)
+        if increase_performance:
+            settings.frame_shape = screen_size + (int(grayscale),)
+        else:
+            wrappers.frame_shape = screen_size + (int(grayscale),)
+        env = diambra.arena.make(
+            id, settings, wrappers, rank=rank, render_mode=render_mode, log_level=log_level
+        )
+        super().__init__(env)
+
+        self.action_space = self.env.action_space
+        obs = {}
+        for k, space in self.env.observation_space.spaces.items():
+            if isinstance(space, gym.spaces.Box):
+                obs[k] = space
+            elif isinstance(space, gym.spaces.Discrete):
+                obs[k] = gym.spaces.Box(0, space.n - 1, (1,), np.int32)
+            elif isinstance(space, gym.spaces.MultiDiscrete):
+                obs[k] = gym.spaces.Box(
+                    np.zeros_like(space.nvec), space.nvec - 1, (len(space.nvec),), np.int32
+                )
+            else:
+                raise RuntimeError(f"Invalid observation space, got: {type(space)}")
+        self.observation_space = gym.spaces.Dict(obs)
+        self._render_mode = render_mode
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def __getattr__(self, name):
+        return getattr(self.env, name)
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {
+            k: np.asarray(v).reshape(self.observation_space[k].shape) for k, v in obs.items()
+        }
+
+    def step(self, action: Any):
+        if self._action_type == "discrete" and isinstance(action, np.ndarray):
+            action = action.squeeze().item()
+        obs, reward, done, truncated, infos = self.env.step(action)
+        infos["env_domain"] = "DIAMBRA"
+        return self._convert_obs(obs), reward, done or infos.get("env_done", False), truncated, infos
+
+    def render(self, mode: str = "rgb_array", **kwargs):
+        return self.env.render()
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs, infos = self.env.reset(seed=seed, options=options)
+        infos["env_domain"] = "DIAMBRA"
+        return self._convert_obs(obs), infos

@@ -1,0 +1,189 @@
+"""DeepMind Control Suite adapter.
+
+Behavioral contract from the reference ``sheeprl/envs/dmc.py`` (DMCWrapper
+:49-234, itself adapted from dmc2gym): spec→Box conversion, a normalized
+``[-1, 1]`` action space rescaled to the true bounds, pixel and/or flattened
+vector observations under the ``rgb``/``state`` keys, and
+``discount``/``internal_state`` extras per step.
+
+Import-gated: requires ``dm_control`` (reference imports.py probe).
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_DMC_AVAILABLE
+
+if not _IS_DMC_AVAILABLE:
+    raise ModuleNotFoundError(
+        "dm_control is required for the DMC environments: pip install dm_control"
+    )
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+from dm_control import suite
+from dm_env import specs
+from gymnasium import spaces
+
+
+def _spec_to_box(spec_list, dtype) -> spaces.Box:
+    """dm_env specs → one flat gym Box (reference :17-38)."""
+    lows, highs = [], []
+    for s in spec_list:
+        dim = int(np.prod(s.shape))
+        if isinstance(s, specs.BoundedArray):
+            lows.append(np.broadcast_to(s.minimum, (dim,)).astype(np.float32))
+            highs.append(np.broadcast_to(s.maximum, (dim,)).astype(np.float32))
+        elif isinstance(s, specs.Array):
+            lows.append(np.full(dim, -np.inf, np.float32))
+            highs.append(np.full(dim, np.inf, np.float32))
+        else:
+            raise ValueError(f"Unrecognized spec: {type(s)}")
+    low = np.concatenate(lows).astype(dtype)
+    high = np.concatenate(highs).astype(dtype)
+    return spaces.Box(low, high, dtype=dtype)
+
+
+def _flatten_obs(obs: Dict[Any, Any]) -> np.ndarray:
+    pieces = [np.array([v]) if np.isscalar(v) else np.asarray(v).ravel() for v in obs.values()]
+    return np.concatenate(pieces, axis=0)
+
+
+class DMCWrapper(gym.Env):
+    """dm_control task behind the gymnasium API (reference :49-234; a plain
+    ``gym.Env`` holding the dm_env, since gymnasium 1.x ``Wrapper`` refuses
+    non-gymnasium inner envs)."""
+
+    def __init__(
+        self,
+        id: str,
+        from_pixels: bool = False,
+        from_vectors: bool = True,
+        height: int = 84,
+        width: int = 84,
+        camera_id: int = 0,
+        task_kwargs: Optional[Dict[Any, Any]] = None,
+        environment_kwargs: Optional[Dict[Any, Any]] = None,
+        channels_first: bool = True,
+        visualize_reward: bool = False,
+        seed: Optional[int] = None,
+    ):
+        if not (from_vectors or from_pixels):
+            raise ValueError(
+                "'from_vectors' and 'from_pixels' must not be both False: "
+                f"got {from_vectors} and {from_pixels} respectively."
+            )
+        domain_name, task_name = id.split("_", 1)
+        self._from_pixels = from_pixels
+        self._from_vectors = from_vectors
+        self._height = height
+        self._width = width
+        self._camera_id = camera_id
+        self._channels_first = channels_first
+
+        env = suite.load(
+            domain_name=domain_name,
+            task_name=task_name,
+            task_kwargs=task_kwargs,
+            visualize_reward=visualize_reward,
+            environment_kwargs=environment_kwargs,
+        )
+        self.env = env
+
+        self._true_action_space = _spec_to_box([env.action_spec()], np.float32)
+        self._norm_action_space = spaces.Box(
+            low=-1.0, high=1.0, shape=self._true_action_space.shape, dtype=np.float32
+        )
+        reward_space = _spec_to_box([env.reward_spec()], np.float32)
+        self._reward_range = (float(reward_space.low.item()), float(reward_space.high.item()))
+
+        obs_space = {}
+        if from_pixels:
+            shape = (3, height, width) if channels_first else (height, width, 3)
+            obs_space["rgb"] = spaces.Box(0, 255, shape, np.uint8)
+        if from_vectors:
+            obs_space["state"] = _spec_to_box(env.observation_spec().values(), np.float64)
+        self._observation_space = spaces.Dict(obs_space)
+        self._state_space = _spec_to_box(env.observation_spec().values(), np.float64)
+        self.current_state = None
+        self._render_mode = "rgb_array"
+        self._metadata = {}
+        self.seed(seed=seed)
+
+    def __getattr__(self, name):
+        if name.startswith("_") or name == "env":
+            raise AttributeError(name)
+        return getattr(self.env, name)
+
+    @property
+    def observation_space(self):
+        return self._observation_space
+
+    @property
+    def state_space(self) -> spaces.Box:
+        return self._state_space
+
+    @property
+    def action_space(self) -> spaces.Box:
+        return self._norm_action_space
+
+    @property
+    def reward_range(self) -> Tuple[float, float]:
+        return self._reward_range
+
+    @property
+    def render_mode(self) -> str:
+        return self._render_mode
+
+    def seed(self, seed: Optional[int] = None):
+        self._true_action_space.seed(seed)
+        self._norm_action_space.seed(seed)
+        self._observation_space.seed(seed)
+
+    def _get_obs(self, time_step) -> Dict[str, np.ndarray]:
+        obs = {}
+        if self._from_pixels:
+            rgb = self.render(camera_id=self._camera_id)
+            if self._channels_first:
+                rgb = rgb.transpose(2, 0, 1).copy()
+            obs["rgb"] = rgb
+        if self._from_vectors:
+            obs["state"] = _flatten_obs(time_step.observation)
+        return obs
+
+    def _denormalize_action(self, action: np.ndarray) -> np.ndarray:
+        """[-1, 1] → true bounds (reference :180-188)."""
+        action = np.asarray(action, np.float64)
+        frac = (action - self._norm_action_space.low) / (
+            self._norm_action_space.high - self._norm_action_space.low
+        )
+        true = frac * (
+            self._true_action_space.high - self._true_action_space.low
+        ) + self._true_action_space.low
+        return true.astype(np.float32)
+
+    def step(self, action):
+        time_step = self.env.step(self._denormalize_action(action))
+        reward = time_step.reward or 0.0
+        done = time_step.last()
+        obs = self._get_obs(time_step)
+        self.current_state = _flatten_obs(time_step.observation)
+        extra = {
+            "discount": time_step.discount,
+            "internal_state": self.env.physics.get_state().copy(),
+        }
+        return obs, reward, done, False, extra
+
+    def reset(self, seed: Optional[int] = None, options=None):
+        time_step = self.env.reset()
+        self.current_state = _flatten_obs(time_step.observation)
+        return self._get_obs(time_step), {}
+
+    def render(self, camera_id: Optional[int] = None) -> np.ndarray:
+        return self.env.physics.render(
+            height=self._height, width=self._width, camera_id=camera_id or self._camera_id
+        )
+
+    def close(self) -> None:
+        self.env.close()
