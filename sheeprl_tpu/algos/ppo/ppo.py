@@ -80,6 +80,7 @@ def build_update_fn(
     cfg,
     fabric,
     n_local: int,
+    donate: bool = True,
 ):
     """Compile the full PPO update as one SPMD program.
 
@@ -164,7 +165,9 @@ def build_update_fn(
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(shmapped, donate_argnums=(0, 1))
+    # decoupled mode keeps the old params alive for the player thread, so
+    # donation must be off there (donated buffers are invalidated mid-use)
+    return jax.jit(shmapped, donate_argnums=(0, 1) if donate else ())
 
 
 @register_algorithm()

@@ -1,0 +1,410 @@
+"""PPO, decoupled — player/trainer split.
+
+Behavioral contract from the reference ``sheeprl/algos/ppo/ppo_decoupled.py``
+(main :597-644, player :33-346, trainer :349-594): one process dedicated to
+environment interaction and the rest to optimization, exchanging rollout
+chunks and updated parameters once per update, with the player always acting
+with the last broadcast parameters.
+
+TPU-native design: the reference's three Gloo/NCCL process groups
+(cfg broadcast, ``scatter_object_list`` rollout chunks, flat-param broadcast,
+``Join`` for uneven chunks — :619-640) collapse into a **player thread on
+the CPU host** feeding the SPMD trainer mesh through a depth-1 queue:
+
+- the player thread steps the envs and runs the jitted policy on the current
+  parameter snapshot while the main thread runs the update program on the
+  *previous* rollout (double buffering — env interaction and TPU compute
+  overlap instead of alternating);
+- parameter "broadcast" is swapping one replicated pytree reference; rollout
+  "scatter" is one sharded ``device_put`` (even chunking by construction, so
+  no Join semantics are needed);
+- the stored behavior-policy log-probs make the one-rollout parameter
+  staleness exact for the clipped objective.
+
+Requires ≥2 devices like the reference (registry ``decoupled=True``; the
+CLI enforces it, cli.py check_configs).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import build_agent, sample_actions
+from sheeprl_tpu.algos.ppo.ppo import build_update_fn, make_vector_env
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
+from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.optim import set_lr
+from sheeprl_tpu.utils.utils import gae, polynomial_decay, save_configs
+
+
+@register_algorithm(decoupled=True)
+def main(fabric, cfg: Dict[str, Any]):
+    initial_ent_coef = float(cfg.algo.ent_coef)
+    initial_clip_coef = float(cfg.algo.clip_coef)
+
+    world_size = fabric.world_size
+    root_key = fabric.seed_everything(cfg.seed)
+
+    state = None
+    logger, log_dir = create_tensorboard_logger(cfg)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    n_envs = int(cfg.env.num_envs) * world_size
+    envs = make_vector_env(cfg, fabric, log_dir, n_envs)
+    observation_space = envs.single_observation_space
+
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.cnn_keys.encoder) + len(cfg.mlp_keys.encoder) == 0:
+        raise RuntimeError(
+            "You should specify at least one CNN keys or MLP keys from the cli: "
+            "`cnn_keys.encoder=[rgb]` or `mlp_keys.encoder=[state]`"
+        )
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+    obs_keys = mlp_keys + cnn_keys
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (
+            envs.single_action_space.nvec.tolist()
+            if is_multidiscrete
+            else [envs.single_action_space.n]
+        )
+    )
+
+    agent = build_agent(cfg, actions_dim, is_continuous, cnn_keys, mlp_keys)
+
+    root_key, init_key = jax.random.split(root_key)
+    dummy_obs = {}
+    for k in obs_keys:
+        shape = observation_space[k].shape
+        if k in cnn_keys:
+            dummy_obs[k] = jnp.zeros((1, int(np.prod(shape[:-2])), *shape[-2:]), jnp.float32)
+        else:
+            dummy_obs[k] = jnp.zeros((1, int(np.prod(shape))), jnp.float32)
+    params = agent.init(init_key, dummy_obs)["params"]
+
+    tx = instantiate(cfg.algo.optimizer, max_grad_norm=cfg.algo.max_grad_norm or None)
+    opt_state = tx.init(params)
+
+    if cfg.checkpoint.resume_from:
+        template = {
+            "params": params,
+            "opt_state": opt_state,
+            "update": 0,
+            "batch_size": 0,
+            "last_log": 0,
+            "last_checkpoint": 0,
+        }
+        state = fabric.load(cfg.checkpoint.resume_from, template)
+        params = state["params"]
+        opt_state = state["opt_state"]
+        cfg.per_rank_batch_size = int(np.asarray(state["batch_size"])) // world_size
+    params = jax.device_put(params, fabric.replicated)
+    opt_state = jax.device_put(opt_state, fabric.replicated)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+
+    rollout_steps = int(cfg.algo.rollout_steps)
+
+    @jax.jit
+    def policy_step_fn(params, obs, key):
+        norm = normalize_obs(obs, cnn_keys, obs_keys)
+        pre_dist, values = agent.apply({"params": params}, norm)
+        actions, real_actions, logprob = sample_actions(pre_dist, is_continuous, key)
+        return actions, real_actions, logprob, values
+
+    @jax.jit
+    def value_fn(params, obs):
+        norm = normalize_obs(obs, cnn_keys, obs_keys)
+        return agent.apply({"params": params}, norm, method=agent.get_value)
+
+    gamma, gae_lambda = float(cfg.algo.gamma), float(cfg.algo.gae_lambda)
+
+    @jax.jit
+    def gae_fn(rewards, values, dones, next_values):
+        return gae(rewards, values, dones, next_values, gamma, gae_lambda)
+
+    n_local = rollout_steps * int(cfg.env.num_envs)
+    update_fn = build_update_fn(agent, tx, cfg, fabric, n_local, donate=False)
+    data_sharding = fabric.replicated if cfg.buffer.share_data else fabric.data_sharding
+
+    start_step = int(np.asarray(state["update"])) // world_size if state is not None else 1
+    policy_steps_per_update = int(n_envs * rollout_steps)
+    num_updates = int(cfg.total_steps) // policy_steps_per_update if not cfg.dry_run else 1
+    last_log = int(np.asarray(state["last_log"])) if state is not None else 0
+    last_checkpoint = int(np.asarray(state["last_checkpoint"])) if state is not None else 0
+    policy_step = (start_step - 1) * policy_steps_per_update
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_update != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_update value ({policy_steps_per_update})."
+        )
+    if cfg.checkpoint.every % policy_steps_per_update != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_update value ({policy_steps_per_update})."
+        )
+
+    # ------------------------------------------------------------------
+    # the player thread (reference player(), :33-346)
+    # ------------------------------------------------------------------
+
+    # depth-1 queue = the double buffer: the player fills rollout k+1 while
+    # the trainer consumes rollout k
+    rollout_q: "queue.Queue[Any]" = queue.Queue(maxsize=1)
+    # the "param broadcast": the trainer swaps in the new replicated pytree,
+    # the player reads whichever snapshot is current (jax arrays are
+    # immutable, so a torn read is impossible)
+    param_cell = {"params": params}
+    stop = threading.Event()
+    player_error: Dict[str, BaseException] = {}
+
+    def player(player_key):
+        try:
+            obs = envs.reset(seed=cfg.seed)[0]
+            next_obs = prepare_obs(obs, cnn_keys, n_envs)
+            for update in range(start_step, num_updates + 1):
+                rollout = {k: [] for k in obs_keys}
+                extras = {"dones": [], "values": [], "actions": [], "logprobs": [], "rewards": []}
+                ep_stats = []
+                snapshot = param_cell["params"]
+                with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+                    for _ in range(rollout_steps):
+                        nonlocal_key = jax.random.fold_in(player_key, len(extras["dones"]) + update * rollout_steps)
+                        actions_j, real_actions_j, logprob_j, values_j = policy_step_fn(
+                            snapshot, next_obs, nonlocal_key
+                        )
+                        real_actions = np.asarray(real_actions_j)
+                        obs, rewards, terminated, truncated, info = envs.step(
+                            real_actions.reshape(envs.action_space.shape)
+                        )
+
+                        truncated_envs = np.nonzero(truncated)[0]
+                        if len(truncated_envs) > 0:
+                            final_obs = info["final_obs"]
+                            t_obs = {
+                                k: np.stack([np.asarray(final_obs[te][k]) for te in truncated_envs])
+                                for k in obs_keys
+                            }
+                            t_obs = prepare_obs(t_obs, cnn_keys, len(truncated_envs))
+                            vals = np.asarray(value_fn(snapshot, t_obs)).reshape(-1)
+                            rewards = np.asarray(rewards, dtype=np.float32)
+                            rewards[truncated_envs] += vals
+
+                        dones = np.logical_or(terminated, truncated).astype(np.float32)
+                        for k in obs_keys:
+                            rollout[k].append(np.asarray(next_obs[k]))
+                        extras["dones"].append(dones.reshape(n_envs, 1))
+                        extras["values"].append(np.asarray(values_j).reshape(n_envs, 1))
+                        extras["actions"].append(np.asarray(actions_j).reshape(n_envs, -1))
+                        extras["logprobs"].append(np.asarray(logprob_j).reshape(n_envs, 1))
+                        extras["rewards"].append(
+                            np.asarray(rewards, np.float32).reshape(n_envs, 1)
+                        )
+                        next_obs = prepare_obs(obs, cnn_keys, n_envs)
+
+                        if cfg.metric.log_level > 0 and "final_info" in info:
+                            fi = info["final_info"]
+                            if isinstance(fi, dict) and "episode" in fi:
+                                mask = np.asarray(fi.get("_episode", []), dtype=bool)
+                                for i in np.nonzero(mask)[0]:
+                                    ep_stats.append(
+                                        (float(fi["episode"]["r"][i]), float(fi["episode"]["l"][i]))
+                                    )
+
+                    next_values = np.asarray(value_fn(snapshot, next_obs))
+
+                payload = {
+                    "data": {
+                        **{k: np.stack(rollout[k]) for k in obs_keys},
+                        **{k: np.stack(v) for k, v in extras.items()},
+                    },
+                    "next_values": next_values,
+                    "ep_stats": ep_stats,
+                }
+                rollout_q.put(payload)
+                if stop.is_set():
+                    return
+        except BaseException as e:  # surface crashes in the trainer loop
+            player_error["error"] = e
+            rollout_q.put(None)
+
+    root_key, player_key = jax.random.split(root_key)
+    player_thread = threading.Thread(target=player, args=(player_key,), daemon=True, name="ppo-player")
+    player_thread.start()
+
+    # ------------------------------------------------------------------
+    # the trainer loop (reference trainer(), :349-594)
+    # ------------------------------------------------------------------
+
+    last_train = 0
+    train_step = 0
+
+    try:
+        for update in range(start_step, num_updates + 1):
+            if cfg.algo.anneal_lr:
+                lr = polynomial_decay(
+                    update - 1,
+                    initial=cfg.algo.optimizer.lr,
+                    final=0.0,
+                    max_decay_steps=num_updates,
+                    power=1.0,
+                )
+                opt_state = set_lr(opt_state, lr)
+            else:
+                lr = cfg.algo.optimizer.lr
+
+            payload = rollout_q.get()
+            if payload is None:
+                raise RuntimeError("PPO player thread crashed") from player_error.get("error")
+            policy_step += policy_steps_per_update
+
+            returns, advantages = gae_fn(
+                payload["data"]["rewards"],
+                payload["data"]["values"],
+                payload["data"]["dones"],
+                payload["next_values"],
+            )
+
+            def flat(x):
+                x = jnp.asarray(x)
+                return jnp.swapaxes(x, 0, 1).reshape((n_envs * x.shape[0],) + x.shape[2:])
+
+            local_data = {
+                **{k: flat(payload["data"][k]) for k in obs_keys},
+                "actions": flat(payload["data"]["actions"]),
+                "logprobs": flat(payload["data"]["logprobs"]),
+                "values": flat(payload["data"]["values"]),
+                "returns": flat(returns),
+                "advantages": flat(advantages),
+            }
+            local_data = jax.device_put(local_data, data_sharding)
+
+            with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+                root_key, update_key = jax.random.split(root_key)
+                params, opt_state, losses = update_fn(
+                    params,
+                    opt_state,
+                    local_data,
+                    update_key,
+                    jnp.float32(cfg.algo.clip_coef),
+                    jnp.float32(cfg.algo.ent_coef),
+                )
+                losses = np.asarray(losses)
+            train_step += world_size
+
+            # the new parameters become visible to the player (the reference's
+            # rank-1 → rank-0 flat-parameter broadcast, :525-529)
+            param_cell["params"] = params
+
+            if cfg.metric.log_level > 0 and logger is not None:
+                logger.log_metrics({"Info/learning_rate": lr}, policy_step)
+                logger.log_metrics({"Info/clip_coef": cfg.algo.clip_coef}, policy_step)
+                logger.log_metrics({"Info/ent_coef": cfg.algo.ent_coef}, policy_step)
+
+            if aggregator and not aggregator.disabled:
+                aggregator.update("Loss/policy_loss", losses[0])
+                aggregator.update("Loss/value_loss", losses[1])
+                aggregator.update("Loss/entropy_loss", losses[2])
+                for ep_rew, ep_len in payload["ep_stats"]:
+                    if "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+
+            if cfg.metric.log_level > 0 and (
+                policy_step - last_log >= cfg.metric.log_every or update == num_updates
+            ):
+                if aggregator and not aggregator.disabled:
+                    metrics_dict = aggregator.compute()
+                    if logger is not None:
+                        logger.log_metrics(metrics_dict, policy_step)
+                    aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if logger is not None:
+                        if timer_metrics.get("Time/train_time"):
+                            logger.log_metrics(
+                                {
+                                    "Time/sps_train": (train_step - last_train)
+                                    / max(timer_metrics["Time/train_time"], 1e-9)
+                                },
+                                policy_step,
+                            )
+                        if timer_metrics.get("Time/env_interaction_time"):
+                            logger.log_metrics(
+                                {
+                                    "Time/sps_env_interaction": (
+                                        (policy_step - last_log)
+                                        / world_size
+                                        * cfg.env.action_repeat
+                                    )
+                                    / max(timer_metrics["Time/env_interaction_time"], 1e-9)
+                                },
+                                policy_step,
+                            )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+            if cfg.algo.anneal_clip_coef:
+                cfg.algo.clip_coef = polynomial_decay(
+                    update, initial=initial_clip_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+                )
+            if cfg.algo.anneal_ent_coef:
+                cfg.algo.ent_coef = polynomial_decay(
+                    update, initial=initial_ent_coef, final=0.0, max_decay_steps=num_updates, power=1.0
+                )
+
+            if (
+                cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
+            ) or (update == num_updates and cfg.checkpoint.save_last):
+                last_checkpoint = policy_step
+                ckpt_state = {
+                    "params": jax.device_get(params),
+                    "opt_state": jax.device_get(opt_state),
+                    "update": update * world_size,
+                    "batch_size": cfg.per_rank_batch_size * world_size,
+                    "last_log": last_log,
+                    "last_checkpoint": last_checkpoint,
+                }
+                ckpt_path = os.path.join(
+                    log_dir, "checkpoint", f"ckpt_{policy_step}_{fabric.global_rank}"
+                )
+                fabric.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state)
+    finally:
+        stop.set()
+        try:  # unblock a player waiting on the full queue
+            rollout_q.get_nowait()
+        except queue.Empty:
+            pass
+        player_thread.join(timeout=30)
+
+    envs.close()
+    if fabric.is_global_zero:
+        test(agent, jax.device_get(params), fabric, cfg, log_dir)

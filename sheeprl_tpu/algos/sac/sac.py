@@ -67,6 +67,7 @@ def build_train_fn(
     action_scale: np.ndarray,
     action_bias: np.ndarray,
     target_entropy: float,
+    donate: bool = True,
 ):
     """Compile G gradient steps (critic → EMA → actor → alpha) as one SPMD
     program. ``batch`` leaves are ``[G, B_local, ...]``; ``do_ema`` is a
@@ -160,7 +161,9 @@ def build_train_fn(
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(shmapped, donate_argnums=(0, 1))
+    # decoupled mode keeps the old actor params alive for the player
+    # thread, so donation must be off there
+    return jax.jit(shmapped, donate_argnums=(0, 1) if donate else ())
 
 
 @register_algorithm()

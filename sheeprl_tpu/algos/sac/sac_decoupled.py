@@ -1,0 +1,370 @@
+"""SAC, decoupled — player/trainer split.
+
+Behavioral contract from the reference ``sheeprl/algos/sac/sac_decoupled.py``
+(main :32-60, player :63-270, trainer :273-548): a dedicated environment
+process keeps the replay buffer and ships one sampled batch per policy step
+to the trainers, which return updated parameters.
+
+TPU-native design (see ``ppo/ppo_decoupled.py`` for the pattern): the player
+is a CPU-host thread stepping the envs and appending to the host-side numpy
+replay buffer under a lock; the trainer loop paces itself to the reference's
+one-train-round-per-policy-step cadence through a step-counter condition
+variable, samples directly from the shared buffer, runs the fused SPMD SAC
+step, and swaps the replicated parameter pytree the player acts with.
+Requires ≥2 devices like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import (
+    SACActor,
+    SACCritic,
+    action_bounds,
+    build_agent_state,
+    squash_sample,
+)
+from sheeprl_tpu.algos.sac.sac import build_train_fn
+from sheeprl_tpu.algos.sac.utils import concat_obs, test
+from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import save_configs
+
+
+@register_algorithm(decoupled=True)
+def main(fabric, cfg: Dict[str, Any]):
+    if "minedojo" in (cfg.env.wrapper._target_ or "").lower():
+        raise ValueError("MineDojo is not currently supported by SAC agent")
+
+    world_size = fabric.world_size
+    root_key = fabric.seed_everything(cfg.seed)
+
+    if len(cfg.cnn_keys.encoder) > 0:
+        warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
+        cfg.cnn_keys.encoder = []
+
+    state = None
+    logger, log_dir = create_tensorboard_logger(cfg)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    n_envs = int(cfg.env.num_envs) * world_size
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+
+    vectorized_env = SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(cfg, cfg.seed + i, 0, log_dir if fabric.is_global_zero else None, "train", vector_env_idx=i)
+            for i in range(n_envs)
+        ],
+        autoreset_mode=AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    for k in cfg.mlp_keys.encoder:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                "Only environments with vector-only observations are supported by the SAC agent. "
+                f"Provided environment: {cfg.env.id}"
+            )
+
+    act_dim = int(np.prod(action_space.shape))
+    obs_dim = int(sum(np.prod(observation_space[k].shape) for k in cfg.mlp_keys.encoder))
+    action_scale, action_bias = action_bounds(action_space)
+
+    actor = SACActor(action_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size)
+    critic = SACCritic(hidden_size=cfg.algo.critic.hidden_size, num_critics=1)
+    target_entropy = -float(act_dim)
+
+    root_key, init_key = jax.random.split(root_key)
+    agent_state = build_agent_state(
+        actor, critic, init_key, int(cfg.algo.critic.n), obs_dim, act_dim, cfg.algo.alpha.alpha
+    )
+
+    qf_tx = instantiate(cfg.algo.critic.optimizer)
+    actor_tx = instantiate(cfg.algo.actor.optimizer)
+    alpha_tx = instantiate(cfg.algo.alpha.optimizer)
+    opt_states = {
+        "actor": actor_tx.init(agent_state["actor"]),
+        "qf": qf_tx.init(agent_state["critics"]),
+        "alpha": alpha_tx.init(agent_state["log_alpha"]),
+    }
+
+    if cfg.checkpoint.resume_from:
+        template = {
+            "agent": agent_state,
+            "opt_states": opt_states,
+            "update": 0,
+            "batch_size": 0,
+            "last_log": 0,
+            "last_checkpoint": 0,
+        }
+        state = fabric.load(cfg.checkpoint.resume_from, template)
+        agent_state = state["agent"]
+        opt_states = state["opt_states"]
+        cfg.per_rank_batch_size = int(np.asarray(state["batch_size"])) // world_size
+    agent_state = jax.device_put(agent_state, fabric.replicated)
+    opt_states = jax.device_put(opt_states, fabric.replicated)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        max(buffer_size, 1),
+        n_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
+        obs_keys=("observations",),
+    )
+    if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
+        rb.load_state_dict(state["rb"])
+
+    scale_j, bias_j = jnp.asarray(action_scale), jnp.asarray(action_bias)
+
+    @jax.jit
+    def policy_fn(actor_params, obs, key):
+        mean, std = actor.apply({"params": actor_params}, obs)
+        actions, _ = squash_sample(mean, std, key, scale_j, bias_j)
+        return actions
+
+    train_fn = build_train_fn(
+        actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric,
+        action_scale, action_bias, target_entropy, donate=False,
+    )
+    batch_sharding = fabric.sharding(None, fabric.data_axis)
+
+    last_train = 0
+    train_step = 0
+    start_step = int(np.asarray(state["update"])) // world_size if state is not None else 1
+    policy_step = int(np.asarray(state["update"])) * cfg.env.num_envs if state is not None else 0
+    last_log = int(np.asarray(state["last_log"])) if state is not None else 0
+    last_checkpoint = int(np.asarray(state["last_checkpoint"])) if state is not None else 0
+    policy_steps_per_update = int(n_envs)
+    num_updates = int(cfg.total_steps // policy_steps_per_update) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_update if not cfg.dry_run else 0
+    if cfg.checkpoint.resume_from and not cfg.buffer.get("checkpoint", False):
+        learning_starts += start_step
+
+    per_rank_gradient_steps = int(cfg.algo.per_rank_gradient_steps)
+    ema_every = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_update + 1
+
+    # ------------------------------------------------------------------
+    # the player thread (reference player(), :63-270): steps the envs with
+    # the latest broadcast params and appends to the shared host buffer
+    # ------------------------------------------------------------------
+
+    rb_lock = threading.Lock()
+    step_cv = threading.Condition()
+    # collected/trained counters bound the player's lead to one step (the
+    # reference player blocks on the per-step param exchange, :291-294)
+    progress = {"collected": start_step - 1, "trained": start_step - 1}
+    param_cell = {"actor": agent_state["actor"]}
+    player_error: Dict[str, BaseException] = {}
+    stop = threading.Event()
+
+    def player(player_key):
+        try:
+            o = envs.reset(seed=cfg.seed)[0]
+            obs = concat_obs(o, cfg.mlp_keys.encoder, n_envs)
+            for update in range(start_step, num_updates + 1):
+                # collect step `update` while the trainer works on `update-1`
+                # (one-step lead = the PPO sibling's depth-1 queue)
+                with step_cv:
+                    step_cv.wait_for(
+                        lambda: progress["trained"] >= update - 2 or stop.is_set()
+                    )
+                if stop.is_set():
+                    return
+                with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+                    if update <= learning_starts:
+                        actions = envs.action_space.sample()
+                    else:
+                        step_key = jax.random.fold_in(player_key, update)
+                        actions = np.asarray(policy_fn(param_cell["actor"], obs, step_key))
+                    next_o, rewards, terminated, truncated, infos = envs.step(
+                        actions.reshape(envs.action_space.shape)
+                    )
+                    dones = np.logical_or(terminated, truncated)
+
+                ep_stats = []
+                if cfg.metric.log_level > 0 and "final_info" in infos:
+                    fi = infos["final_info"]
+                    if isinstance(fi, dict) and "episode" in fi:
+                        mask = np.asarray(fi.get("_episode", []), dtype=bool)
+                        for i in np.nonzero(mask)[0]:
+                            ep_stats.append(
+                                (float(fi["episode"]["r"][i]), float(fi["episode"]["l"][i]))
+                            )
+
+                next_obs = concat_obs(next_o, cfg.mlp_keys.encoder, n_envs)
+                real_next_obs = next_obs.copy()
+                if "final_obs" in infos:
+                    for idx, final_obs in enumerate(infos["final_obs"]):
+                        if final_obs is not None:
+                            real_next_obs[idx] = concat_obs(final_obs, cfg.mlp_keys.encoder, 1)[0]
+
+                step_data = {
+                    "observations": obs[None],
+                    "actions": np.asarray(actions, np.float32).reshape(1, n_envs, -1),
+                    "rewards": np.asarray(rewards, np.float32).reshape(1, n_envs, 1),
+                    "dones": np.asarray(dones, np.float32).reshape(1, n_envs, 1),
+                }
+                if not cfg.buffer.sample_next_obs:
+                    step_data["next_observations"] = real_next_obs[None]
+                with rb_lock:
+                    rb.add(step_data)
+                obs = next_obs
+
+                with step_cv:
+                    progress["collected"] = update
+                    progress.setdefault("ep_stats", []).extend(ep_stats)
+                    step_cv.notify_all()
+        except BaseException as e:
+            player_error["error"] = e
+            with step_cv:
+                progress["collected"] = num_updates
+                step_cv.notify_all()
+
+    root_key, player_key = jax.random.split(root_key)
+    player_thread = threading.Thread(target=player, args=(player_key,), daemon=True, name="sac-player")
+    player_thread.start()
+
+    # ------------------------------------------------------------------
+    # the trainer loop (reference trainer(), :273-548): one train round per
+    # policy step once learning starts
+    # ------------------------------------------------------------------
+
+    try:
+        for update in range(start_step, num_updates + 1):
+            with step_cv:
+                step_cv.wait_for(lambda: progress["collected"] >= update)
+                ep_stats = progress.pop("ep_stats", [])
+            if "error" in player_error:
+                raise RuntimeError("SAC player thread crashed") from player_error["error"]
+            policy_step += n_envs
+
+            if aggregator and not aggregator.disabled:
+                for ep_rew, ep_len in ep_stats:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                    aggregator.update("Game/ep_len_avg", ep_len)
+                    fabric.print(f"Rank-0: policy_step={policy_step}, reward={ep_rew}")
+
+            if update >= learning_starts:
+                training_steps = learning_starts if update == learning_starts else 1
+                g_total = max(training_steps, 1) * per_rank_gradient_steps
+                with rb_lock:
+                    sample = rb.sample(
+                        g_total * cfg.per_rank_batch_size * world_size,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
+                    )
+                batch = {
+                    k: np.reshape(v, (g_total, world_size * cfg.per_rank_batch_size) + v.shape[2:])
+                    for k, v in sample.items()
+                }
+                batch = jax.device_put(batch, batch_sharding)
+
+                with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+                    root_key, train_key = jax.random.split(root_key)
+                    do_ema = jnp.bool_(update % ema_every == 0)
+                    agent_state, opt_states, losses = train_fn(
+                        agent_state, opt_states, batch, train_key, do_ema
+                    )
+                    losses = np.asarray(losses)
+                train_step += world_size
+                # parameter broadcast to the player (reference :525-529)
+                param_cell["actor"] = agent_state["actor"]
+
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Loss/value_loss", losses[0])
+                    aggregator.update("Loss/policy_loss", losses[1])
+                    aggregator.update("Loss/alpha_loss", losses[2])
+
+            if cfg.metric.log_level > 0 and (
+                policy_step - last_log >= cfg.metric.log_every or update == num_updates
+            ):
+                if aggregator and not aggregator.disabled:
+                    metrics_dict = aggregator.compute()
+                    if logger is not None:
+                        logger.log_metrics(metrics_dict, policy_step)
+                    aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if logger is not None:
+                        if timer_metrics.get("Time/train_time"):
+                            logger.log_metrics(
+                                {"Time/sps_train": (train_step - last_train) / max(timer_metrics["Time/train_time"], 1e-9)},
+                                policy_step,
+                            )
+                        if timer_metrics.get("Time/env_interaction_time"):
+                            logger.log_metrics(
+                                {
+                                    "Time/sps_env_interaction": (
+                                        (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                    )
+                                    / max(timer_metrics["Time/env_interaction_time"], 1e-9)
+                                },
+                                policy_step,
+                            )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+                update == num_updates and cfg.checkpoint.save_last
+            ):
+                last_checkpoint = policy_step
+                ckpt_state = {
+                    "agent": jax.device_get(agent_state),
+                    "opt_states": jax.device_get(opt_states),
+                    "update": update * world_size,
+                    "batch_size": cfg.per_rank_batch_size * world_size,
+                    "last_log": last_log,
+                    "last_checkpoint": last_checkpoint,
+                }
+                ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{fabric.global_rank}")
+                with rb_lock:  # the player must not write mid-snapshot
+                    fabric.call(
+                        "on_checkpoint_player",
+                        ckpt_path=ckpt_path,
+                        state=ckpt_state,
+                        replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
+                    )
+
+            # release the player for the next step (bounded one-step lead)
+            with step_cv:
+                progress["trained"] = update
+                step_cv.notify_all()
+    finally:
+        stop.set()
+        with step_cv:
+            step_cv.notify_all()
+        player_thread.join(timeout=30)
+        envs.close()
+
+    if fabric.is_global_zero:
+        test(actor, agent_state["actor"], scale_j, bias_j, fabric, cfg, log_dir)

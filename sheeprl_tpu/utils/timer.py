@@ -6,6 +6,11 @@ disable-able, with ``compute()`` returning accumulated seconds and resetting.
 Train loops wrap the env-interaction and train phases; the CLI derives
 ``Time/sps_*`` rates from the ratios.
 
+Thread safety: the decoupled algorithms time the player thread's env
+interaction while the trainer thread calls ``compute()``/``reset()``, so the
+registry is guarded by a lock and a scope that loses its entry to a
+concurrent reset re-registers on exit instead of raising.
+
 One TPU-specific caveat: jax dispatch is async, so a timed block that only
 *launches* device work would under-report. Callers time around points where
 they already synchronize (e.g. after pulling losses to host); ``timer`` itself
@@ -14,6 +19,7 @@ stays a pure wall-clock measure, matching the reference semantics.
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import ContextDecorator
 from typing import Dict, Optional
@@ -26,11 +32,17 @@ class timer(ContextDecorator):
 
     disabled: bool = False
     timers: Dict[str, SumMetric] = {}
+    _lock = threading.Lock()
 
     def __init__(self, name: str, metric: Optional[SumMetric] = None):
         self.name = name
-        if not timer.disabled and name not in timer.timers:
-            timer.timers[name] = metric if metric is not None else SumMetric(sync_on_compute=False)
+        self._metric = metric
+        if not timer.disabled:
+            with timer._lock:
+                if name not in timer.timers:
+                    timer.timers[name] = (
+                        metric if metric is not None else SumMetric(sync_on_compute=False)
+                    )
 
     def __enter__(self):
         if not timer.disabled:
@@ -39,7 +51,18 @@ class timer(ContextDecorator):
 
     def __exit__(self, *exc):
         if not timer.disabled:
-            timer.timers[self.name].update(time.perf_counter() - self._start)
+            elapsed = time.perf_counter() - self._start
+            with timer._lock:
+                if self.name not in timer.timers:  # registry was reset mid-scope
+                    # a FRESH metric: re-registering the (possibly already
+                    # computed) original would double count its total
+                    sync = (
+                        getattr(self._metric, "sync_on_compute", False)
+                        if self._metric is not None
+                        else False
+                    )
+                    timer.timers[self.name] = SumMetric(sync_on_compute=sync)
+                timer.timers[self.name].update(elapsed)
         return False
 
     @classmethod
@@ -49,10 +72,12 @@ class timer(ContextDecorator):
     @classmethod
     def compute(cls) -> Dict[str, float]:
         """Accumulated seconds per name; resets the registry (reference :60-76)."""
-        out = {name: metric.compute() for name, metric in cls.timers.items()}
-        cls.reset()
+        with cls._lock:
+            out = {name: metric.compute() for name, metric in cls.timers.items()}
+            cls.timers = {}
         return out
 
     @classmethod
     def reset(cls) -> None:
-        cls.timers = {}
+        with cls._lock:
+            cls.timers = {}

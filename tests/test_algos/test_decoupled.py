@@ -1,0 +1,83 @@
+"""Decoupled PPO/SAC tests: dry runs on the 2-device mesh and the
+single-device rejection (reference ``tests/test_algos/test_algos.py``
+decoupled cases assert RuntimeError at devices==1, :139-143)."""
+
+import pytest
+
+from sheeprl_tpu import cli
+
+
+def base_args(tmp_path):
+    return [
+        "dry_run=True",
+        "env.sync_env=True",
+        "checkpoint.every=1000000",
+        "metric.log_every=1000000",
+        "metric.log_level=0",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+        f"root_dir={tmp_path}/logs",
+        "run_name=test",
+        "fabric.accelerator=cpu",
+    ]
+
+
+def test_ppo_decoupled(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        base_args(tmp_path)
+        + [
+            "exp=ppo_decoupled",
+            "fabric.devices=2",
+            "env.id=CartPole-v1",
+            "algo.rollout_steps=4",
+            "per_rank_batch_size=4",
+            "algo.update_epochs=2",
+        ]
+    )
+
+
+def test_ppo_decoupled_rejects_single_device(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(RuntimeError):
+        cli.run(
+            base_args(tmp_path)
+            + [
+                "exp=ppo_decoupled",
+                "fabric.devices=1",
+                "env.id=CartPole-v1",
+                "algo.rollout_steps=4",
+                "per_rank_batch_size=4",
+            ]
+        )
+
+
+def test_sac_decoupled(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        base_args(tmp_path)
+        + [
+            "exp=sac_decoupled",
+            "fabric.devices=2",
+            "env.id=Pendulum-v1",
+            "per_rank_batch_size=4",
+            "algo.learning_starts=0",
+            "algo.hidden_size=8",
+            "buffer.size=64",
+        ]
+    )
+
+
+def test_sac_decoupled_rejects_single_device(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(RuntimeError):
+        cli.run(
+            base_args(tmp_path)
+            + [
+                "exp=sac_decoupled",
+                "fabric.devices=1",
+                "env.id=Pendulum-v1",
+                "per_rank_batch_size=4",
+            ]
+        )
