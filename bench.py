@@ -34,6 +34,7 @@ def main() -> None:
         "checkpoint.save_last=False",
         "metric.log_level=0",
         "buffer.memmap=False",
+        "algo.run_test=False",
         "exp_name=bench_ppo",
     ]
     start = time.perf_counter()

@@ -1,0 +1,185 @@
+"""Template for a generalized distributed actor-learner on this framework.
+
+The reference's 3-tier template (``examples/architecture_template.py``: one
+buffer process + M players + N trainers wired with TorchCollective process
+groups) maps to the TPU-native composition used by the decoupled algorithms:
+
+- **M player threads** on the CPU host, each stepping its own envs with a
+  jitted host-side policy against the latest parameter snapshot
+  (``sheeprl_tpu/utils/host.py`` mirrors);
+- **per-player host buffers** (lock-guarded numpy ReplayBuffers) instead of
+  a buffer process — each player appends to its own, the trainer samples
+  across all of them;
+- **the trainer** is the main thread driving the whole device mesh with one
+  ``shard_map``-ped jitted update (data-parallel `pmean` grads takes the
+  place of N trainer ranks), publishing fresh snapshots by swapping one
+  pytree reference.
+
+Run it on the virtual CPU mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 JAX_PLATFORMS=cpu \
+        python examples/architecture_template.py
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.fabric import Fabric
+from sheeprl_tpu.utils.host import HostParamMirror
+
+NUM_PLAYERS = 2
+ENVS_PER_PLAYER = 2
+TOTAL_STEPS = 256
+BATCH_SIZE = 32
+OBS_DIM, ACT_DIM, HIDDEN = 4, 2, 32
+
+
+def init_net(key):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (OBS_DIM, HIDDEN)) * 0.1,
+        "w2": jax.random.normal(k2, (HIDDEN, ACT_DIM)) * 0.1,
+    }
+
+
+def q_values(params, obs):
+    return jnp.tanh(obs @ params["w1"]) @ params["w2"]
+
+
+def player(pid, mirror_cell, rb, rb_lock, stop, counters, cv):
+    """One actor: ε-greedy rollouts with the latest host snapshot."""
+    envs = gym.vector.SyncVectorEnv(
+        [partial(gym.make, "CartPole-v1") for _ in range(ENVS_PER_PLAYER)]
+    )
+    act = jax.jit(lambda p, o: jnp.argmax(q_values(p, o), -1))
+    rng = np.random.default_rng(pid)
+    obs = envs.reset(seed=pid)[0].astype(np.float32)
+    while not stop.is_set():
+        snapshot = mirror_cell["params"]
+        if rng.random() < 0.2:
+            actions = envs.action_space.sample()
+        else:
+            actions = np.asarray(act(snapshot, obs))
+        next_obs, rewards, term, trunc, _ = envs.step(actions)
+        next_obs = next_obs.astype(np.float32)
+        with rb_lock:
+            rb.add(
+                {
+                    "observations": obs[None],
+                    "next_observations": next_obs[None],
+                    "actions": np.asarray(actions, np.float32).reshape(1, ENVS_PER_PLAYER, 1),
+                    "rewards": np.asarray(rewards, np.float32).reshape(1, ENVS_PER_PLAYER, 1),
+                    "dones": np.logical_or(term, trunc).astype(np.float32).reshape(1, ENVS_PER_PLAYER, 1),
+                }
+            )
+        obs = next_obs
+        with cv:
+            counters["collected"] += ENVS_PER_PLAYER
+            cv.notify_all()
+    envs.close()
+
+
+def main():
+    fabric = Fabric(devices="auto", accelerator="auto")
+    print(f"mesh: {fabric.world_size} device(s), players: {NUM_PLAYERS} host thread(s)")
+
+    key = jax.random.PRNGKey(0)
+    params = jax.device_put(init_net(key), fabric.replicated)
+    tx = optax.adam(1e-3)
+    opt_state = jax.device_put(tx.init(params), fabric.replicated)
+
+    # parameter "broadcast": a host-mirrored snapshot swapped atomically
+    mirror = HostParamMirror(params, enabled=fabric.on_accelerator)
+    mirror_cell = {"params": mirror(params)}
+
+    # the buffer tier: one host-side numpy ring buffer per player
+    buffers = [
+        ReplayBuffer(4096, ENVS_PER_PLAYER, obs_keys=("observations",))
+        for _ in range(NUM_PLAYERS)
+    ]
+    rb_locks = [threading.Lock() for _ in range(NUM_PLAYERS)]
+    stop = threading.Event()
+    counters = {"collected": 0}
+    cv = threading.Condition()
+
+    # the trainer tier: one fused DQN-style update over the mesh
+    def local_step(params, opt_state, batch):
+        def loss_fn(p):
+            q = jnp.take_along_axis(
+                q_values(p, batch["observations"]),
+                batch["actions"].astype(jnp.int32), -1,
+            )
+            target = batch["rewards"] + 0.99 * (1 - batch["dones"]) * jnp.max(
+                q_values(p, batch["next_observations"]), -1, keepdims=True
+            )
+            return jnp.mean((q - jax.lax.stop_gradient(target)) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.lax.pmean(grads, fabric.data_axis)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, jax.lax.pmean(loss, fabric.data_axis)
+
+    train = jax.jit(
+        jax.shard_map(
+            local_step,
+            mesh=fabric.mesh,
+            in_specs=(P(), P(), P(fabric.data_axis)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
+
+    threads = [
+        threading.Thread(
+            target=player,
+            args=(i, mirror_cell, buffers[i], rb_locks[i], stop, counters, cv),
+            daemon=True,
+        )
+        for i in range(NUM_PLAYERS)
+    ]
+    for t in threads:
+        t.start()
+
+    steps = 0
+    batch_total = BATCH_SIZE * fabric.world_size
+    sharding = fabric.sharding(fabric.data_axis)
+    while steps < TOTAL_STEPS:
+        with cv:
+            # every player buffer needs a few rows before sampling is valid
+            cv.wait_for(lambda: all(rb.full or rb._pos >= 16 for rb in buffers))
+        per_player = batch_total // NUM_PLAYERS
+        parts = []
+        for rb, lock in zip(buffers, rb_locks):
+            with lock:
+                parts.append(rb.sample(per_player))
+        batch = jax.device_put(
+            {
+                k: np.concatenate([np.asarray(p[k][0], np.float32) for p in parts])
+                for k in parts[0]
+            },
+            sharding,
+        )
+        params, opt_state, loss = train(params, opt_state, batch)
+        mirror_cell["params"] = mirror(params)  # publish to every player
+        steps += 1
+        if steps % 64 == 0:
+            print(f"step {steps}: loss={float(np.asarray(loss)):.4f}")
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

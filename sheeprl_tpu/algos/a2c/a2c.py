@@ -34,6 +34,7 @@ from sheeprl_tpu.algos.ppo.agent import (
 from sheeprl_tpu.algos.ppo.ppo import make_vector_env
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -187,6 +188,11 @@ def main(fabric, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
+    to_host = HostParamMirror(
+        params,
+        enabled=HostParamMirror.enabled_for(fabric, cfg),
+    )
+
     rollout_steps = int(cfg.algo.rollout_steps)
     rb = ReplayBuffer(
         max(int(cfg.buffer.size), rollout_steps),
@@ -198,10 +204,12 @@ def main(fabric, cfg: Dict[str, Any]):
 
     @jax.jit
     def policy_step_fn(params, obs, key):
+        # key advances inside the jitted call: one host dispatch per env step
+        key, sub = jax.random.split(key)
         norm = normalize_obs(obs, cnn_keys, obs_keys)
         pre_dist, values = agent.apply({"params": params}, norm)
-        actions, real_actions, logprob = sample_actions(pre_dist, is_continuous, key)
-        return actions, real_actions, logprob, values
+        actions, real_actions, logprob = sample_actions(pre_dist, is_continuous, sub)
+        return actions, real_actions, logprob, values, key
 
     @jax.jit
     def value_fn(params, obs):
@@ -243,15 +251,17 @@ def main(fabric, cfg: Dict[str, Any]):
 
     obs = envs.reset(seed=cfg.seed)[0]
     next_obs = prepare_obs(obs, cnn_keys, n_envs)
+    play_params = to_host(params)
+    root_key, play_key = jax.random.split(root_key)
+    play_key = to_host.put_key(play_key)
 
     for update in range(start_step, num_updates + 1):
         for _ in range(rollout_steps):
             policy_step += n_envs
 
             with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
-                root_key, step_key = jax.random.split(root_key)
-                actions_j, real_actions_j, logprob_j, values_j = policy_step_fn(
-                    params, next_obs, step_key
+                actions_j, real_actions_j, logprob_j, values_j, play_key = policy_step_fn(
+                    play_params, next_obs, play_key
                 )
                 real_actions = np.asarray(real_actions_j)
                 obs, rewards, terminated, truncated, info = envs.step(
@@ -266,7 +276,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         for k in obs_keys
                     }
                     t_obs = prepare_obs(t_obs, cnn_keys, len(truncated_envs))
-                    vals = np.asarray(value_fn(params, t_obs)).reshape(-1)
+                    vals = np.asarray(value_fn(play_params, t_obs)).reshape(-1)
                     rewards = np.asarray(rewards, dtype=np.float32)
                     rewards[truncated_envs] += vals
 
@@ -299,7 +309,7 @@ def main(fabric, cfg: Dict[str, Any]):
                             f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}"
                         )
 
-        next_values = value_fn(params, next_obs)
+        next_values = value_fn(play_params, next_obs)
         returns, advantages = gae_fn(rb["rewards"], rb["values"], rb["dones"], next_values)
 
         def flat(x):
@@ -318,6 +328,7 @@ def main(fabric, cfg: Dict[str, Any]):
             root_key, update_key = jax.random.split(root_key)
             params, opt_state, losses = update_fn(params, opt_state, local_data, update_key)
             losses = np.asarray(losses)
+        play_params = to_host(params)
         train_step += world_size
 
         if aggregator and not aggregator.disabled:
@@ -380,5 +391,5 @@ def main(fabric, cfg: Dict[str, Any]):
             )
 
     envs.close()
-    if fabric.is_global_zero:
+    if fabric.is_global_zero and cfg.algo.get("run_test", True):
         test(agent, jax.device_get(params), fabric, cfg, log_dir)

@@ -44,6 +44,7 @@ from sheeprl_tpu.algos.dreamer_v1.utils import (
     test,
 )
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.distributions import Bernoulli, Independent, Normal
 from sheeprl_tpu.utils.env import make_env
@@ -413,6 +414,13 @@ def main(fabric, cfg: Dict[str, Any]):
     )
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
 
+    # the player acts on the CPU host with mirrored snapshots (utils/host.py)
+    mirror_on = HostParamMirror.enabled_for(fabric, cfg)
+    wm_mirror = HostParamMirror(agent_state["params"]["world_model"], enabled=mirror_on)
+    actor_mirror = HostParamMirror(agent_state["params"]["actor"], enabled=mirror_on)
+    play_wm = wm_mirror(agent_state["params"]["world_model"])
+    play_actor = actor_mirror(agent_state["params"]["actor"])
+
     aggregator = None
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
@@ -482,7 +490,7 @@ def main(fabric, cfg: Dict[str, Any]):
     step_data["actions"] = np.zeros((1, n_envs, int(np.sum(actions_dim))), np.float32)
     step_data["rewards"] = np.zeros((1, n_envs, 1), np.float32)
     rb.add(step_data)
-    player_state = player_fns["init_states"](agent_state["params"]["world_model"], n_envs)
+    player_state = player_fns["init_states"](play_wm, n_envs)
 
     per_rank_gradient_steps = 0
     for update in range(start_step, num_updates + 1):
@@ -505,8 +513,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 norm_obs = normalize_obs_jnp(obs, cnn_keys)
                 root_key, act_key = jax.random.split(root_key)
                 actions_j, player_state = player_fns["exploration_action"](
-                    agent_state["params"]["world_model"],
-                    agent_state["params"]["actor"],
+                    play_wm,
+                    play_actor,
                     player_state,
                     norm_obs,
                     act_key,
@@ -578,7 +586,7 @@ def main(fabric, cfg: Dict[str, Any]):
             reset_mask = np.zeros((n_envs, 1), np.float32)
             reset_mask[dones_idxes] = 1.0
             player_state = player_fns["reset_states"](
-                agent_state["params"]["world_model"], player_state, jnp.asarray(reset_mask)
+                play_wm, player_state, jnp.asarray(reset_mask)
             )
 
         updates_before_training -= 1
@@ -603,6 +611,8 @@ def main(fabric, cfg: Dict[str, Any]):
                     per_rank_gradient_steps += 1
                 if metrics is not None:
                     metrics = jax.device_get(metrics)
+                play_wm = wm_mirror(agent_state["params"]["world_model"])
+                play_actor = actor_mirror(agent_state["params"]["actor"])
                 train_step += world_size
             updates_before_training = cfg.algo.train_every // policy_steps_per_update
             if cfg.algo.actor.expl_decay:
@@ -677,7 +687,7 @@ def main(fabric, cfg: Dict[str, Any]):
             )
 
     envs.close()
-    if fabric.is_global_zero:
+    if fabric.is_global_zero and cfg.algo.get("run_test", True):
         test(
             player_fns,
             jax.device_get(agent_state["params"]),

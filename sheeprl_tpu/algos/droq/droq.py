@@ -38,6 +38,7 @@ from sheeprl_tpu.algos.sac.agent import SACActor, action_bounds, squash_sample
 from sheeprl_tpu.algos.sac.loss import entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import concat_obs, test
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
@@ -260,9 +261,17 @@ def main(fabric, cfg: Dict[str, Any]):
 
     @jax.jit
     def policy_fn(actor_params, obs, key):
+        # key advances inside the jitted call: one host dispatch per env step
+        key, sub = jax.random.split(key)
         mean, std = actor.apply({"params": actor_params}, obs)
-        actions, _ = squash_sample(mean, std, key, scale_j, bias_j)
-        return actions
+        actions, _ = squash_sample(mean, std, sub, scale_j, bias_j)
+        return actions, key
+
+    actor_mirror = HostParamMirror(
+        agent_state["actor"],
+        enabled=HostParamMirror.enabled_for(fabric, cfg),
+    )
+    play_actor = actor_mirror(agent_state["actor"])
 
     train_fn = build_train_fn(
         actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric, action_scale, action_bias, target_entropy
@@ -285,6 +294,8 @@ def main(fabric, cfg: Dict[str, Any]):
     o = envs.reset(seed=cfg.seed)[0]
     obs = concat_obs(o, cfg.mlp_keys.encoder, n_envs)
     per_rank_gradient_steps = int(cfg.algo.per_rank_gradient_steps)
+    root_key, play_key = jax.random.split(root_key)
+    play_key = actor_mirror.put_key(play_key)
 
     for update in range(start_step, num_updates + 1):
         policy_step += n_envs
@@ -293,8 +304,8 @@ def main(fabric, cfg: Dict[str, Any]):
             if update <= learning_starts:
                 actions = envs.action_space.sample()
             else:
-                root_key, act_key = jax.random.split(root_key)
-                actions = np.asarray(policy_fn(agent_state["actor"], obs, act_key))
+                actions_j, play_key = policy_fn(play_actor, obs, play_key)
+                actions = np.asarray(actions_j)
             next_o, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -352,6 +363,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     agent_state, opt_states, critic_batch, actor_batch, train_key
                 )
                 losses = np.asarray(losses)
+                play_actor = actor_mirror(agent_state["actor"])
             train_step += world_size
 
             if aggregator and not aggregator.disabled:
@@ -410,5 +422,5 @@ def main(fabric, cfg: Dict[str, Any]):
             )
 
     envs.close()
-    if fabric.is_global_zero:
+    if fabric.is_global_zero and cfg.algo.get("run_test", True):
         test(actor, agent_state["actor"], scale_j, bias_j, fabric, cfg, log_dir)

@@ -20,6 +20,7 @@ from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import build_train_fn
 from sheeprl_tpu.algos.dreamer_v2.utils import normalize_obs_jnp, prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, build_player_fns
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
@@ -152,6 +153,15 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         cfg, fabric, actions_dim, is_continuous,
     )
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
+    # host-mirrored acting snapshots (utils/host.py); the frozen
+    # exploration actor is mirrored once
+    mirror_on = HostParamMirror.enabled_for(fabric, cfg)
+    wm_mirror = HostParamMirror(agent_state["params"]["world_model"], enabled=mirror_on)
+    actor_mirror = HostParamMirror(agent_state["params"]["actor"], enabled=mirror_on)
+    play_wm = wm_mirror(agent_state["params"]["world_model"])
+    play_actor = actor_mirror(agent_state["params"]["actor"])
+    play_actor_expl = HostParamMirror(actor_expl_params, enabled=mirror_on)(actor_expl_params)
+
     player_actor_type = str(cfg.algo.player.actor_type)
 
     aggregator = None
@@ -215,12 +225,13 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     step_data["rewards"] = np.zeros((1, n_envs, 1), np.float32)
     step_data["is_first"] = np.ones((1, n_envs, 1), np.float32)
     rb.add(step_data)
-    player_state = player_fns["init_states"](agent_state["params"]["world_model"], n_envs)
+    player_state = player_fns["init_states"](play_wm, n_envs)
+
 
     def player_actor_params():
         if player_actor_type == "exploration":
-            return actor_expl_params
-        return agent_state["params"]["actor"]
+            return play_actor_expl
+        return play_actor
 
     per_rank_gradient_steps = 0
     for update in range(start_step, num_updates + 1):
@@ -233,7 +244,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             norm_obs = normalize_obs_jnp(obs, cnn_keys)
             root_key, act_key = jax.random.split(root_key)
             actions_j, player_state = player_fns["exploration_action"](
-                agent_state["params"]["world_model"],
+                play_wm,
                 player_actor_params(),
                 player_state,
                 norm_obs,
@@ -305,7 +316,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             reset_mask = np.zeros((n_envs, 1), np.float32)
             reset_mask[dones_idxes] = 1.0
             player_state = player_fns["reset_states"](
-                agent_state["params"]["world_model"], player_state, jnp.asarray(reset_mask)
+                play_wm, player_state, jnp.asarray(reset_mask)
             )
 
         updates_before_training -= 1
@@ -338,6 +349,8 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                     per_rank_gradient_steps += 1
                 if metrics is not None:
                     metrics = jax.device_get(metrics)
+                play_wm = wm_mirror(agent_state["params"]["world_model"])
+                play_actor = actor_mirror(agent_state["params"]["actor"])
                 train_step += world_size
             updates_before_training = cfg.algo.train_every // policy_steps_per_update
             if cfg.algo.actor.expl_decay:
@@ -411,7 +424,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             )
 
     envs.close()
-    if fabric.is_global_zero:
+    if fabric.is_global_zero and cfg.algo.get("run_test", True):
         final = jax.device_get(agent_state["params"])
         test(
             player_fns,

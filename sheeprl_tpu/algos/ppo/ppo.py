@@ -43,6 +43,7 @@ from jax.sharding import PartitionSpec as P
 from sheeprl_tpu.algos.ppo.agent import PPOAgent, build_agent, evaluate_actions, sample_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
+from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.env import make_env
@@ -284,12 +285,26 @@ def main(fabric, cfg: Dict[str, Any]):
     # jitted programs
     # ------------------------------------------------------------------
 
+    # The player runs on the CPU host with a mirrored parameter snapshot
+    # (one pytree transfer per update) instead of dispatching one device
+    # program per env step: env interaction is latency-bound, and over a
+    # remote-attached TPU every dispatch is a network round trip
+    # (SURVEY §5.8 — players pinned to CPU hosts feeding the trainer mesh).
+    to_host = HostParamMirror(
+        params,
+        enabled=HostParamMirror.enabled_for(fabric, cfg),
+    )
+
     @jax.jit
     def policy_step_fn(params, obs, key):
+        # the key advances INSIDE the jitted call: the host rollout then costs
+        # exactly one dispatch per env step (a host-side jax.random.split per
+        # step would be a second one — over a remote TPU, a second round trip)
+        key, sub = jax.random.split(key)
         norm = normalize_obs(obs, cnn_keys, obs_keys)
         pre_dist, values = agent.apply({"params": params}, norm)
-        actions, real_actions, logprob = sample_actions(pre_dist, is_continuous, key)
-        return actions, real_actions, logprob, values
+        actions, real_actions, logprob = sample_actions(pre_dist, is_continuous, sub)
+        return actions, real_actions, logprob, values, key
 
     @jax.jit
     def value_fn(params, obs):
@@ -338,6 +353,9 @@ def main(fabric, cfg: Dict[str, Any]):
     # First observation
     obs = envs.reset(seed=cfg.seed)[0]
     next_obs = prepare_obs(obs, cnn_keys, n_envs)
+    play_params = to_host(params)
+    root_key, play_key = jax.random.split(root_key)
+    play_key = to_host.put_key(play_key)
 
     for update in range(start_step, num_updates + 1):
         if cfg.algo.anneal_lr:
@@ -356,9 +374,8 @@ def main(fabric, cfg: Dict[str, Any]):
             policy_step += n_envs
 
             with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
-                root_key, step_key = jax.random.split(root_key)
-                actions_j, real_actions_j, logprob_j, values_j = policy_step_fn(
-                    params, next_obs, step_key
+                actions_j, real_actions_j, logprob_j, values_j, play_key = policy_step_fn(
+                    play_params, next_obs, play_key
                 )
                 real_actions = np.asarray(real_actions_j)
                 obs, rewards, terminated, truncated, info = envs.step(
@@ -374,7 +391,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         for k in obs_keys
                     }
                     t_obs = prepare_obs(t_obs, cnn_keys, len(truncated_envs))
-                    vals = np.asarray(value_fn(params, t_obs)).reshape(-1)
+                    vals = np.asarray(value_fn(play_params, t_obs)).reshape(-1)
                     rewards = np.asarray(rewards, dtype=np.float32)
                     rewards[truncated_envs] += vals
 
@@ -409,7 +426,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         )
 
         # GAE over the whole rollout (ppo.py:350-368), one fused scan on device
-        next_values = value_fn(params, next_obs)
+        next_values = value_fn(play_params, next_obs)
         returns, advantages = gae_fn(
             rb["rewards"], rb["values"], rb["dones"], next_values
         )
@@ -441,6 +458,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 jnp.float32(cfg.algo.ent_coef),
             )
             losses = np.asarray(losses)  # blocks → train_time is honest
+        play_params = to_host(params)
         train_step += world_size
 
         if aggregator and not aggregator.disabled:
@@ -520,5 +538,5 @@ def main(fabric, cfg: Dict[str, Any]):
             )
 
     envs.close()
-    if fabric.is_global_zero:
+    if fabric.is_global_zero and cfg.algo.get("run_test", True):
         test(agent, params, fabric, cfg, log_dir)

@@ -42,6 +42,7 @@ from sheeprl_tpu.algos.ppo.agent import build_agent, sample_actions
 from sheeprl_tpu.algos.ppo.ppo import build_update_fn, make_vector_env
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -177,10 +178,15 @@ def main(fabric, cfg: Dict[str, Any]):
     # depth-1 queue = the double buffer: the player fills rollout k+1 while
     # the trainer consumes rollout k
     rollout_q: "queue.Queue[Any]" = queue.Queue(maxsize=1)
-    # the "param broadcast": the trainer swaps in the new replicated pytree,
-    # the player reads whichever snapshot is current (jax arrays are
-    # immutable, so a torn read is impossible)
-    param_cell = {"params": params}
+    # the "param broadcast": the trainer swaps in the new snapshot, the
+    # player reads whichever is current (jax arrays are immutable, so a torn
+    # read is impossible); the snapshot lives on the CPU host so the player's
+    # per-step policy dispatch never leaves the host (utils/host.py)
+    to_host = HostParamMirror(
+        params,
+        enabled=HostParamMirror.enabled_for(fabric, cfg),
+    )
+    param_cell = {"params": to_host(params)}
     stop = threading.Event()
     player_error: Dict[str, BaseException] = {}
 
@@ -320,7 +326,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
             # the new parameters become visible to the player (the reference's
             # rank-1 → rank-0 flat-parameter broadcast, :525-529)
-            param_cell["params"] = params
+            param_cell["params"] = to_host(params)
 
             if cfg.metric.log_level > 0 and logger is not None:
                 logger.log_metrics({"Info/learning_rate": lr}, policy_step)
@@ -406,5 +412,5 @@ def main(fabric, cfg: Dict[str, Any]):
         player_thread.join(timeout=30)
 
     envs.close()
-    if fabric.is_global_zero:
+    if fabric.is_global_zero and cfg.algo.get("run_test", True):
         test(agent, jax.device_get(params), fabric, cfg, log_dir)

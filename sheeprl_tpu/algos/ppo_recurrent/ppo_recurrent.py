@@ -527,7 +527,7 @@ def main(fabric, cfg: Dict[str, Any]):
             )
 
     envs.close()
-    if fabric.is_global_zero:
+    if fabric.is_global_zero and cfg.algo.get("run_test", True):
         from sheeprl_tpu.algos.ppo_recurrent.utils import test
 
         test(agent, jax.device_get(params), fabric, cfg, log_dir)

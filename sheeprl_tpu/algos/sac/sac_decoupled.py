@@ -36,6 +36,7 @@ from sheeprl_tpu.algos.sac.agent import (
 from sheeprl_tpu.algos.sac.sac import build_train_fn
 from sheeprl_tpu.algos.sac.utils import concat_obs, test
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
@@ -183,7 +184,11 @@ def main(fabric, cfg: Dict[str, Any]):
     # collected/trained counters bound the player's lead to one step (the
     # reference player blocks on the per-step param exchange, :291-294)
     progress = {"collected": start_step - 1, "trained": start_step - 1}
-    param_cell = {"actor": agent_state["actor"]}
+    actor_mirror = HostParamMirror(
+        agent_state["actor"],
+        enabled=HostParamMirror.enabled_for(fabric, cfg),
+    )
+    param_cell = {"actor": actor_mirror(agent_state["actor"])}
     player_error: Dict[str, BaseException] = {}
     stop = threading.Event()
 
@@ -297,7 +302,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     losses = np.asarray(losses)
                 train_step += world_size
                 # parameter broadcast to the player (reference :525-529)
-                param_cell["actor"] = agent_state["actor"]
+                param_cell["actor"] = actor_mirror(agent_state["actor"])
 
                 if aggregator and not aggregator.disabled:
                     aggregator.update("Loss/value_loss", losses[0])
@@ -366,5 +371,5 @@ def main(fabric, cfg: Dict[str, Any]):
         player_thread.join(timeout=30)
         envs.close()
 
-    if fabric.is_global_zero:
+    if fabric.is_global_zero and cfg.algo.get("run_test", True):
         test(actor, agent_state["actor"], scale_j, bias_j, fabric, cfg, log_dir)

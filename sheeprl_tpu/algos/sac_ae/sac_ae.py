@@ -33,6 +33,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac_ae.agent import build_agent, ensemble_q, preprocess_obs
 from sheeprl_tpu.algos.sac_ae.utils import normalize_obs_jnp, prepare_obs, test
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
@@ -359,10 +360,21 @@ def main(fabric, cfg: Dict[str, Any]):
 
     @jax.jit
     def policy_fn(agent_params, obs, key):
+        # key advances inside the jitted call: one host dispatch per env step
+        key, sub = jax.random.split(key)
         feat = encoder.apply({"params": agent_params["encoder"]}, obs)
         mean, std = actor_trunk.apply({"params": agent_params["actor"]}, feat)
-        actions, _ = squash_sample(mean, std, key, scale_j, bias_j)
-        return actions
+        actions, _ = squash_sample(mean, std, sub, scale_j, bias_j)
+        return actions, key
+
+    def _acting_subtree(p):
+        return {"encoder": p["encoder"], "actor": p["actor"]}
+
+    actor_mirror = HostParamMirror(
+        _acting_subtree(agent_state),
+        enabled=HostParamMirror.enabled_for(fabric, cfg),
+    )
+    play_params = actor_mirror(_acting_subtree(agent_state))
 
     train_fn = build_train_fn(
         encoder, decoder, qf, actor_trunk, txs, cfg, fabric,
@@ -395,6 +407,8 @@ def main(fabric, cfg: Dict[str, Any]):
 
     o = envs.reset(seed=cfg.seed)[0]
     obs = prepare_obs(o, cnn_keys, mlp_keys, n_envs)
+    root_key, play_key = jax.random.split(root_key)
+    play_key = actor_mirror.put_key(play_key)
 
     per_rank_gradient_steps = int(cfg.algo.per_rank_gradient_steps)
     ema_every = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_update + 1
@@ -408,9 +422,9 @@ def main(fabric, cfg: Dict[str, Any]):
             if update <= learning_starts:
                 actions = envs.action_space.sample()
             else:
-                root_key, act_key = jax.random.split(root_key)
                 norm_obs = normalize_obs_jnp(obs, cnn_keys)
-                actions = np.asarray(policy_fn(agent_state, norm_obs, act_key))
+                actions_j, play_key = policy_fn(play_params, norm_obs, play_key)
+                actions = np.asarray(actions_j)
             next_o, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -478,6 +492,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     agent_state, opt_states, batch, train_key, gates
                 )
                 losses = np.asarray(losses)
+            play_params = actor_mirror(_acting_subtree(agent_state))
             train_step += world_size
 
             if aggregator and not aggregator.disabled:
@@ -537,7 +552,7 @@ def main(fabric, cfg: Dict[str, Any]):
             )
 
     envs.close()
-    if fabric.is_global_zero:
+    if fabric.is_global_zero and cfg.algo.get("run_test", True):
         test(
             encoder, actor_trunk, jax.device_get(agent_state), scale_j, bias_j,
             fabric, cfg, log_dir,

@@ -248,5 +248,30 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
     eval_algorithm(cfg)
 
 
+def registration(args: Optional[Sequence[str]] = None) -> None:
+    """Model-registration entrypoint (upstream sheeprl's
+    ``sheeprl_model_manager.py`` → ``cli.registration``): publish a training
+    checkpoint into the filesystem model registry."""
+    from sheeprl_tpu.utils.model_manager import ModelManager
+
+    overrides = list(args) if args is not None else sys.argv[1:]
+    cfg = compose(
+        "model_manager_config",
+        overrides=overrides,
+        allow_missing=("checkpoint_path", "model_name"),
+    )
+    ckpt_path = cfg.get("checkpoint_path")
+    model_name = cfg.get("model_name")
+    if not ckpt_path or ckpt_path == "???":
+        raise ValueError("You must specify the checkpoint path: checkpoint_path=/path/to/ckpt")
+    if not model_name or model_name == "???":
+        raise ValueError("You must specify the model name: model_name=my_agent")
+    manager = ModelManager(cfg.get("registry_dir", "models"))
+    version = manager.register_model(
+        model_name, ckpt_path, description=cfg.get("description", "")
+    )
+    print(f"Registered '{model_name}' v{version} in {manager.registry_dir}")
+
+
 if __name__ == "__main__":
     run()

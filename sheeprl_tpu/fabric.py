@@ -93,6 +93,12 @@ class Fabric:
         return len(self._devices)
 
     @property
+    def on_accelerator(self) -> bool:
+        """True when the mesh runs on an accelerator (acting then mirrors
+        parameters to the CPU host — see utils/host.py)."""
+        return self.mesh.devices.flat[0].platform != "cpu"
+
+    @property
     def global_rank(self) -> int:
         """Process index — host-side identity for logging/checkpointing."""
         return jax.process_index()
@@ -169,6 +175,16 @@ class Fabric:
                 f"fabric.num_nodes={self.num_nodes} but jax.distributed is not initialized; "
                 "running single-host"
             )
+        # Eager host-side work in the entrypoint (flax param init, PRNG key
+        # math, staging) defaults to the local CPU: every op traced eagerly
+        # on an accelerator is its own XLA program — over a remote-attached
+        # TPU that is a compile + round trip *per op*. Mesh computation is
+        # unaffected: the train programs carry explicit shardings/meshes and
+        # their inputs are committed with device_put.
+        try:
+            jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        except RuntimeError:  # pragma: no cover - no cpu backend
+            pass
         return fn(self, *args, **kwargs)
 
     def setup_module(self, module: Any) -> Any:

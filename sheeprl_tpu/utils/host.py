@@ -1,0 +1,53 @@
+"""Host-side parameter mirroring for the acting path.
+
+Environment interaction is latency-bound: one jitted policy call per env
+step. When the mesh is a (possibly remote-attached) accelerator, dispatching
+that call to the mesh costs a full round trip per step, which dominates
+wall-clock (SURVEY §5.8 — players live on CPU hosts feeding the trainer
+mesh). :class:`HostParamMirror` keeps a CPU copy of the acting parameters,
+refreshed once per update as a **single packed transfer**: the pytree is
+raveled on the mesh (one jitted concat) so the snapshot crosses the wire as
+one array instead of one round trip per leaf, then unraveled on the host.
+
+Usage::
+
+    mirror = HostParamMirror(params, enabled=fabric.on_accelerator)
+    play_params = mirror(params)          # CPU tree (or `params` if disabled)
+    ...
+    play_params = mirror(new_params)      # refresh after each update
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class HostParamMirror:
+    @staticmethod
+    def enabled_for(fabric, cfg) -> bool:
+        """The one enable rule shared by every algorithm: host acting is on
+        unless ``algo.player_on_host=False``, and only matters when the mesh
+        runs on an accelerator."""
+        return bool(cfg.algo.get("player_on_host", True)) and fabric.on_accelerator
+
+    def __init__(self, example_tree: Any, enabled: bool = True):
+        self.enabled = bool(enabled)
+        if self.enabled:
+            from jax.flatten_util import ravel_pytree
+
+            self._host = jax.devices("cpu")[0]
+            _, self._unravel = ravel_pytree(jax.device_get(example_tree))
+            self._pack = jax.jit(lambda p: ravel_pytree(p)[0])
+
+    def __call__(self, tree: Any) -> Any:
+        if not self.enabled:
+            return tree
+        flat = np.asarray(self._pack(tree))
+        return jax.device_put(self._unravel(flat), self._host)
+
+    def put_key(self, key: jax.Array) -> jax.Array:
+        """Commit a PRNG key next to the mirrored params."""
+        return jax.device_put(key, self._host) if self.enabled else key

@@ -1,0 +1,148 @@
+"""Model registry — publish trained agents out of training checkpoints.
+
+Equivalent of the reference's model-manager subsystem (upstream sheeprl
+ships ``sheeprl_model_manager.py`` → ``cli.registration`` backed by MLflow;
+the mounted 0.4.7 snapshot contains only the shim, the newer test snapshot
+exercises it via ``tests/conftest.py``). MLflow is not part of this image,
+so the registry is filesystem-backed with the same concepts:
+
+- **register**: copy the agent state out of a run checkpoint into
+  ``<registry>/<name>/v<k>/`` together with the run's config and free-form
+  metadata; versions auto-increment;
+- **get / load**: resolve ``(name, version)`` → checkpoint path or restored
+  pytree (latest version by default);
+- **list / delete / transition**: enumerate the registry, drop versions,
+  and move a version between ``none/staging/production`` stages.
+
+Orbax is the storage format, so a registered model is loadable with the same
+``Fabric.load`` used for training checkpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+_STAGES = ("none", "staging", "production")
+
+
+class ModelManager:
+    def __init__(self, registry_dir: str = "models"):
+        self.registry_dir = os.path.abspath(registry_dir)
+        os.makedirs(self.registry_dir, exist_ok=True)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _model_dir(self, name: str) -> str:
+        return os.path.join(self.registry_dir, name)
+
+    def _versions(self, name: str) -> List[int]:
+        mdir = self._model_dir(name)
+        if not os.path.isdir(mdir):
+            return []
+        out = []
+        for d in os.listdir(mdir):
+            if d.startswith("v") and d[1:].isdigit():
+                out.append(int(d[1:]))
+        return sorted(out)
+
+    def _version_dir(self, name: str, version: int) -> str:
+        return os.path.join(self._model_dir(name), f"v{version}")
+
+    def _resolve(self, name: str, version: Optional[int]) -> int:
+        versions = self._versions(name)
+        if not versions:
+            raise KeyError(f"No registered model named '{name}' in {self.registry_dir}")
+        if version is None:
+            return versions[-1]
+        if version not in versions:
+            raise KeyError(f"Model '{name}' has no version {version}; available: {versions}")
+        return version
+
+    # -- API ---------------------------------------------------------------
+
+    def register_model(
+        self,
+        name: str,
+        checkpoint_path: str,
+        description: str = "",
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> int:
+        """Publish the checkpoint at ``checkpoint_path`` as a new version.
+
+        The checkpoint directory (orbax tree) is copied verbatim; the run's
+        persisted ``.hydra/config.yaml`` is copied alongside when present.
+        Returns the new version number.
+        """
+        checkpoint_path = os.path.abspath(checkpoint_path)
+        if not os.path.isdir(checkpoint_path):
+            raise FileNotFoundError(f"Checkpoint not found: {checkpoint_path}")
+        version = (self._versions(name)[-1] + 1) if self._versions(name) else 1
+        vdir = self._version_dir(name, version)
+        os.makedirs(vdir)
+        shutil.copytree(checkpoint_path, os.path.join(vdir, "checkpoint"))
+        run_cfg = os.path.join(
+            os.path.dirname(os.path.dirname(checkpoint_path)), ".hydra", "config.yaml"
+        )
+        if os.path.isfile(run_cfg):
+            shutil.copy(run_cfg, os.path.join(vdir, "config.yaml"))
+        meta = {
+            "name": name,
+            "version": version,
+            "description": description,
+            "source_checkpoint": checkpoint_path,
+            "registered_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "stage": "none",
+            **(metadata or {}),
+        }
+        with open(os.path.join(vdir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        return version
+
+    def get_model(self, name: str, version: Optional[int] = None) -> str:
+        """Path of the registered checkpoint (latest version by default)."""
+        version = self._resolve(name, version)
+        return os.path.join(self._version_dir(name, version), "checkpoint")
+
+    def load_model(self, name: str, version: Optional[int] = None) -> Any:
+        """Restore the registered agent pytree."""
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            return ckptr.restore(self.get_model(name, version))
+
+    def get_metadata(self, name: str, version: Optional[int] = None) -> Dict[str, Any]:
+        version = self._resolve(name, version)
+        with open(os.path.join(self._version_dir(name, version), "meta.json")) as f:
+            return json.load(f)
+
+    def list_models(self) -> Dict[str, List[Dict[str, Any]]]:
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        if not os.path.isdir(self.registry_dir):
+            return out
+        for name in sorted(os.listdir(self.registry_dir)):
+            versions = self._versions(name)
+            if versions:
+                out[name] = [self.get_metadata(name, v) for v in versions]
+        return out
+
+    def transition_model(self, name: str, version: Optional[int] = None, stage: str = "staging") -> None:
+        """Move a version between lifecycle stages (MLflow-style)."""
+        if stage not in _STAGES:
+            raise ValueError(f"Unknown stage '{stage}'; must be one of {_STAGES}")
+        version = self._resolve(name, version)
+        path = os.path.join(self._version_dir(name, version), "meta.json")
+        with open(path) as f:
+            meta = json.load(f)
+        meta["stage"] = stage
+        with open(path, "w") as f:
+            json.dump(meta, f, indent=2)
+
+    def delete_model(self, name: str, version: Optional[int] = None) -> None:
+        version = self._resolve(name, version)
+        shutil.rmtree(self._version_dir(name, version))
+        if not self._versions(name):
+            shutil.rmtree(self._model_dir(name), ignore_errors=True)

@@ -1,0 +1,71 @@
+"""HostParamMirror unit tests — the enabled (accelerator) path is otherwise
+only exercised on real TPU hardware, so the pack/unravel round-trip is
+pinned here on CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.host import HostParamMirror
+
+
+def _tree():
+    return {
+        "dense": {"kernel": jnp.arange(12, dtype=jnp.float32).reshape(3, 4), "bias": jnp.ones(4)},
+        "scale": jnp.float32(2.5),
+        "embed": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+    }
+
+
+def test_enabled_roundtrip_is_exact():
+    tree = _tree()
+    mirror = HostParamMirror(tree, enabled=True)
+    out = mirror(tree)
+    # identical structure and bit-exact leaves
+    assert jax.tree_util.tree_structure(out) == jax.tree_util.tree_structure(tree)
+    for a, b in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.dtype == b.dtype
+    # mirrored leaves live on the CPU host
+    cpu = jax.devices("cpu")[0]
+    assert all(cpu in leaf.devices() for leaf in jax.tree_util.tree_leaves(out))
+
+
+def test_enabled_refresh_tracks_new_values():
+    tree = _tree()
+    mirror = HostParamMirror(tree, enabled=True)
+    updated = jax.tree_util.tree_map(lambda x: x + 1.0, tree)
+    out = mirror(updated)
+    np.testing.assert_array_equal(
+        np.asarray(out["dense"]["kernel"]),
+        np.arange(12, dtype=np.float32).reshape(3, 4) + 1.0,
+    )
+
+
+def test_put_key_placement():
+    mirror = HostParamMirror(_tree(), enabled=True)
+    key = mirror.put_key(jax.random.PRNGKey(0))
+    assert jax.devices("cpu")[0] in key.devices()
+
+
+def test_disabled_is_identity():
+    tree = _tree()
+    mirror = HostParamMirror(tree, enabled=False)
+    assert mirror(tree) is tree
+    key = jax.random.PRNGKey(0)
+    assert mirror.put_key(key) is key
+
+
+def test_enabled_for_rule():
+    class FakeFabric:
+        on_accelerator = True
+
+    class FakeCfg:
+        algo = {"player_on_host": True}
+
+    assert HostParamMirror.enabled_for(FakeFabric(), FakeCfg())
+    FakeCfg.algo = {"player_on_host": False}
+    assert not HostParamMirror.enabled_for(FakeFabric(), FakeCfg())
+    FakeFabric.on_accelerator = False
+    FakeCfg.algo = {}
+    assert not HostParamMirror.enabled_for(FakeFabric(), FakeCfg())

@@ -1,0 +1,97 @@
+"""Model-registry tests (upstream sheeprl's model-manager surface:
+register / get / list / transition / delete + the registration CLI)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+def _write_ckpt(tmp_path, name="ckpt_4_0", value=1.0):
+    import orbax.checkpoint as ocp
+
+    run_dir = tmp_path / "run" / "version_0"
+    ckpt = run_dir / "checkpoint" / name
+    hydra_dir = run_dir / ".hydra"
+    hydra_dir.mkdir(parents=True)
+    (hydra_dir / "config.yaml").write_text("algo:\n  name: ppo\n")
+    state = {"params": {"w": np.full((2, 2), value, np.float32)}, "update": 4}
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(os.fspath(ckpt), state)
+    return os.fspath(ckpt)
+
+
+def test_register_get_load_roundtrip(tmp_path):
+    from sheeprl_tpu.utils.model_manager import ModelManager
+
+    ckpt = _write_ckpt(tmp_path)
+    mm = ModelManager(os.fspath(tmp_path / "registry"))
+    v1 = mm.register_model("cartpole_ppo", ckpt, description="first")
+    assert v1 == 1
+    v2 = mm.register_model("cartpole_ppo", _write_ckpt(tmp_path / "b", value=2.0))
+    assert v2 == 2
+
+    # latest by default
+    restored = mm.load_model("cartpole_ppo")
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 2.0)
+    restored_v1 = mm.load_model("cartpole_ppo", version=1)
+    np.testing.assert_allclose(np.asarray(restored_v1["params"]["w"]), 1.0)
+
+    meta = mm.get_metadata("cartpole_ppo", 1)
+    assert meta["description"] == "first" and meta["stage"] == "none"
+    # the run config travels with the model
+    assert os.path.isfile(
+        os.path.join(os.path.dirname(mm.get_model("cartpole_ppo", 1)), "config.yaml")
+    )
+
+
+def test_list_transition_delete(tmp_path):
+    from sheeprl_tpu.utils.model_manager import ModelManager
+
+    mm = ModelManager(os.fspath(tmp_path / "registry"))
+    mm.register_model("m", _write_ckpt(tmp_path))
+    mm.register_model("m", _write_ckpt(tmp_path / "b"))
+
+    listing = mm.list_models()
+    assert list(listing) == ["m"] and len(listing["m"]) == 2
+
+    mm.transition_model("m", 1, "production")
+    assert mm.get_metadata("m", 1)["stage"] == "production"
+    with pytest.raises(ValueError):
+        mm.transition_model("m", 1, "bogus")
+
+    mm.delete_model("m", 2)
+    assert [d["version"] for d in mm.list_models()["m"]] == [1]
+    mm.delete_model("m", 1)
+    assert mm.list_models() == {}
+    with pytest.raises(KeyError):
+        mm.get_model("m")
+
+
+def test_registration_cli(tmp_path, monkeypatch, capsys):
+    from sheeprl_tpu import cli
+
+    monkeypatch.chdir(tmp_path)
+    ckpt = _write_ckpt(tmp_path)
+    cli.registration(
+        [
+            f"checkpoint_path={ckpt}",
+            "model_name=from_cli",
+            f"registry_dir={tmp_path}/registry",
+            "description=via cli",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert "Registered 'from_cli' v1" in out
+    meta = json.load(open(tmp_path / "registry" / "from_cli" / "v1" / "meta.json"))
+    assert meta["description"] == "via cli"
+
+
+def test_registration_cli_requires_args(tmp_path):
+    from sheeprl_tpu import cli
+
+    with pytest.raises(ValueError):
+        cli.registration([f"registry_dir={tmp_path}/r", "model_name=x"])
+    with pytest.raises(ValueError):
+        cli.registration([f"registry_dir={tmp_path}/r", f"checkpoint_path={tmp_path}"])
