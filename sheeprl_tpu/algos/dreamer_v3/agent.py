@@ -925,6 +925,11 @@ def build_player_fns(
     unimix = float(cfg.algo.unimix)
     rec_size = int(cfg.algo.world_model.recurrent_model.recurrent_state_size)
     act_dim = int(np.sum(actions_dim))
+    # MineDojo envs carry per-step validity masks: route sampling and
+    # exploration noise through the mask-aware actor (reference dispatches a
+    # MinedojoActor subclass via cfg.algo.actor.cls; here the same head
+    # layout takes a `masks` kwarg — minedojo_actor.py)
+    minedojo = "minedojo" in str(cfg.env.wrapper.get("_target_", "") or "").lower()
 
     def init_states(wm_params, n_envs: int):
         recurrent = jnp.tanh(jnp.zeros((n_envs, rec_size)))
@@ -944,7 +949,7 @@ def build_player_fns(
             lambda f, s: reset_mask * f + (1.0 - reset_mask) * s, fresh, state
         )
 
-    def _step(wm_params, actor_params, state, obs, key, is_training: bool):
+    def _step(wm_params, actor_params, state, obs, key, is_training: bool, masks=None):
         embed = world_model.apply({"params": wm_params}, obs, method=WorldModel.encode)
         recurrent = world_model.apply(
             {"params": wm_params},
@@ -959,10 +964,17 @@ def build_player_fns(
         )
         latent = jnp.concatenate([stochastic, recurrent], -1)
         pre_dist = actor.apply({"params": actor_params}, latent)
-        dists = build_actor_dists(
-            pre_dist, is_continuous, distribution, init_std, min_std, unimix
-        )
-        actions = sample_actor_actions(dists, is_continuous, k_act, is_training)
+        if minedojo and masks is not None:
+            from sheeprl_tpu.algos.dreamer_v3.minedojo_actor import sample_minedojo_actions
+
+            actions, _ = sample_minedojo_actions(
+                pre_dist, masks, k_act, unimix, is_training
+            )
+        else:
+            dists = build_actor_dists(
+                pre_dist, is_continuous, distribution, init_std, min_std, unimix
+            )
+            actions = sample_actor_actions(dists, is_continuous, k_act, is_training)
         new_state = {
             "actions": jnp.concatenate(actions, -1),
             "recurrent": recurrent,
@@ -971,14 +983,23 @@ def build_player_fns(
         return actions, new_state
 
     @jax.jit
-    def greedy_action(wm_params, actor_params, state, obs, key):
-        return _step(wm_params, actor_params, state, obs, key, is_training=False)
+    def greedy_action(wm_params, actor_params, state, obs, key, masks=None):
+        return _step(wm_params, actor_params, state, obs, key, is_training=False, masks=masks)
 
     @jax.jit
-    def exploration_action(wm_params, actor_params, state, obs, key, expl_amount):
+    def exploration_action(wm_params, actor_params, state, obs, key, expl_amount, masks=None):
         k_step, k_expl = jax.random.split(key)
-        actions, new_state = _step(wm_params, actor_params, state, obs, k_step, is_training=True)
-        expl = add_exploration_noise(actions, expl_amount, is_continuous, k_expl)
+        actions, new_state = _step(
+            wm_params, actor_params, state, obs, k_step, is_training=True, masks=masks
+        )
+        if minedojo and masks is not None:
+            from sheeprl_tpu.algos.dreamer_v3.minedojo_actor import (
+                add_minedojo_exploration_noise,
+            )
+
+            expl = add_minedojo_exploration_noise(actions, expl_amount, masks, k_expl)
+        else:
+            expl = add_exploration_noise(actions, expl_amount, is_continuous, k_expl)
         new_state = dict(new_state, actions=jnp.concatenate(expl, -1))
         return expl, new_state
 

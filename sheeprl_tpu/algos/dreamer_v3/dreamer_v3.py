@@ -448,6 +448,12 @@ def main(fabric, cfg: Dict[str, Any]):
         else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
     )
     clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+    is_minedojo = "minedojo" in str(cfg.env.wrapper.get("_target_", "") or "").lower()
+    mask_keys = (
+        ("mask_action_type", "mask_craft_smelt", "mask_equip_place", "mask_destroy")
+        if is_minedojo
+        else ()
+    )
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
     if cfg.cnn_keys.encoder == [] and cfg.mlp_keys.encoder == []:
@@ -628,6 +634,11 @@ def main(fabric, cfg: Dict[str, Any]):
                     )
             else:
                 norm_obs = normalize_obs_jnp(obs, cnn_keys)
+                masks = (
+                    {k: jnp.asarray(np.asarray(o[k])) for k in mask_keys}
+                    if is_minedojo
+                    else None
+                )
                 root_key, act_key = jax.random.split(root_key)
                 actions_j, player_state = player_fns["exploration_action"](
                     play_wm,
@@ -636,6 +647,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     norm_obs,
                     act_key,
                     jnp.float32(expl_amount),
+                    masks=masks,
                 )
                 actions = np.concatenate([np.asarray(a) for a in actions_j], -1)
                 if is_continuous:

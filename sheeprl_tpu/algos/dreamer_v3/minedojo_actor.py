@@ -1,0 +1,150 @@
+"""Mask-aware MineDojo actor (reference ``sheeprl/algos/dreamer_v3/agent.py``
+MinedojoActor :770-897, and the DV2 variant it subclasses).
+
+MineDojo exposes per-step validity masks (``mask_action_type``,
+``mask_craft_smelt``, ``mask_equip_place``, ``mask_destroy`` — see
+``envs/minedojo.py``); the actor must sample the three-head action
+(action-type, craft-arg, item-arg) so that
+
+- invalid action types are never selected,
+- the craft-arg head is masked by ``mask_craft_smelt`` *only when* the
+  sampled action type is craft (id 15),
+- the item-arg head is masked by ``mask_equip_place`` for equip/place
+  (16/17) and by ``mask_destroy`` for destroy (18).
+
+The reference implements the conditioning with Python loops over the
+``[T, B]`` grid; here it is branchless ``jnp.where`` masking over the whole
+batch, so the masked actor stays inside the jitted player/imagination
+programs (SURVEY.md "hard parts": mask-dependent Minedojo actors must
+become branchless to stay jittable).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v3.agent import uniform_mix
+from sheeprl_tpu.distributions import OneHotCategorical, OneHotCategoricalStraightThrough
+
+_NEG_INF = -1e9  # softmax-safe -inf: keeps masked logits finite under jit
+
+CRAFT_ACTION = 15
+EQUIP_ACTION = 16
+PLACE_ACTION = 17
+DESTROY_ACTION = 18
+
+
+def _mask_logits(logits: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(valid, logits, _NEG_INF)
+
+
+def masked_action_type_logits(logits: jnp.ndarray, masks: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """Head 0: invalid action types are unreachable (reference :820-823)."""
+    return _mask_logits(logits, masks["mask_action_type"].astype(bool))
+
+
+def masked_arg_logits(
+    head: int,
+    logits: jnp.ndarray,
+    functional_action: jnp.ndarray,
+    masks: Dict[str, jnp.ndarray],
+) -> jnp.ndarray:
+    """Heads 1/2 conditioned on the *sampled* action type
+    (reference :824-843), branchlessly over the batch.
+
+    ``functional_action``: integer ``[...]`` action-type ids.
+    """
+    if head == 1:
+        is_craft = (functional_action == CRAFT_ACTION)[..., None]
+        valid = jnp.logical_or(
+            jnp.logical_not(is_craft), masks["mask_craft_smelt"].astype(bool)
+        )
+        return _mask_logits(logits, valid)
+    if head == 2:
+        is_equip_place = jnp.logical_or(
+            functional_action == EQUIP_ACTION, functional_action == PLACE_ACTION
+        )[..., None]
+        is_destroy = (functional_action == DESTROY_ACTION)[..., None]
+        valid = jnp.logical_and(
+            jnp.logical_or(jnp.logical_not(is_equip_place), masks["mask_equip_place"].astype(bool)),
+            jnp.logical_or(jnp.logical_not(is_destroy), masks["mask_destroy"].astype(bool)),
+        )
+        return _mask_logits(logits, valid)
+    raise ValueError(f"masked_arg_logits handles heads 1 and 2, got {head}")
+
+
+def sample_minedojo_actions(
+    pre_dist: Sequence[jnp.ndarray],
+    masks: Optional[Dict[str, jnp.ndarray]],
+    key: jax.Array,
+    unimix: float = 0.01,
+    is_training: bool = True,
+) -> Tuple[List[jnp.ndarray], List[Any]]:
+    """Sequentially sample the three MineDojo heads with mask conditioning
+    (reference forward :801-853). Returns ``(actions, dists)``."""
+    if masks is None:
+        masks = {}
+    keys = jax.random.split(key, len(pre_dist))
+    actions: List[jnp.ndarray] = []
+    dists: List[Any] = []
+    functional_action = None
+    for i, logits in enumerate(pre_dist):
+        logits = uniform_mix(logits, logits.shape[-1], unimix)
+        if masks:
+            if i == 0:
+                logits = masked_action_type_logits(logits, masks)
+            else:
+                logits = masked_arg_logits(i, logits, functional_action, masks)
+        dist = OneHotCategoricalStraightThrough(logits=logits)
+        act = dist.rsample(keys[i]) if is_training else dist.mode
+        actions.append(act)
+        dists.append(dist)
+        if functional_action is None:
+            functional_action = jnp.argmax(act, axis=-1)
+    return actions, dists
+
+
+def add_minedojo_exploration_noise(
+    actions: Sequence[jnp.ndarray],
+    expl_amount: jnp.ndarray,
+    masks: Optional[Dict[str, jnp.ndarray]],
+    key: jax.Array,
+) -> List[jnp.ndarray]:
+    """ε-exploration that still respects the env constraints (reference
+    add_exploration_noise :855-897): uniform resampling draws only from the
+    *valid* actions, and when the resampled action type becomes a functional
+    action (craft/equip/place/destroy) the argument heads are forced to
+    resample too so the composite action stays consistent."""
+    if masks is None:
+        masks = {}
+    out: List[jnp.ndarray] = []
+    functional_action = jnp.argmax(actions[0], axis=-1)
+    keys = jax.random.split(key, 2 * len(actions))
+    type_changed = None
+    for i, act in enumerate(actions):
+        logits = jnp.zeros_like(act)
+        if masks:
+            if i == 0:
+                logits = masked_action_type_logits(logits, masks)
+            else:
+                logits = masked_arg_logits(i, logits, functional_action, masks)
+        rand = OneHotCategorical(logits=logits).sample(keys[2 * i])
+        take = jax.random.uniform(keys[2 * i + 1], act.shape[:-1] + (1,)) < expl_amount
+        if i == 0:
+            new0 = jnp.where(take, rand, act)
+            new_functional = jnp.argmax(new0, axis=-1)
+            # forced-resample condition for the argument heads (reference
+            # expl_amount = 2 hack :883-889)
+            type_changed = jnp.logical_and(
+                new_functional != functional_action,
+                jnp.logical_and(new_functional >= CRAFT_ACTION, new_functional <= DESTROY_ACTION),
+            )[..., None]
+            functional_action = new_functional
+            out.append(new0)
+        else:
+            take = jnp.logical_or(take, type_changed)
+            out.append(jnp.where(take, rand, act))
+    return out

@@ -176,6 +176,21 @@ def run_algorithm(cfg) -> None:
     ) == 0
     timer.disabled = cfg.metric.log_level == 0 or cfg.metric.get("disable_timer", False)
 
+    # jax.profiler trace capture around the whole run (SURVEY §5.1 — the TPU
+    # superset of the reference's named-scope timers)
+    profiler = cfg.metric.get("profiler", False)
+    if profiler:
+        import jax
+
+        # traces land inside the run tree next to checkpoints/metrics
+        trace_dir = (
+            profiler
+            if isinstance(profiler, str)
+            else os.path.join("logs", "runs", str(cfg.root_dir), str(cfg.run_name), "jax_traces")
+        )
+        with jax.profiler.trace(os.path.abspath(trace_dir)):
+            return fabric.launch(entrypoint, cfg, **kwargs)
+
     fabric.launch(entrypoint, cfg, **kwargs)
 
 

@@ -1,0 +1,137 @@
+"""Minedojo mask-aware actor units: the branchless masking must make invalid
+actions unreachable and condition the argument heads on the sampled action
+type (reference MinedojoActor, dreamer_v3/agent.py:770-897)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.minedojo_actor import (
+    CRAFT_ACTION,
+    DESTROY_ACTION,
+    add_minedojo_exploration_noise,
+    sample_minedojo_actions,
+)
+
+N_TYPES, N_CRAFT, N_ITEMS = 19, 6, 8
+
+
+def _masks(batch=4, allow_types=None, allow_craft=None, allow_items=None):
+    m = {
+        "mask_action_type": np.ones((batch, N_TYPES), bool),
+        "mask_craft_smelt": np.ones((batch, N_CRAFT), bool),
+        "mask_equip_place": np.ones((batch, N_ITEMS), bool),
+        "mask_destroy": np.ones((batch, N_ITEMS), bool),
+    }
+    if allow_types is not None:
+        m["mask_action_type"][:] = False
+        m["mask_action_type"][:, allow_types] = True
+    if allow_craft is not None:
+        m["mask_craft_smelt"][:] = False
+        m["mask_craft_smelt"][:, allow_craft] = True
+    if allow_items is not None:
+        m["mask_equip_place"][:] = False
+        m["mask_equip_place"][:, allow_items] = True
+        m["mask_destroy"][:] = False
+        m["mask_destroy"][:, allow_items] = True
+    return {k: jnp.asarray(v) for k, v in m.items()}
+
+
+def _pre_dist(batch=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        jnp.asarray(rng.normal(size=(batch, n)).astype(np.float32))
+        for n in (N_TYPES, N_CRAFT, N_ITEMS)
+    ]
+
+
+def test_invalid_action_types_never_sampled():
+    masks = _masks(allow_types=[0, 1, 14])
+    for seed in range(5):
+        actions, _ = sample_minedojo_actions(_pre_dist(), masks, jax.random.PRNGKey(seed))
+        chosen = np.asarray(jnp.argmax(actions[0], -1))
+        assert set(chosen.tolist()) <= {0, 1, 14}
+
+
+def test_craft_arg_masked_only_when_crafting():
+    # force every env to pick the craft action → the craft head must obey
+    masks = _masks(allow_types=[CRAFT_ACTION], allow_craft=[2])
+    actions, _ = sample_minedojo_actions(_pre_dist(), masks, jax.random.PRNGKey(0))
+    assert np.all(np.asarray(jnp.argmax(actions[0], -1)) == CRAFT_ACTION)
+    assert np.all(np.asarray(jnp.argmax(actions[1], -1)) == 2)
+
+    # non-functional action type → craft head unconstrained by the mask
+    masks2 = _masks(allow_types=[1], allow_craft=[2])
+    seen = set()
+    for seed in range(8):
+        actions, _ = sample_minedojo_actions(_pre_dist(seed=seed), masks2, jax.random.PRNGKey(seed))
+        seen |= set(np.asarray(jnp.argmax(actions[1], -1)).tolist())
+    assert len(seen) > 1  # not pinned to the masked option
+
+
+def test_destroy_arg_masked_when_destroying():
+    masks = _masks(allow_types=[DESTROY_ACTION], allow_items=[5])
+    actions, _ = sample_minedojo_actions(_pre_dist(), masks, jax.random.PRNGKey(3))
+    assert np.all(np.asarray(jnp.argmax(actions[0], -1)) == DESTROY_ACTION)
+    assert np.all(np.asarray(jnp.argmax(actions[2], -1)) == 5)
+
+
+def test_greedy_mode_respects_masks():
+    masks = _masks(allow_types=[7])
+    actions, _ = sample_minedojo_actions(
+        _pre_dist(), masks, jax.random.PRNGKey(0), is_training=False
+    )
+    assert np.all(np.asarray(jnp.argmax(actions[0], -1)) == 7)
+
+
+def test_exploration_noise_respects_masks():
+    masks = _masks(allow_types=[0, 3], allow_craft=[1], allow_items=[2])
+    actions, _ = sample_minedojo_actions(_pre_dist(), masks, jax.random.PRNGKey(1))
+    noisy = add_minedojo_exploration_noise(
+        actions, jnp.float32(1.0), masks, jax.random.PRNGKey(2)
+    )
+    assert set(np.asarray(jnp.argmax(noisy[0], -1)).tolist()) <= {0, 3}
+
+
+def test_dv3_player_respects_masks_when_minedojo():
+    """End-to-end wiring: the DV3 player routes sampling through the
+    mask-aware actor when the env wrapper is MineDojo."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent, build_player_fns
+    from sheeprl_tpu.config.engine import compose
+
+    cfg = compose(
+        "config",
+        overrides=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "metric.log_level=0",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.stochastic_size=4",
+            "algo.world_model.discrete_size=4",
+            "cnn_keys.encoder=[rgb]",
+        ],
+    )
+    cfg.env.wrapper._target_ = "sheeprl_tpu.envs.minedojo.MineDojoWrapper"
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    actions_dim = (N_TYPES, N_CRAFT, N_ITEMS)
+    world_model, actor, critic, params = build_agent(
+        cfg, actions_dim, False, obs_space, jax.random.PRNGKey(0)
+    )
+    player_fns = build_player_fns(world_model, actor, cfg, actions_dim, False)
+    state = player_fns["init_states"](params["world_model"], 3)
+    obs = {"rgb": jnp.zeros((3, 3, 64, 64), jnp.float32)}
+    masks = _masks(batch=3, allow_types=[4])
+    for seed in range(3):
+        actions, state = player_fns["exploration_action"](
+            params["world_model"], params["actor"], state, obs,
+            jax.random.PRNGKey(seed), jnp.float32(0.5), masks=masks,
+        )
+        assert np.all(np.asarray(jnp.argmax(actions[0], -1)) == 4)

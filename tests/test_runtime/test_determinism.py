@@ -1,0 +1,59 @@
+"""Seeds → bitwise reproducibility (SURVEY §5.2: jit purity + threaded PRNG
+keys make determinism structural; this pins it)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+
+def _train_once(tmp_path, run_name):
+    from sheeprl_tpu import cli
+
+    cli.run(
+        [
+            "exp=ppo",
+            "env=gym",
+            "env.id=CartPole-v1",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "dry_run=False",
+            "total_steps=64",
+            "algo.rollout_steps=8",
+            "per_rank_batch_size=8",
+            "algo.update_epochs=2",
+            "env.num_envs=2",
+            "fabric.devices=1",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "buffer.memmap=False",
+            "checkpoint.save_last=True",
+            "checkpoint.every=1000000",
+            "algo.run_test=False",
+            "seed=7",
+            f"root_dir={tmp_path}/logs",
+            f"run_name={run_name}",
+        ]
+    )
+    ckpts = sorted(
+        glob.glob(f"{tmp_path}/logs/**/{run_name}*/**/ckpt_*", recursive=True)
+    )
+    assert ckpts, f"no checkpoint for {run_name}"
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(ckpts[-1]))
+
+
+def test_same_seed_same_bits(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    a = _train_once(tmp_path, "run_a")
+    b = _train_once(tmp_path, "run_b")
+    import jax
+
+    leaves_a = jax.tree_util.tree_leaves(a["params"])
+    leaves_b = jax.tree_util.tree_leaves(b["params"])
+    assert len(leaves_a) == len(leaves_b) > 0
+    for la, lb in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
