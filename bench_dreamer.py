@@ -1,0 +1,105 @@
+"""DreamerV3 training-throughput benchmark on the attached accelerator.
+
+Measures steady-state gradient-steps/sec of the full fused DV3 train step
+(world model + actor + critic, T=64 sequences, batch 16, the S/M preset of
+the Atari-100K recipe) — the quantity that dominates Atari-100K wall-clock
+(~100k gradient steps at ``train_every=1``).
+
+Prints ONE JSON line like bench.py. Baseline: the reference trains
+Atari-100K in 14 h on a single RTX 3080 (`BASELINE.md`), i.e. ≈2.0
+grad-steps/s end-to-end.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_STEPS_PER_SEC = 100000 / (14 * 3600)  # reference 100K wall-clock
+
+
+def main() -> None:
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
+        build_optimizers_and_state,
+        build_train_fn,
+    )
+    from sheeprl_tpu.config.engine import compose
+    from sheeprl_tpu.fabric import Fabric
+
+    # eager work (init, key math) stays on the host — over a remote-attached
+    # TPU every eager op is otherwise a ~100 ms compile+dispatch round trip
+    # (Fabric.launch pins this for training runs; the bench drives the step
+    # function directly)
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+    cfg = compose(
+        "config",
+        overrides=[
+            "exp=dreamer_v3_100k_ms_pacman",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "metric.log_level=0",
+            "buffer.checkpoint=False",
+            "checkpoint.every=1000000",
+        ],
+    )
+    fabric = Fabric(devices=1, accelerator="auto")
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    actions_dim = (9,)  # MsPacman
+    world_model, actor, critic, params = build_agent(
+        cfg, actions_dim, False, obs_space, jax.random.PRNGKey(0)
+    )
+    world_tx, actor_tx, critic_tx, agent_state = build_optimizers_and_state(cfg, params)
+    agent_state = jax.device_put(agent_state, fabric.replicated)
+    train_fn = build_train_fn(
+        world_model, actor, critic, world_tx, actor_tx, critic_tx,
+        cfg, fabric, actions_dim, False,
+    )
+
+    T, B = int(cfg.per_rank_sequence_length), int(cfg.per_rank_batch_size)
+    rng = np.random.default_rng(0)
+    data = {
+        "rgb": rng.integers(0, 255, size=(T, B, 3, 64, 64)).astype(np.float32),
+        "actions": np.eye(9, dtype=np.float32)[rng.integers(0, 9, (T, B))],
+        "rewards": rng.normal(size=(T, B, 1)).astype(np.float32),
+        "dones": np.zeros((T, B, 1), np.float32),
+        "is_first": np.zeros((T, B, 1), np.float32),
+    }
+    batch = jax.device_put(
+        {k: jnp.asarray(v) for k, v in data.items()},
+        fabric.sharding(None, fabric.data_axis),
+    )
+
+    # compile + warmup; keys/tau prepared outside the timed loop
+    tau_first, tau = jnp.float32(1.0), jnp.float32(0.02)
+    n = 20
+    keys = [jax.random.PRNGKey(i) for i in range(n + 1)]
+    agent_state, metrics = train_fn(agent_state, batch, keys[n], tau_first)
+    float(np.asarray(metrics["Loss/world_model_loss"]))
+
+    start = time.perf_counter()
+    for i in range(n):
+        agent_state, metrics = train_fn(agent_state, batch, keys[i], tau)
+    float(np.asarray(metrics["Loss/world_model_loss"]))  # block
+    steps_per_sec = n / (time.perf_counter() - start)
+
+    print(
+        json.dumps(
+            {
+                "metric": "dreamer_v3_100k_grad_steps_per_sec",
+                "value": round(steps_per_sec, 2),
+                "unit": "steps/s",
+                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

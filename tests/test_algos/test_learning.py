@@ -1,0 +1,55 @@
+"""Learning-curve smoke test (a gap SURVEY §4 notes in the reference's own
+suite): PPO must actually *solve* CartPole, not just run. Guards against
+silent learning-breaking regressions (wrong advantage sign, broken GAE,
+mis-threaded PRNG keys, stale mirrored params) that every dry-run test would
+miss. ~17 s on the CI CPU."""
+
+import contextlib
+import io
+
+import numpy as np
+
+from sheeprl_tpu import cli
+
+
+def test_ppo_learns_cartpole(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli.run(
+            [
+                "exp=ppo",
+                "env=gym",
+                "env.id=CartPole-v1",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "total_steps=40960",
+                "algo.rollout_steps=64",
+                "per_rank_batch_size=64",
+                "env.num_envs=8",
+                "fabric.devices=1",
+                "fabric.accelerator=cpu",
+                "metric.log_level=1",
+                "metric.log_every=100000",
+                "buffer.memmap=False",
+                "checkpoint.save_last=False",
+                "checkpoint.every=100000000",
+                "algo.anneal_lr=True",
+                "algo.run_test=False",
+                "seed=3",
+                f"root_dir={tmp_path}/logs",
+                "run_name=learning_smoke",
+            ]
+        )
+    rewards = [
+        float(line.rsplit("=", 1)[-1])
+        for line in buf.getvalue().splitlines()
+        if "reward_env" in line
+    ]
+    assert len(rewards) > 50, "too few finished episodes to judge learning"
+    early = float(np.mean(rewards[:10]))
+    late = float(np.mean(rewards[-10:]))
+    # seed 3 reaches ~500 (solved); 150 leaves generous slack above the
+    # ~10-20 random-policy episodes while still requiring real learning
+    assert late > 150, f"PPO failed to learn CartPole: early={early:.1f}, late={late:.1f}"
+    assert late > 3 * early, f"no improvement: early={early:.1f}, late={late:.1f}"
