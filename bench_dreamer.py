@@ -19,6 +19,8 @@ BASELINE_STEPS_PER_SEC = 100000 / (14 * 3600)  # reference 100K wall-clock
 
 
 def main() -> None:
+    import sys
+
     import gymnasium as gym
     import jax
     import jax.numpy as jnp
@@ -47,9 +49,14 @@ def main() -> None:
             "metric.log_level=0",
             "buffer.checkpoint=False",
             "checkpoint.every=1000000",
+            *sys.argv[1:],  # e.g. fabric.precision=bf16-mixed
         ],
     )
-    fabric = Fabric(devices=1, accelerator="auto")
+    fabric = Fabric(
+        devices=cfg.fabric.get("devices", 1),
+        accelerator=cfg.fabric.get("accelerator", "auto"),
+        precision=cfg.fabric.get("precision", "32-true"),
+    )
     obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
     actions_dim = (9,)  # MsPacman
     world_model, actor, critic, params = build_agent(
@@ -65,7 +72,7 @@ def main() -> None:
     T, B = int(cfg.per_rank_sequence_length), int(cfg.per_rank_batch_size)
     rng = np.random.default_rng(0)
     data = {
-        "rgb": rng.integers(0, 255, size=(T, B, 3, 64, 64)).astype(np.float32),
+        "rgb": rng.integers(0, 256, size=(T, B, 3, 64, 64)).astype(np.float32),
         "actions": np.eye(9, dtype=np.float32)[rng.integers(0, 9, (T, B))],
         "rewards": rng.normal(size=(T, B, 1)).astype(np.float32),
         "dones": np.zeros((T, B, 1), np.float32),
@@ -93,6 +100,7 @@ def main() -> None:
         json.dumps(
             {
                 "metric": "dreamer_v3_100k_grad_steps_per_sec",
+                "precision": str(cfg.fabric.get("precision", "32-true")),
                 "value": round(steps_per_sec, 2),
                 "unit": "steps/s",
                 "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 2),

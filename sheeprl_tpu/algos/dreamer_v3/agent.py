@@ -62,6 +62,7 @@ class CNNEncoder(nn.Module):
     stages: int = 4
     layer_norm: bool = True
     activation: Any = "silu"
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, obs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
@@ -76,6 +77,7 @@ class CNNEncoder(nn.Module):
             norm_eps=1e-3,
             bias=not self.layer_norm,
             flatten=True,
+            dtype=self.dtype,
         )(x)
         return x
 
@@ -90,6 +92,7 @@ class MLPEncoder(nn.Module):
     layer_norm: bool = True
     activation: Any = "silu"
     symlog_inputs: bool = True
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, obs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
@@ -101,6 +104,7 @@ class MLPEncoder(nn.Module):
             norm_eps=1e-3,
             bias=not self.layer_norm,
             symlog_inputs=self.symlog_inputs,
+            dtype=self.dtype,
         )(x)
 
 
@@ -117,6 +121,7 @@ class MultiEncoderDV3(nn.Module):
     layer_norm: bool = True
     cnn_act: Any = "silu"
     dense_act: Any = "silu"
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, obs: Dict[str, jnp.ndarray]) -> jnp.ndarray:
@@ -129,6 +134,7 @@ class MultiEncoderDV3(nn.Module):
                     stages=self.stages,
                     layer_norm=self.layer_norm,
                     activation=self.cnn_act,
+                    dtype=self.dtype,
                     name="cnn_encoder",
                 )(obs)
             )
@@ -140,6 +146,7 @@ class MultiEncoderDV3(nn.Module):
                     dense_units=self.dense_units,
                     layer_norm=self.layer_norm,
                     activation=self.dense_act,
+                    dtype=self.dtype,
                     name="mlp_encoder",
                 )(obs)
             )
@@ -158,13 +165,14 @@ class CNNDecoder(nn.Module):
     image_size: Tuple[int, int]
     layer_norm: bool = True
     activation: Any = "silu"
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, latent: jnp.ndarray) -> jnp.ndarray:
         total_c = sum(self.output_channels)
         top_c = (2 ** (self.stages - 1)) * self.channels_multiplier
         base = self.image_size[0] // (2**self.stages)
-        x = nn.Dense(top_c * base * base)(latent)
+        x = nn.Dense(top_c * base * base, dtype=self.dtype)(latent)
         lead = x.shape[:-1]
         x = jnp.reshape(x, lead + (top_c, base, base))
         hidden = [
@@ -181,6 +189,7 @@ class CNNDecoder(nn.Module):
                 layer_norm=self.layer_norm,
                 norm_eps=1e-3,
                 bias=not self.layer_norm,
+                dtype=self.dtype,
             )(x)
         x = DeCNN(
             channels=[total_c],
@@ -190,9 +199,11 @@ class CNNDecoder(nn.Module):
             activation="identity",
             layer_norm=False,
             bias=True,
+            dtype=self.dtype,
             name="head",
         )(x)
-        return x + 0.5
+        # losses/distributions run in f32 regardless of the compute dtype
+        return x.astype(jnp.float32) + 0.5
 
 
 class MLPDecoder(nn.Module):
@@ -205,6 +216,7 @@ class MLPDecoder(nn.Module):
     dense_units: int = 512
     layer_norm: bool = True
     activation: Any = "silu"
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, latent: jnp.ndarray) -> Dict[str, jnp.ndarray]:
@@ -214,9 +226,10 @@ class MLPDecoder(nn.Module):
             layer_norm=self.layer_norm,
             norm_eps=1e-3,
             bias=not self.layer_norm,
+            dtype=self.dtype,
         )(latent)
         return {
-            k: nn.Dense(dim, name=f"head_{k}")(x)
+            k: nn.Dense(dim, dtype=self.dtype, name=f"head_{k}")(x).astype(jnp.float32)
             for k, dim in zip(self.keys, self.output_dims)
         }
 
@@ -233,6 +246,7 @@ class RecurrentModel(nn.Module):
     dense_units: int
     layer_norm: bool = True
     activation: Any = "silu"
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
@@ -242,10 +256,12 @@ class RecurrentModel(nn.Module):
             layer_norm=self.layer_norm,
             norm_eps=1e-3,
             bias=not self.layer_norm,
+            dtype=self.dtype,
         )(x)
+        # the carried state stays f32 (the cell's gate mix promotes back)
         return LayerNormGRUCell(
-            self.recurrent_state_size, bias=False, layer_norm=True, name="gru"
-        )(feat, h)
+            self.recurrent_state_size, bias=False, layer_norm=True, dtype=self.dtype, name="gru"
+        )(feat, h).astype(jnp.float32)
 
 
 class _StochasticModel(nn.Module):
@@ -256,6 +272,7 @@ class _StochasticModel(nn.Module):
     stoch_size: int  # stochastic_size * discrete_size
     layer_norm: bool = True
     activation: Any = "silu"
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -265,8 +282,11 @@ class _StochasticModel(nn.Module):
             layer_norm=self.layer_norm,
             norm_eps=1e-3,
             bias=not self.layer_norm,
+            dtype=self.dtype,
         )(x)
-        return nn.Dense(self.stoch_size, name="head")(x)
+        # categorical logits in f32: the unimix/log-softmax/KL math is
+        # precision-sensitive
+        return nn.Dense(self.stoch_size, dtype=self.dtype, name="head")(x).astype(jnp.float32)
 
 
 def uniform_mix(logits: jnp.ndarray, discrete: int, unimix: float) -> jnp.ndarray:
@@ -311,6 +331,7 @@ class RSSM(nn.Module):
     layer_norm: bool = True
     unimix: float = 0.01
     activation: Any = "silu"
+    dtype: Optional[Any] = None
 
     def setup(self):
         self.recurrent_model = RecurrentModel(
@@ -318,6 +339,7 @@ class RSSM(nn.Module):
             dense_units=self.dense_units,
             layer_norm=self.layer_norm,
             activation=self.activation,
+            dtype=self.dtype,
         )
         stoch = self.stochastic_size * self.discrete_size
         self.representation_model = _StochasticModel(
@@ -325,12 +347,14 @@ class RSSM(nn.Module):
             stoch_size=stoch,
             layer_norm=self.layer_norm,
             activation=self.activation,
+            dtype=self.dtype,
         )
         self.transition_model = _StochasticModel(
             hidden_size=self.hidden_size,
             stoch_size=stoch,
             layer_norm=self.layer_norm,
             activation=self.activation,
+            dtype=self.dtype,
         )
 
     def _transition(
@@ -407,6 +431,7 @@ class MLPWithHead(nn.Module):
     dense_units: int
     layer_norm: bool = True
     activation: Any = "silu"
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -416,8 +441,9 @@ class MLPWithHead(nn.Module):
             layer_norm=self.layer_norm,
             norm_eps=1e-3,
             bias=not self.layer_norm,
+            dtype=self.dtype,
         )(x)
-        return nn.Dense(self.output_dim, name="head")(x)
+        return nn.Dense(self.output_dim, dtype=self.dtype, name="head")(x).astype(jnp.float32)
 
 
 class WorldModel(nn.Module):
@@ -452,6 +478,7 @@ class WorldModel(nn.Module):
     unimix: float = 0.01
     cnn_act: Any = "silu"
     dense_act: Any = "silu"
+    dtype: Optional[Any] = None
 
     def setup(self):
         self.encoder = MultiEncoderDV3(
@@ -464,6 +491,7 @@ class WorldModel(nn.Module):
             layer_norm=self.layer_norm,
             cnn_act=self.cnn_act,
             dense_act=self.dense_act,
+            dtype=self.dtype,
         )
         self.rssm = RSSM(
             recurrent_state_size=self.recurrent_state_size,
@@ -475,6 +503,7 @@ class WorldModel(nn.Module):
             layer_norm=self.layer_norm,
             unimix=self.unimix,
             activation=self.dense_act,
+            dtype=self.dtype,
         )
         if self.cnn_keys:
             self.cnn_decoder = CNNDecoder(
@@ -484,6 +513,7 @@ class WorldModel(nn.Module):
                 image_size=self.image_size,
                 layer_norm=self.layer_norm,
                 activation=self.cnn_act,
+                dtype=self.dtype,
             )
         if self.mlp_keys:
             self.mlp_decoder = MLPDecoder(
@@ -493,6 +523,7 @@ class WorldModel(nn.Module):
                 dense_units=self.dense_units,
                 layer_norm=self.layer_norm,
                 activation=self.dense_act,
+                dtype=self.dtype,
             )
         self.reward_model = MLPWithHead(
             output_dim=self.reward_bins,
@@ -500,6 +531,7 @@ class WorldModel(nn.Module):
             dense_units=self.reward_dense_units or self.dense_units,
             layer_norm=self.layer_norm,
             activation=self.dense_act,
+            dtype=self.dtype,
         )
         self.continue_model = MLPWithHead(
             output_dim=1,
@@ -507,6 +539,7 @@ class WorldModel(nn.Module):
             dense_units=self.continue_dense_units or self.dense_units,
             layer_norm=self.layer_norm,
             activation=self.dense_act,
+            dtype=self.dtype,
         )
 
     # -- methods for apply(..., method=...) --------------------------------
@@ -592,6 +625,7 @@ class Actor(nn.Module):
     mlp_layers: int = 5
     layer_norm: bool = True
     activation: Any = "silu"
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, state: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
@@ -601,11 +635,16 @@ class Actor(nn.Module):
             layer_norm=self.layer_norm,
             norm_eps=1e-3,
             bias=not self.layer_norm,
+            dtype=self.dtype,
         )(state)
         if self.is_continuous:
-            return (nn.Dense(int(np.sum(self.actions_dim)) * 2, name="head_0")(x),)
+            return (
+                nn.Dense(int(np.sum(self.actions_dim)) * 2, dtype=self.dtype, name="head_0")(x)
+                .astype(jnp.float32),
+            )
         return tuple(
-            nn.Dense(dim, name=f"head_{i}")(x) for i, dim in enumerate(self.actions_dim)
+            nn.Dense(dim, dtype=self.dtype, name=f"head_{i}")(x).astype(jnp.float32)
+            for i, dim in enumerate(self.actions_dim)
         )
 
 
@@ -808,6 +847,11 @@ def build_agent(
     mlp_keys = list(cfg.mlp_keys.encoder)
     screen = int(cfg.env.screen_size)
     stages = int(np.log2(screen)) - 2
+    # fabric.precision=bf16-mixed: bf16 compute with f32 params and f32
+    # losses/logits (heads cast back); 32-true keeps everything f32
+    from sheeprl_tpu.fabric import compute_dtype_from_precision
+
+    compute_dtype = compute_dtype_from_precision(cfg.fabric.get("precision", "32-true"))
     cnn_channels = [
         int(np.prod(observation_space[k].shape[:-2])) for k in cnn_keys
     ]
@@ -838,6 +882,7 @@ def build_agent(
         unimix=float(cfg.algo.unimix),
         cnn_act=cfg.algo.cnn_act,
         dense_act=cfg.algo.dense_act,
+        dtype=compute_dtype,
     )
     latent_size = (
         int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
@@ -853,6 +898,7 @@ def build_agent(
         mlp_layers=int(cfg.algo.actor.mlp_layers),
         layer_norm=bool(cfg.algo.actor.layer_norm),
         activation=cfg.algo.actor.dense_act,
+        dtype=compute_dtype,
     )
     critic = MLPWithHead(
         output_dim=int(cfg.algo.critic.bins),
@@ -860,6 +906,7 @@ def build_agent(
         dense_units=int(cfg.algo.critic.dense_units),
         layer_norm=bool(cfg.algo.critic.layer_norm),
         activation=cfg.algo.critic.dense_act,
+        dtype=compute_dtype,
     )
 
     k_wm, k_actor, k_critic, k_hw, k_ha, k_hc, k_s = jax.random.split(key, 7)

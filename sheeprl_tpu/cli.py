@@ -102,6 +102,22 @@ def check_configs(cfg) -> None:
             UserWarning,
         )
 
+    # mixed precision is validated for everyone but currently consumed only by
+    # the DreamerV3 model family — warn instead of silently training in f32
+    from sheeprl_tpu.fabric import compute_dtype_from_precision
+
+    precision = cfg.fabric.get("precision", "32-true")
+    if compute_dtype_from_precision(precision) is not None and algo_name not in (
+        "dreamer_v3",
+        "p2e_dv3_exploration",
+        "p2e_dv3_finetuning",
+    ):
+        warnings.warn(
+            f"fabric.precision={precision} is only consumed by the DreamerV3 model "
+            f"family; '{algo_name}' will train in f32",
+            UserWarning,
+        )
+
 
 def _prune_metric_keys(cfg, algo_module: str) -> None:
     """Drop aggregator keys the algorithm never updates (reference cli.py:141-155)."""

@@ -60,6 +60,21 @@ def _select_devices(devices: Any, accelerator: str) -> List[jax.Device]:
     return list(all_devices[:n])
 
 
+def compute_dtype_from_precision(precision: Any):
+    """The one precision→compute-dtype mapping (shared by Fabric and the
+    model builders): "32-true" → None (f32 everywhere), "bf16-mixed" → bf16
+    compute with f32 params/losses. Anything else raises — silently
+    reinterpreting fp16/true-bf16 requests would mislead."""
+    p = str(precision or "32-true").lower()
+    if p in ("32-true", "32"):
+        return None
+    if p == "bf16-mixed":
+        return jnp.bfloat16
+    raise ValueError(
+        f"Unsupported fabric.precision {precision!r}: use '32-true' or 'bf16-mixed'"
+    )
+
+
 class Fabric:
     """Mesh-owning runtime handed to every algorithm entrypoint as ``fabric``."""
 
@@ -129,13 +144,13 @@ class Fabric:
 
     @property
     def compute_dtype(self):
-        """bf16 under mixed precision — params stay f32, activations bf16
-        (the TPU-native analog of fabric's "bf16-mixed")."""
-        return jnp.bfloat16 if "bf16" in self.precision or "16" in self.precision else jnp.float32
+        """None for f32, bf16 under mixed precision — params stay f32,
+        activations bf16 (the TPU-native analog of fabric's "bf16-mixed")."""
+        return compute_dtype_from_precision(self.precision)
 
     @property
     def param_dtype(self):
-        return jnp.bfloat16 if self.precision == "bf16-true" else jnp.float32
+        return jnp.float32
 
     # ------------------------------------------------------------------
     # shardings

@@ -106,6 +106,7 @@ class MLP(nn.Module):
     symlog_inputs: bool = False
     bias: Union[bool, Sequence[bool]] = True
     param_dtype: Any = jnp.float32
+    dtype: Optional[Any] = None  # compute dtype (bf16-mixed); params stay param_dtype
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -118,14 +119,18 @@ class MLP(nn.Module):
         biases = _broadcast(self.bias, n)
         act = resolve_activation(self.activation)
         for i, size in enumerate(self.hidden_sizes):
-            x = nn.Dense(size, use_bias=biases[i], param_dtype=self.param_dtype)(x)
+            x = nn.Dense(
+                size, use_bias=biases[i], param_dtype=self.param_dtype, dtype=self.dtype
+            )(x)
             if norms[i]:
-                x = nn.LayerNorm(epsilon=self.norm_eps, param_dtype=self.param_dtype)(x)
+                x = nn.LayerNorm(
+                    epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype
+                )(x)
             x = act(x)
             if self.dropout > 0.0:
                 x = nn.Dropout(self.dropout, deterministic=deterministic)(x)
         if self.output_dim is not None:
-            x = nn.Dense(self.output_dim, param_dtype=self.param_dtype)(x)
+            x = nn.Dense(self.output_dim, param_dtype=self.param_dtype, dtype=self.dtype)(x)
         return x
 
 
@@ -164,6 +169,7 @@ class CNN(nn.Module):
     bias: Union[bool, Sequence[bool]] = True
     flatten: bool = False
     param_dtype: Any = jnp.float32
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -184,11 +190,14 @@ class CNN(nn.Module):
                 padding=pad,
                 use_bias=biases[i],
                 param_dtype=self.param_dtype,
+                dtype=self.dtype,
             )(x)
             if norms[i]:
                 # LayerNorm over the channel axis — NHWC makes the reference's
                 # LayerNormChannelLast permute dance (utils/model.py:225-235) free
-                x = nn.LayerNorm(epsilon=self.norm_eps, param_dtype=self.param_dtype)(x)
+                x = nn.LayerNorm(
+                    epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype
+                )(x)
             x = act(x)
         if self.flatten:
             x = jnp.reshape(x, (x.shape[0], -1))
@@ -209,6 +218,7 @@ class DeCNN(nn.Module):
     bias: Union[bool, Sequence[bool]] = True
     final_activation: Union[str, Callable, None] = None
     param_dtype: Any = jnp.float32
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -237,9 +247,12 @@ class DeCNN(nn.Module):
                 use_bias=biases[i],
                 transpose_kernel=True,
                 param_dtype=self.param_dtype,
+                dtype=self.dtype,
             )(x)
             if norms[i]:
-                x = nn.LayerNorm(epsilon=self.norm_eps, param_dtype=self.param_dtype)(x)
+                x = nn.LayerNorm(
+                    epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype
+                )(x)
             if i < n - 1:
                 x = act(x)
             elif self.final_activation is not None:
@@ -289,13 +302,16 @@ class LayerNormGRUCell(nn.Module):
     layer_norm: bool = False
     norm_eps: float = 1e-3
     param_dtype: Any = jnp.float32
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
         inp = jnp.concatenate([h, x], axis=-1)
-        z = nn.Dense(3 * self.hidden_size, use_bias=self.bias, param_dtype=self.param_dtype)(inp)
+        z = nn.Dense(
+            3 * self.hidden_size, use_bias=self.bias, param_dtype=self.param_dtype, dtype=self.dtype
+        )(inp)
         if self.layer_norm:
-            z = nn.LayerNorm(epsilon=self.norm_eps, param_dtype=self.param_dtype)(z)
+            z = nn.LayerNorm(epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype)(z)
         reset, cand, update = jnp.split(z, 3, axis=-1)
         reset = jax.nn.sigmoid(reset)
         cand = jnp.tanh(reset * cand)

@@ -53,6 +53,56 @@ def test_dreamer_v3(tmp_path, devices, env_id, monkeypatch):
     cli.run(dv3_args(tmp_path, [f"fabric.devices={devices}", f"env.id={env_id}"]))
 
 
+def test_dreamer_v3_bf16_mixed(tmp_path, monkeypatch):
+    """fabric.precision=bf16-mixed trains end-to-end: bf16 compute, f32
+    params/losses (heads cast back), finite losses."""
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        dv3_args(
+            tmp_path,
+            ["fabric.devices=1", "env.id=discrete_dummy", "fabric.precision=bf16-mixed"],
+        )
+    )
+
+
+def test_bf16_param_dtype_stays_f32():
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.config.engine import compose
+
+    cfg = compose(
+        "config",
+        overrides=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            "metric.log_level=0",
+            "fabric.precision=bf16-mixed",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.stochastic_size=4",
+            "algo.world_model.discrete_size=4",
+            "cnn_keys.encoder=[rgb]",
+        ],
+    )
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    world_model, actor, critic, params = build_agent(
+        cfg, (4,), False, obs_space, jax.random.PRNGKey(0)
+    )
+    # mixed precision: master params stay f32
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert leaf.dtype == jnp.float32
+    # heads still emit f32 logits for the loss math
+    out = actor.apply({"params": params["actor"]}, jnp.zeros((1, 4 * 4 + 8)))
+    assert all(o.dtype == jnp.float32 for o in out)
+
+
 def test_dreamer_v3_temporal_train(tmp_path, monkeypatch):
     """Non-dry run so the dynamic-learning scan sees T>1 sequences with real
     action conditioning (the dry run trains on T=1 reset-only steps)."""

@@ -44,9 +44,14 @@ def test_fabric_shard_data_places_on_mesh():
 
 
 def test_fabric_precision_dtypes():
-    assert Fabric(devices=1, accelerator="cpu").compute_dtype == jnp.float32
+    import pytest
+
+    # None == "compute in the params' dtype" (f32)
+    assert Fabric(devices=1, accelerator="cpu").compute_dtype is None
     assert Fabric(devices=1, accelerator="cpu", precision="bf16-mixed").compute_dtype == jnp.bfloat16
     assert Fabric(devices=1, accelerator="cpu", precision="bf16-mixed").param_dtype == jnp.float32
+    with pytest.raises(ValueError):
+        Fabric(devices=1, accelerator="cpu", precision="16-mixed").compute_dtype
 
 
 def test_fabric_save_load_roundtrip(tmp_path):
