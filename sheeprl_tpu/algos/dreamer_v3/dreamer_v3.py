@@ -547,8 +547,13 @@ def main(fabric, cfg: Dict[str, Any]):
     # the player acts on the CPU host with mirrored world-model/actor
     # snapshots, refreshed once per training burst (utils/host.py)
     mirror_on = HostParamMirror.enabled_for(fabric, cfg)
-    wm_mirror = HostParamMirror(agent_state["params"]["world_model"], enabled=mirror_on)
-    actor_mirror = HostParamMirror(agent_state["params"]["actor"], enabled=mirror_on)
+    refresh = cfg.algo.get("player_on_host_refresh_every", 1)
+    wm_mirror = HostParamMirror(
+        agent_state["params"]["world_model"], enabled=mirror_on, refresh_every=refresh
+    )
+    actor_mirror = HostParamMirror(
+        agent_state["params"]["actor"], enabled=mirror_on, refresh_every=refresh
+    )
     play_wm = wm_mirror(agent_state["params"]["world_model"])
     play_actor = actor_mirror(agent_state["params"]["actor"])
 
@@ -762,11 +767,12 @@ def main(fabric, cfg: Dict[str, Any]):
                         tau = 1.0 if per_rank_gradient_steps == 0 else cfg.algo.critic.tau
                     else:
                         tau = 0.0
-                    batch = {
-                        k: jnp.asarray(v[i], jnp.float32)
-                        for k, v in local_data.items()
-                    }
-                    batch = jax.device_put(batch, data_sharding)
+                    # ship native dtypes (uint8 pixels = 4x less than f32
+                    # over the host->HBM link) straight to the sharding; the
+                    # train step normalizes on device
+                    batch = jax.device_put(
+                        {k: v[i] for k, v in local_data.items()}, data_sharding
+                    )
                     root_key, train_key = jax.random.split(root_key)
                     agent_state, metrics = train_fn(
                         agent_state, batch, train_key, jnp.float32(tau)

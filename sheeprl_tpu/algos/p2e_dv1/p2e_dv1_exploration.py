@@ -442,9 +442,16 @@ def main(fabric, cfg: Dict[str, Any]):
 
     # host-mirrored acting snapshots (utils/host.py)
     mirror_on = HostParamMirror.enabled_for(fabric, cfg)
-    wm_mirror = HostParamMirror(agent_state["params"]["world_model"], enabled=mirror_on)
-    actor_expl_mirror = HostParamMirror(agent_state["params"]["actor_exploration"], enabled=mirror_on)
-    actor_task_mirror = HostParamMirror(agent_state["params"]["actor_task"], enabled=mirror_on)
+    refresh = cfg.algo.get("player_on_host_refresh_every", 1)
+    wm_mirror = HostParamMirror(
+        agent_state["params"]["world_model"], enabled=mirror_on, refresh_every=refresh
+    )
+    actor_expl_mirror = HostParamMirror(
+        agent_state["params"]["actor_exploration"], enabled=mirror_on, refresh_every=refresh
+    )
+    actor_task_mirror = HostParamMirror(
+        agent_state["params"]["actor_task"], enabled=mirror_on, refresh_every=refresh
+    )
     play_wm = wm_mirror(agent_state["params"]["world_model"])
     play_actor_expl = actor_expl_mirror(agent_state["params"]["actor_exploration"])
     play_actor_task = actor_task_mirror(agent_state["params"]["actor_task"])
@@ -619,8 +626,12 @@ def main(fabric, cfg: Dict[str, Any]):
             with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
                 metrics = None
                 for i in range(n_samples):
-                    batch = {k: jnp.asarray(v[i], jnp.float32) for k, v in local_data.items()}
-                    batch = jax.device_put(batch, data_sharding)
+                    # ship native dtypes (uint8 pixels = 4x less than f32
+                    # over the host->HBM link) straight to the sharding; the
+                    # train step normalizes on device
+                    batch = jax.device_put(
+                        {k: v[i] for k, v in local_data.items()}, data_sharding
+                    )
                     root_key, train_key = jax.random.split(root_key)
                     agent_state, metrics = train_fn(agent_state, batch, train_key)
                     per_rank_gradient_steps += 1

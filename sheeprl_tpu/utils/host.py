@@ -33,8 +33,14 @@ class HostParamMirror:
         runs on an accelerator."""
         return bool(cfg.algo.get("player_on_host", True)) and fabric.on_accelerator
 
-    def __init__(self, example_tree: Any, enabled: bool = True):
+    def __init__(self, example_tree: Any, enabled: bool = True, refresh_every: int = 1):
         self.enabled = bool(enabled)
+        # refreshing costs one full-model transfer; a cadence > 1 lets the
+        # player act on a snapshot stale by up to refresh_every-1 updates
+        # (algo.player_on_host_refresh_every)
+        self.refresh_every = max(int(refresh_every or 1), 1)
+        self._calls = 0
+        self._cache: Any = None
         if self.enabled:
             from jax.flatten_util import ravel_pytree
 
@@ -45,8 +51,11 @@ class HostParamMirror:
     def __call__(self, tree: Any) -> Any:
         if not self.enabled:
             return tree
-        flat = np.asarray(self._pack(tree))
-        return jax.device_put(self._unravel(flat), self._host)
+        if self._cache is None or self._calls % self.refresh_every == 0:
+            flat = np.asarray(self._pack(tree))
+            self._cache = jax.device_put(self._unravel(flat), self._host)
+        self._calls += 1
+        return self._cache
 
     def put_key(self, key: jax.Array) -> jax.Array:
         """Commit a PRNG key next to the mirrored params."""

@@ -69,3 +69,19 @@ def test_enabled_for_rule():
     FakeFabric.on_accelerator = False
     FakeCfg.algo = {}
     assert not HostParamMirror.enabled_for(FakeFabric(), FakeCfg())
+
+
+def test_refresh_every_caches_between_refreshes():
+    tree = _tree()
+    mirror = HostParamMirror(tree, enabled=True, refresh_every=3)
+    first = mirror(tree)
+    updated = jax.tree_util.tree_map(lambda x: x + 1.0, tree)
+    # calls 2 and 3 return the cached (stale) snapshot
+    assert mirror(updated) is first
+    assert mirror(updated) is first
+    # call 4 starts a new cadence window → fresh values
+    out = mirror(updated)
+    assert out is not first
+    np.testing.assert_array_equal(
+        np.asarray(out["scale"]), np.asarray(updated["scale"])
+    )
