@@ -188,10 +188,7 @@ def main(fabric, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
-    to_host = HostParamMirror(
-        params,
-        enabled=HostParamMirror.enabled_for(fabric, cfg),
-    )
+    to_host = HostParamMirror.from_cfg(params, fabric, cfg)
 
     rollout_steps = int(cfg.algo.rollout_steps)
     rb = ReplayBuffer(

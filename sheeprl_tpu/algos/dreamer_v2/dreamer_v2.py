@@ -478,14 +478,8 @@ def main(fabric, cfg: Dict[str, Any]):
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
 
     # the player acts on the CPU host with mirrored snapshots (utils/host.py)
-    mirror_on = HostParamMirror.enabled_for(fabric, cfg)
-    refresh = cfg.algo.get("player_on_host_refresh_every", 1)
-    wm_mirror = HostParamMirror(
-        agent_state["params"]["world_model"], enabled=mirror_on, refresh_every=refresh
-    )
-    actor_mirror = HostParamMirror(
-        agent_state["params"]["actor"], enabled=mirror_on, refresh_every=refresh
-    )
+    wm_mirror = HostParamMirror.from_cfg(agent_state["params"]["world_model"], fabric, cfg)
+    actor_mirror = HostParamMirror.from_cfg(agent_state["params"]["actor"], fabric, cfg)
     play_wm = wm_mirror(agent_state["params"]["world_model"])
     play_actor = actor_mirror(agent_state["params"]["actor"])
 

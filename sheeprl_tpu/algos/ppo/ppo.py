@@ -290,10 +290,7 @@ def main(fabric, cfg: Dict[str, Any]):
     # program per env step: env interaction is latency-bound, and over a
     # remote-attached TPU every dispatch is a network round trip
     # (SURVEY §5.8 — players pinned to CPU hosts feeding the trainer mesh).
-    to_host = HostParamMirror(
-        params,
-        enabled=HostParamMirror.enabled_for(fabric, cfg),
-    )
+    to_host = HostParamMirror.from_cfg(params, fabric, cfg)
 
     @jax.jit
     def policy_step_fn(params, obs, key):

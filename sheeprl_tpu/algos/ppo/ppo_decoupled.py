@@ -182,10 +182,7 @@ def main(fabric, cfg: Dict[str, Any]):
     # player reads whichever is current (jax arrays are immutable, so a torn
     # read is impossible); the snapshot lives on the CPU host so the player's
     # per-step policy dispatch never leaves the host (utils/host.py)
-    to_host = HostParamMirror(
-        params,
-        enabled=HostParamMirror.enabled_for(fabric, cfg),
-    )
+    to_host = HostParamMirror.from_cfg(params, fabric, cfg)
     param_cell = {"params": to_host(params)}
     stop = threading.Event()
     player_error: Dict[str, BaseException] = {}

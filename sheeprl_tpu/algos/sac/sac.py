@@ -280,10 +280,7 @@ def main(fabric, cfg: Dict[str, Any]):
         actions, _ = squash_sample(mean, std, sub, scale_j, bias_j)
         return actions, key
 
-    actor_mirror = HostParamMirror(
-        agent_state["actor"],
-        enabled=HostParamMirror.enabled_for(fabric, cfg),
-    )
+    actor_mirror = HostParamMirror.from_cfg(agent_state["actor"], fabric, cfg)
     play_actor = actor_mirror(agent_state["actor"])
 
     train_fn = build_train_fn(

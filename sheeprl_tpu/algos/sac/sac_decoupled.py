@@ -184,10 +184,7 @@ def main(fabric, cfg: Dict[str, Any]):
     # collected/trained counters bound the player's lead to one step (the
     # reference player blocks on the per-step param exchange, :291-294)
     progress = {"collected": start_step - 1, "trained": start_step - 1}
-    actor_mirror = HostParamMirror(
-        agent_state["actor"],
-        enabled=HostParamMirror.enabled_for(fabric, cfg),
-    )
+    actor_mirror = HostParamMirror.from_cfg(agent_state["actor"], fabric, cfg)
     param_cell = {"actor": actor_mirror(agent_state["actor"])}
     player_error: Dict[str, BaseException] = {}
     stop = threading.Event()

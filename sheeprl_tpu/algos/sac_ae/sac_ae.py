@@ -370,10 +370,7 @@ def main(fabric, cfg: Dict[str, Any]):
     def _acting_subtree(p):
         return {"encoder": p["encoder"], "actor": p["actor"]}
 
-    actor_mirror = HostParamMirror(
-        _acting_subtree(agent_state),
-        enabled=HostParamMirror.enabled_for(fabric, cfg),
-    )
+    actor_mirror = HostParamMirror.from_cfg(_acting_subtree(agent_state), fabric, cfg)
     play_params = actor_mirror(_acting_subtree(agent_state))
 
     train_fn = build_train_fn(

@@ -255,7 +255,7 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
         allow_missing=("checkpoint_path",),
     )
     ckpt_path = eval_cfg.get("checkpoint_path")
-    if not ckpt_path:
+    if not ckpt_path or ckpt_path == "???":
         raise ValueError("You must specify the checkpoint path: checkpoint_path=/path/to/ckpt")
     cfg, log_dir = _load_run_config(ckpt_path)
 

@@ -168,10 +168,18 @@ def make_env(
             env = gym.wrappers.TimeLimit(env, max_episode_steps=cfg.env.max_episode_steps)
         env = gym.wrappers.RecordEpisodeStatistics(env)
         if cfg.env.capture_video and rank == 0 and vector_env_idx == 0 and run_name is not None:
-            if cfg.env.grayscale:
-                env = GrayscaleRenderWrapper(env)
-            video_dir = os.path.join(run_name, prefix + "_videos" if prefix else "videos")
-            env = gym.wrappers.RecordVideo(env, video_dir, disable_logger=True)
+            import importlib.util
+
+            if importlib.util.find_spec("moviepy") is None:
+                warnings.warn(
+                    "env.capture_video=True but moviepy is not installed; "
+                    "skipping video capture (pip install moviepy)"
+                )
+            else:
+                if cfg.env.grayscale:
+                    env = GrayscaleRenderWrapper(env)
+                video_dir = os.path.join(run_name, prefix + "_videos" if prefix else "videos")
+                env = gym.wrappers.RecordVideo(env, video_dir, disable_logger=True)
         return env
 
     return thunk

@@ -33,6 +33,16 @@ class HostParamMirror:
         runs on an accelerator."""
         return bool(cfg.algo.get("player_on_host", True)) and fabric.on_accelerator
 
+    @classmethod
+    def from_cfg(cls, example_tree: Any, fabric, cfg) -> "HostParamMirror":
+        """The one construction rule: enable per :meth:`enabled_for`,
+        refresh cadence from ``algo.player_on_host_refresh_every``."""
+        return cls(
+            example_tree,
+            enabled=cls.enabled_for(fabric, cfg),
+            refresh_every=cfg.algo.get("player_on_host_refresh_every", 1),
+        )
+
     def __init__(self, example_tree: Any, enabled: bool = True, refresh_every: int = 1):
         self.enabled = bool(enabled)
         # refreshing costs one full-model transfer; a cadence > 1 lets the

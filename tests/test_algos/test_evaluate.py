@@ -1,0 +1,81 @@
+"""Evaluation-CLI tests (reference ``tests/test_algos/test_cli.py`` resume/
+eval flows): train → checkpoint → ``sheeprl-tpu-eval`` end-to-end."""
+
+import glob
+import os
+
+import pytest
+
+from sheeprl_tpu import cli
+
+
+def _train(tmp_path, extra):
+    cli.run(
+        [
+            "dry_run=True",
+            "env.sync_env=True",
+            "checkpoint.every=1000000",
+            "checkpoint.save_last=True",
+            "metric.log_every=1000000",
+            "metric.log_level=0",
+            "env.capture_video=False",
+            "buffer.memmap=False",
+            "env.num_envs=2",
+            "fabric.devices=1",
+            "fabric.accelerator=cpu",
+            f"root_dir={tmp_path}/logs",
+            "run_name=test",
+            *extra,
+        ]
+    )
+    ckpts = sorted(glob.glob(f"{tmp_path}/logs/**/checkpoint/ckpt_*", recursive=True))
+    assert ckpts, "no checkpoint written"
+    return os.path.abspath(ckpts[-1])
+
+
+def test_eval_cli_ppo(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    ckpt = _train(
+        tmp_path,
+        [
+            "exp=ppo",
+            "env=gym",
+            "env.id=CartPole-v1",
+            "algo.rollout_steps=4",
+            "per_rank_batch_size=4",
+            "algo.update_epochs=1",
+        ],
+    )
+    cli.evaluation([f"checkpoint_path={ckpt}", "fabric.accelerator=cpu"])
+
+
+def test_eval_cli_dreamer_v3(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    ckpt = _train(
+        tmp_path,
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "per_rank_batch_size=2",
+            "per_rank_sequence_length=1",
+            "algo.horizon=2",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.stochastic_size=4",
+            "algo.world_model.discrete_size=4",
+            "algo.learning_starts=0",
+            "cnn_keys.encoder=[rgb]",
+        ],
+    )
+    cli.evaluation([f"checkpoint_path={ckpt}", "fabric.accelerator=cpu"])
+
+
+def test_eval_cli_requires_checkpoint_path(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(ValueError):
+        cli.evaluation(["fabric.accelerator=cpu"])
