@@ -180,9 +180,9 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     actor_mirror = HostParamMirror.from_cfg(agent_state["params"]["actor"], fabric, cfg)
     play_wm = wm_mirror(agent_state["params"]["world_model"])
     play_actor = actor_mirror(agent_state["params"]["actor"])
-    play_actor_expl = HostParamMirror(
-        actor_expl_params, enabled=HostParamMirror.enabled_for(fabric, cfg)
-    )(actor_expl_params)
+    play_actor_expl = HostParamMirror.from_cfg(actor_expl_params, fabric, cfg)(
+        actor_expl_params
+    )
 
     player_actor_type = str(cfg.algo.player.actor_type)
 
