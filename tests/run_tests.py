@@ -1,0 +1,8 @@
+"""Test-suite runner shim (reference ``tests/run_tests.py:1-6``)."""
+
+import sys
+
+import pytest
+
+if __name__ == "__main__":
+    sys.exit(pytest.main(["-s", "--cov=sheeprl_tpu", "-vv", *sys.argv[1:]]))
