@@ -152,6 +152,25 @@ class MultiEncoderDV3(nn.Module):
             )
         return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
 
+    @staticmethod
+    def output_width(
+        cnn_keys: Sequence[str],
+        mlp_keys: Sequence[str],
+        image_size: Tuple[int, int],
+        channels_multiplier: int,
+        stages: int,
+        dense_units: int,
+    ) -> int:
+        """Static feature width: CNN flatten (k=4/s=2/p=1 halves each stage,
+        channels double from ``channels_multiplier``) + MLP ``dense_units``."""
+        width = 0
+        if cnn_keys:
+            h, w = image_size[0] >> stages, image_size[1] >> stages
+            width += h * w * channels_multiplier * 2 ** (stages - 1)
+        if mlp_keys:
+            width += dense_units
+        return width
+
 
 class CNNDecoder(nn.Module):
     """Pixel decoder (reference agent.py:138-211): Linear projection to the
@@ -289,6 +308,61 @@ class _StochasticModel(nn.Module):
         return nn.Dense(self.stoch_size, dtype=self.dtype, name="head")(x).astype(jnp.float32)
 
 
+class _RepresentationModel(nn.Module):
+    """Posterior trunk with the embed half of the first layer split out.
+
+    Mathematically identical to ``_StochasticModel`` over
+    ``concat([h, embed])`` — the joint first-layer kernel is stored as ONE
+    parameter (same init statistics as the reference's single Linear,
+    reference agent.py:406-424) and sliced at apply time — but exposes
+    ``project_embed`` so the train step can batch the embed projection over
+    the whole ``[T, B]`` sequence *outside* the sequential RSSM scan: the
+    embed width (e.g. 4096 from the CNN) dwarfs the recurrent width (512),
+    so this removes ~8/9 of the posterior-trunk FLOPs and weight streaming
+    from the latency-critical per-timestep path.
+    """
+
+    hidden_size: int
+    stoch_size: int  # stochastic_size * discrete_size
+    h_size: int
+    embed_size: int
+    layer_norm: bool = True
+    activation: Any = "silu"
+    dtype: Optional[Any] = None
+
+    def setup(self):
+        self.kernel = self.param(
+            "trunk_kernel",
+            nn.initializers.lecun_normal(),
+            (self.h_size + self.embed_size, self.hidden_size),
+        )
+        if self.layer_norm:
+            self.norm = nn.LayerNorm(epsilon=1e-3, dtype=self.dtype, name="trunk_ln")
+        else:
+            self.bias = self.param(
+                "trunk_bias", nn.initializers.zeros_init(), (self.hidden_size,)
+            )
+        self.head = nn.Dense(self.stoch_size, dtype=self.dtype, name="head")
+
+    def _cast(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x.astype(self.dtype) if self.dtype is not None else x
+
+    def project_embed(self, embed: jnp.ndarray) -> jnp.ndarray:
+        return self._cast(embed) @ self._cast(self.kernel[self.h_size :])
+
+    def from_projected(self, h: jnp.ndarray, embed_proj: jnp.ndarray) -> jnp.ndarray:
+        x = self._cast(h) @ self._cast(self.kernel[: self.h_size]) + self._cast(embed_proj)
+        if self.layer_norm:
+            x = self.norm(x)
+        else:
+            x = x + self._cast(self.bias)
+        x = resolve_activation(self.activation)(x)
+        return self.head(x).astype(jnp.float32)
+
+    def __call__(self, h: jnp.ndarray, embed: jnp.ndarray) -> jnp.ndarray:
+        return self.from_projected(h, self.project_embed(embed))
+
+
 def uniform_mix(logits: jnp.ndarray, discrete: int, unimix: float) -> jnp.ndarray:
     """1% uniform mixture on categorical logits (reference agent.py:392-404).
 
@@ -327,6 +401,7 @@ class RSSM(nn.Module):
     discrete_size: int
     dense_units: int
     hidden_size: int
+    embed_size: int
     representation_hidden_size: Optional[int] = None
     layer_norm: bool = True
     unimix: float = 0.01
@@ -342,9 +417,11 @@ class RSSM(nn.Module):
             dtype=self.dtype,
         )
         stoch = self.stochastic_size * self.discrete_size
-        self.representation_model = _StochasticModel(
+        self.representation_model = _RepresentationModel(
             hidden_size=self.representation_hidden_size or self.hidden_size,
             stoch_size=stoch,
+            h_size=self.recurrent_state_size,
+            embed_size=self.embed_size,
             layer_norm=self.layer_norm,
             activation=self.activation,
             dtype=self.dtype,
@@ -369,8 +446,20 @@ class RSSM(nn.Module):
         self, recurrent_state: jnp.ndarray, embedded_obs: jnp.ndarray, key: jax.Array
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Posterior logits + sampled posterior, flat (reference :406-424)."""
+        return self._representation_projected(
+            recurrent_state, self.project_embed(embedded_obs), key
+        )
+
+    def project_embed(self, embedded_obs: jnp.ndarray) -> jnp.ndarray:
+        """Batchable (non-sequential) half of the posterior trunk — hoist it
+        out of the time scan and feed ``dynamic_projected``."""
+        return self.representation_model.project_embed(embedded_obs)
+
+    def _representation_projected(
+        self, recurrent_state: jnp.ndarray, embed_proj: jnp.ndarray, key: jax.Array
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         logits = uniform_mix(
-            self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1)),
+            self.representation_model.from_projected(recurrent_state, embed_proj),
             self.discrete_size,
             self.unimix,
         )
@@ -391,6 +480,21 @@ class RSSM(nn.Module):
         All inputs are ``[B, ...]``; ``posterior`` flat ``[B, S*D]``. Returns
         ``(recurrent_state, posterior, posterior_logits, prior_logits)``.
         """
+        return self.dynamic_projected(
+            posterior, recurrent_state, action, self.project_embed(embedded_obs), is_first, key
+        )
+
+    def dynamic_projected(
+        self,
+        posterior: jnp.ndarray,
+        recurrent_state: jnp.ndarray,
+        action: jnp.ndarray,
+        embed_proj: jnp.ndarray,
+        is_first: jnp.ndarray,
+        key: jax.Array,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """``dynamic`` with the embed projection precomputed (the train scan
+        hoists ``project_embed`` over [T, B] outside the time loop)."""
         action = (1.0 - is_first) * action
         recurrent_state = (1.0 - is_first) * recurrent_state
         init_post = self._transition(recurrent_state, None, sample_state=False)[1]
@@ -400,7 +504,9 @@ class RSSM(nn.Module):
         )
         k1, k2 = jax.random.split(key)
         prior_logits, _ = self._transition(recurrent_state, k1)
-        posterior_logits, posterior = self._representation(recurrent_state, embedded_obs, k2)
+        posterior_logits, posterior = self._representation_projected(
+            recurrent_state, embed_proj, k2
+        )
         return recurrent_state, posterior, posterior_logits, prior_logits
 
     def imagination(
@@ -493,12 +599,22 @@ class WorldModel(nn.Module):
             dense_act=self.dense_act,
             dtype=self.dtype,
         )
+        # static encoder output width sizes the split posterior trunk kernel
+        embed_size = MultiEncoderDV3.output_width(
+            self.cnn_keys,
+            self.mlp_keys,
+            self.image_size,
+            self.channels_multiplier,
+            self.stages,
+            self.dense_units,
+        )
         self.rssm = RSSM(
             recurrent_state_size=self.recurrent_state_size,
             stochastic_size=self.stochastic_size,
             discrete_size=self.discrete_size,
             dense_units=self.dense_units,
             hidden_size=self.hidden_size,
+            embed_size=embed_size,
             representation_hidden_size=self.representation_hidden_size,
             layer_norm=self.layer_norm,
             unimix=self.unimix,
@@ -549,6 +665,14 @@ class WorldModel(nn.Module):
 
     def dynamic(self, posterior, recurrent_state, action, embedded_obs, is_first, key):
         return self.rssm.dynamic(posterior, recurrent_state, action, embedded_obs, is_first, key)
+
+    def project_embed(self, embedded_obs):
+        return self.rssm.project_embed(embedded_obs)
+
+    def dynamic_projected(self, posterior, recurrent_state, action, embed_proj, is_first, key):
+        return self.rssm.dynamic_projected(
+            posterior, recurrent_state, action, embed_proj, is_first, key
+        )
 
     def imagination(self, prior, recurrent_state, actions, key):
         return self.rssm.imagination(prior, recurrent_state, actions, key)

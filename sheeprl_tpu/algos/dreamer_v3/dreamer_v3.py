@@ -137,19 +137,22 @@ def build_train_fn(
             [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
         )
         embedded = wm_apply(wm_params, WorldModel.encode, batch_obs)
+        # hoist the embed half of the posterior trunk out of the time scan:
+        # one [T*B, E]×[E, H] matmul here instead of T sequential [B, E]×[E, H]
+        embed_proj = wm_apply(wm_params, WorldModel.project_embed, embedded)
 
         def step(carry, inp):
             posterior, recurrent = carry
-            action, embed, first, k = inp
+            action, eproj, first, k = inp
             recurrent, posterior, post_logits, prior_logits = world_model.apply(
                 {"params": wm_params},
                 posterior,
                 recurrent,
                 action,
-                embed,
+                eproj,
                 first,
                 k,
-                method=WorldModel.dynamic,
+                method=WorldModel.dynamic_projected,
             )
             return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
 
@@ -157,7 +160,7 @@ def build_train_fn(
         (_, _), (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
             step,
             (jnp.zeros((B, stoch_flat)), jnp.zeros((B, rec_size))),
-            (batch_actions, embedded, is_first, keys),
+            (batch_actions, embed_proj, is_first, keys),
         )
         latents = jnp.concatenate([posteriors, recurrents], -1)
         recon = wm_apply(wm_params, WorldModel.decode, latents)

@@ -132,14 +132,17 @@ def build_train_fn(
             [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
         )
         embedded = wm_apply(wm_params, WorldModel.encode, batch_obs)
+        # hoist the embed half of the posterior trunk out of the time scan
+        # (same optimization as dreamer_v3.py wm_loss_fn)
+        embed_proj = wm_apply(wm_params, WorldModel.project_embed, embedded)
 
         def step(carry, inp):
             posterior, recurrent = carry
-            action, embed, first, k = inp
+            action, eproj, first, k = inp
             recurrent, posterior, post_logits, prior_logits = world_model.apply(
                 {"params": wm_params},
-                posterior, recurrent, action, embed, first, k,
-                method=WorldModel.dynamic,
+                posterior, recurrent, action, eproj, first, k,
+                method=WorldModel.dynamic_projected,
             )
             return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
 
@@ -147,7 +150,7 @@ def build_train_fn(
         (_, _), (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
             step,
             (jnp.zeros((B, stoch_flat)), jnp.zeros((B, rec_size))),
-            (batch_actions, embedded, is_first, keys),
+            (batch_actions, embed_proj, is_first, keys),
         )
         latents = jnp.concatenate([posteriors, recurrents], -1)
         recon = wm_apply(wm_params, WorldModel.decode, latents)

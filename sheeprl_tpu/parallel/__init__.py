@@ -1,0 +1,39 @@
+"""Parallelism toolkit: named meshes, sequence/context parallelism.
+
+Data parallelism (the reference's DDP world, SURVEY §2.8) lives in
+:class:`sheeprl_tpu.fabric.Fabric`; this package holds the mesh construction
+shared by everything and the long-context primitives (ring attention,
+Ulysses all-to-all) that go beyond the reference's feature surface.
+"""
+
+from sheeprl_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    axis_size,
+    make_mesh,
+    pad_to_multiple,
+    shard_batch_and_sequence,
+    sharding,
+)
+from sheeprl_tpu.parallel.ring import (
+    attention,
+    ring_attention,
+    ring_self_attention,
+    ulysses_attention,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "axis_size",
+    "make_mesh",
+    "pad_to_multiple",
+    "shard_batch_and_sequence",
+    "sharding",
+    "attention",
+    "ring_attention",
+    "ring_self_attention",
+    "ulysses_attention",
+]

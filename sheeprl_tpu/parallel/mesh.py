@@ -1,0 +1,105 @@
+"""Multi-axis device mesh construction and sharding helpers.
+
+The reference's only parallelism is DDP data-parallel over process ranks
+(SURVEY §2.8); its comm backend is NCCL/Gloo through torch.distributed. Here
+the distributed substrate is a named :class:`jax.sharding.Mesh` and XLA
+collectives over ICI/DCN, and this module is the one place that builds
+meshes — the runtime (:class:`sheeprl_tpu.fabric.Fabric`) uses a 1-D
+``('data',)`` mesh, while long-sequence workloads can ask for an extra
+``'seq'`` (context-parallel) axis and expert/tensor axes are available for
+headroom beyond the reference's feature surface.
+
+TPU notes: ``jax.experimental.mesh_utils.create_device_mesh`` lays the mesh
+out so that neighboring mesh coordinates are ICI neighbors, which is what
+makes ``ppermute`` rings (ring attention, §ring.py) ride ICI at full
+bisection bandwidth instead of hopping through DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names, in mesh-major order. data = batch DP (the reference's
+# DDP world), seq = sequence/context parallelism (ring attention / Ulysses),
+# model = tensor parallelism headroom.
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    axes: Dict[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named mesh with the given ``{axis_name: size}`` layout.
+
+    Any single axis may be ``-1`` to absorb the remaining devices. The
+    product of axis sizes must equal the device count. Uses
+    ``mesh_utils.create_device_mesh`` when the devices are one homogeneous
+    slice (ICI-aware layout); falls back to a reshape otherwise (CPU test
+    meshes).
+    """
+    devs: List[jax.Device] = list(devices) if devices is not None else list(jax.devices())
+    names = tuple(axes.keys())
+    sizes = list(axes.values())
+    wild = [i for i, s in enumerate(sizes) if s == -1]
+    if len(wild) > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {axes}")
+    fixed = int(np.prod([s for s in sizes if s != -1]))
+    if wild:
+        if len(devs) % fixed != 0:
+            raise ValueError(f"{len(devs)} devices do not divide mesh {axes}")
+        sizes[wild[0]] = len(devs) // fixed
+    if int(np.prod(sizes)) != len(devs):
+        raise ValueError(f"Mesh {dict(zip(names, sizes))} needs {int(np.prod(sizes))} devices, have {len(devs)}")
+    if devs[0].platform == "cpu":
+        # Virtual CPU test meshes have no interconnect topology to optimize
+        # (and create_device_mesh rejects some host-device layouts).
+        dev_array = np.asarray(devs).reshape(tuple(sizes))
+    else:
+        # Accelerators: let mesh_utils lay the mesh out so neighboring mesh
+        # coordinates are ICI neighbors. A failure here means the requested
+        # topology is genuinely wrong — a silent reshape fallback would put
+        # ppermute rings on DCN and quietly collapse throughput, so raise.
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(tuple(sizes), devices=devs)
+    return Mesh(dev_array, names)
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+def sharding(mesh: Mesh, *spec: Any) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch_and_sequence(
+    mesh: Mesh,
+    batch_axis: Optional[str] = DATA_AXIS,
+    seq_axis: Optional[str] = SEQ_AXIS,
+) -> NamedSharding:
+    """Sharding for a ``[B, T, ...]`` activation: B over data, T over seq."""
+    b = batch_axis if batch_axis in mesh.shape else None
+    t = seq_axis if seq_axis in mesh.shape else None
+    return NamedSharding(mesh, P(b, t))
+
+
+def pad_to_multiple(x, multiple: int, axis: int) -> Tuple[Any, int]:
+    """Right-pad ``axis`` to a multiple (sequence sharding needs equal local
+    blocks). Returns the padded array and the pad amount. Works on numpy and
+    jax arrays (incl. tracers, so it can be called under ``jit``)."""
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x, 0
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    xp = np if isinstance(x, np.ndarray) else jnp
+    return xp.pad(x, widths), pad

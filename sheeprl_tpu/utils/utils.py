@@ -182,20 +182,6 @@ def save_configs(cfg, log_dir: str) -> None:
         yaml.safe_dump(data, f, sort_keys=False)
 
 
-def two_hot_encoder(x: jnp.ndarray, support: jnp.ndarray) -> jnp.ndarray:
-    """Two-hot encoding of scalar targets against a fixed support."""
-    x = jnp.clip(x, support[0], support[-1])
-    idx_above = jnp.searchsorted(support, x, side="left")
-    idx_above = jnp.clip(idx_above, 1, len(support) - 1)
-    idx_below = idx_above - 1
-    lo, hi = support[idx_below], support[idx_above]
-    w_above = (x - lo) / (hi - lo)
-    w_below = 1.0 - w_above
-    below = jax.nn.one_hot(idx_below, len(support)) * w_below[..., None]
-    above = jax.nn.one_hot(idx_above, len(support)) * w_above[..., None]
-    return below + above
-
-
 def unwrap_fabric(module):  # pragma: no cover - parity shim
     """Parity shim with the reference API: params are already plain pytrees."""
     return module

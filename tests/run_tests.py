@@ -1,8 +1,20 @@
 """Test-suite runner shim (reference ``tests/run_tests.py:1-6``)."""
 
+import os
 import sys
 
 import pytest
 
+# `python tests/run_tests.py` puts tests/ (not the repo root) on sys.path;
+# make the package importable regardless of invocation directory.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 if __name__ == "__main__":
-    sys.exit(pytest.main(["-s", "--cov=sheeprl_tpu", "-vv", *sys.argv[1:]]))
+    args = ["-s", "-vv"]
+    try:  # coverage only when pytest-cov is available (not a hard dep)
+        import pytest_cov  # noqa: F401
+
+        args.insert(1, "--cov=sheeprl_tpu")
+    except ImportError:
+        pass
+    sys.exit(pytest.main([*args, *sys.argv[1:]]))

@@ -123,3 +123,34 @@ def test_multi_encoder_decoder():
     rec = dec.apply(dparams, feat)
     assert rec["state"].shape == (2, 5)
     assert rec["extra"].shape == (2, 2)
+
+
+def test_dv3_encoder_output_width_matches_formula():
+    """MultiEncoderDV3.output_width (sizes the split posterior trunk kernel)
+    must track the real encoder output across cnn-only / mlp-only / both."""
+    from sheeprl_tpu.algos.dreamer_v3.agent import MultiEncoderDV3
+
+    cases = [
+        (("rgb",), (), 32, 3),
+        ((), ("state",), 32, 3),
+        (("rgb",), ("state",), 64, 4),
+    ]
+    for cnn_keys, mlp_keys, screen, stages in cases:
+        enc = MultiEncoderDV3(
+            cnn_keys=cnn_keys,
+            mlp_keys=mlp_keys,
+            channels_multiplier=4,
+            stages=stages,
+            mlp_layers=1,
+            dense_units=16,
+        )
+        obs = {}
+        if cnn_keys:
+            obs["rgb"] = jnp.zeros((2, 3, screen, screen))
+        if mlp_keys:
+            obs["state"] = jnp.zeros((2, 5))
+        feat = enc.apply(enc.init(jax.random.PRNGKey(0), obs), obs)
+        want = MultiEncoderDV3.output_width(
+            cnn_keys, mlp_keys, (screen, screen), 4, stages, 16
+        )
+        assert feat.shape == (2, want), (cnn_keys, mlp_keys, feat.shape, want)
