@@ -73,8 +73,10 @@ def main() -> None:
 
     T, B = int(cfg.per_rank_sequence_length), int(cfg.per_rank_batch_size)
     rng = np.random.default_rng(0)
+    # uint8 pixels: what the real training loop ships (dreamer_v3.py stages
+    # native dtypes host->HBM; the train step normalizes on device)
     data = {
-        "rgb": rng.integers(0, 256, size=(T, B, 3, 64, 64)).astype(np.float32),
+        "rgb": rng.integers(0, 256, size=(T, B, 3, 64, 64)).astype(np.uint8),
         "actions": np.eye(actions_dim[0], dtype=np.float32)[
             rng.integers(0, actions_dim[0], (T, B))
         ],

@@ -378,13 +378,29 @@ def uniform_mix(logits: jnp.ndarray, discrete: int, unimix: float) -> jnp.ndarra
 
 
 def compute_stochastic_state(
-    logits: jnp.ndarray, discrete: int, key: Optional[jax.Array], sample: bool = True
+    logits: jnp.ndarray,
+    discrete: int,
+    key: Optional[jax.Array],
+    sample: bool = True,
+    gumbel: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Sample (straight-through) or take the mode of the categorical latent
     (reference dreamer_v2/utils.py:39-58). ``logits`` flat ``[..., S*D]`` →
-    state ``[..., S, D]``."""
+    state ``[..., S, D]``.
+
+    ``gumbel`` (shape ``[..., S, D]``) is pre-drawn Gumbel(0,1) noise: the
+    train scans generate it for the whole sequence in one vectorized draw
+    outside the time loop, leaving only an add+argmax on the sequential path
+    (``argmax(logits + g)`` is the same sampler ``jax.random.categorical``
+    uses, and is invariant to the log-softmax normalization)."""
     shape = logits.shape
     logits = jnp.reshape(logits, shape[:-1] + (-1, discrete))
+    if sample and gumbel is not None:
+        one = jax.nn.one_hot(
+            jnp.argmax(logits + gumbel, axis=-1), discrete, dtype=logits.dtype
+        )
+        probs = jax.nn.softmax(logits, axis=-1)
+        return one + probs - jax.lax.stop_gradient(probs)
     dist = OneHotCategoricalStraightThrough(logits=logits)
     return dist.rsample(key) if sample else dist.mode
 
@@ -435,11 +451,17 @@ class RSSM(nn.Module):
         )
 
     def _transition(
-        self, recurrent_out: jnp.ndarray, key: Optional[jax.Array], sample_state: bool = True
+        self,
+        recurrent_out: jnp.ndarray,
+        key: Optional[jax.Array],
+        sample_state: bool = True,
+        gumbel: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Prior logits + (sampled|mode) prior, flat (reference :426-439)."""
         logits = uniform_mix(self.transition_model(recurrent_out), self.discrete_size, self.unimix)
-        state = compute_stochastic_state(logits, self.discrete_size, key, sample=sample_state)
+        state = compute_stochastic_state(
+            logits, self.discrete_size, key, sample=sample_state, gumbel=gumbel
+        )
         return logits, jnp.reshape(state, state.shape[:-2] + (-1,))
 
     def _representation(
@@ -456,14 +478,18 @@ class RSSM(nn.Module):
         return self.representation_model.project_embed(embedded_obs)
 
     def _representation_projected(
-        self, recurrent_state: jnp.ndarray, embed_proj: jnp.ndarray, key: jax.Array
+        self,
+        recurrent_state: jnp.ndarray,
+        embed_proj: jnp.ndarray,
+        key: Optional[jax.Array],
+        gumbel: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         logits = uniform_mix(
             self.representation_model.from_projected(recurrent_state, embed_proj),
             self.discrete_size,
             self.unimix,
         )
-        state = compute_stochastic_state(logits, self.discrete_size, key)
+        state = compute_stochastic_state(logits, self.discrete_size, key, gumbel=gumbel)
         return logits, jnp.reshape(state, state.shape[:-2] + (-1,))
 
     def dynamic(
@@ -495,29 +521,70 @@ class RSSM(nn.Module):
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """``dynamic`` with the embed projection precomputed (the train scan
         hoists ``project_embed`` over [T, B] outside the time loop)."""
+        init_post = self._transition(
+            (1.0 - is_first) * recurrent_state, None, sample_state=False
+        )[1]
+        recurrent_state, posterior, posterior_logits = self.dynamic_posterior(
+            posterior, recurrent_state, action, embed_proj, is_first, init_post, key
+        )
+        prior_logits = self.prior_logits(recurrent_state)
+        return recurrent_state, posterior, posterior_logits, prior_logits
+
+    def dynamic_posterior(
+        self,
+        posterior: jnp.ndarray,
+        recurrent_state: jnp.ndarray,
+        action: jnp.ndarray,
+        embed_proj: jnp.ndarray,
+        is_first: jnp.ndarray,
+        init_posterior: jnp.ndarray,
+        key: Optional[jax.Array],
+        gumbel: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Sequential core of ``dynamic``: only the posterior chain.
+
+        The transition (prior) model never feeds back into the time loop —
+        its logits depend only on the produced recurrent states — so train
+        scans run this reduced step and batch :meth:`prior_logits` over the
+        whole ``[T, B]`` output afterwards; likewise ``init_posterior`` (the
+        prior mode at a zeroed recurrent state, constant) is computed once
+        outside. Cuts the per-timestep weight streaming roughly in half.
+        """
         action = (1.0 - is_first) * action
         recurrent_state = (1.0 - is_first) * recurrent_state
-        init_post = self._transition(recurrent_state, None, sample_state=False)[1]
-        posterior = (1.0 - is_first) * posterior + is_first * init_post
+        posterior = (1.0 - is_first) * posterior + is_first * init_posterior
         recurrent_state = self.recurrent_model(
             jnp.concatenate([posterior, action], -1), recurrent_state
         )
-        k1, k2 = jax.random.split(key)
-        prior_logits, _ = self._transition(recurrent_state, k1)
+        if gumbel is None:
+            # same key split as dynamic() (whose k1 sampled the discarded
+            # prior) so both paths draw the identical posterior sample stream
+            key = jax.random.split(key)[1]
         posterior_logits, posterior = self._representation_projected(
-            recurrent_state, embed_proj, k2
+            recurrent_state, embed_proj, key, gumbel=gumbel
         )
-        return recurrent_state, posterior, posterior_logits, prior_logits
+        return recurrent_state, posterior, posterior_logits
+
+    def prior_logits(self, recurrent_states: jnp.ndarray) -> jnp.ndarray:
+        """Unimixed transition logits — batchable over any leading shape."""
+        return uniform_mix(
+            self.transition_model(recurrent_states), self.discrete_size, self.unimix
+        )
 
     def imagination(
-        self, prior: jnp.ndarray, recurrent_state: jnp.ndarray, actions: jnp.ndarray, key: jax.Array
+        self,
+        prior: jnp.ndarray,
+        recurrent_state: jnp.ndarray,
+        actions: jnp.ndarray,
+        key: Optional[jax.Array],
+        gumbel: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """One prior step in imagination (reference :441-457): flat prior in,
         flat sampled prior + new recurrent state out."""
         recurrent_state = self.recurrent_model(
             jnp.concatenate([prior, actions], -1), recurrent_state
         )
-        _, imagined_prior = self._transition(recurrent_state, key)
+        _, imagined_prior = self._transition(recurrent_state, key, gumbel=gumbel)
         return imagined_prior, recurrent_state
 
     def __call__(self, posterior, recurrent_state, action, embedded_obs, is_first, key):
@@ -674,8 +741,26 @@ class WorldModel(nn.Module):
             posterior, recurrent_state, action, embed_proj, is_first, key
         )
 
-    def imagination(self, prior, recurrent_state, actions, key):
-        return self.rssm.imagination(prior, recurrent_state, actions, key)
+    def dynamic_posterior(
+        self,
+        posterior,
+        recurrent_state,
+        action,
+        embed_proj,
+        is_first,
+        init_posterior,
+        key,
+        gumbel=None,
+    ):
+        return self.rssm.dynamic_posterior(
+            posterior, recurrent_state, action, embed_proj, is_first, init_posterior, key, gumbel
+        )
+
+    def prior_logits(self, recurrent_states):
+        return self.rssm.prior_logits(recurrent_states)
+
+    def imagination(self, prior, recurrent_state, actions, key, gumbel=None):
+        return self.rssm.imagination(prior, recurrent_state, actions, key, gumbel=gumbel)
 
     def initial_posterior(self, recurrent_state: jnp.ndarray) -> jnp.ndarray:
         """Mode of the prior at a fresh recurrent state (player init,

@@ -140,28 +140,41 @@ def build_train_fn(
         # hoist the embed half of the posterior trunk out of the time scan:
         # one [T*B, E]×[E, H] matmul here instead of T sequential [B, E]×[E, H]
         embed_proj = wm_apply(wm_params, WorldModel.project_embed, embedded)
+        # the is_first reset posterior is the prior mode at a zeroed recurrent
+        # state — a constant, computed once (broadcast over B inside the scan)
+        init_post = wm_apply(
+            wm_params, WorldModel.initial_posterior, jnp.zeros((1, rec_size))
+        )
 
         def step(carry, inp):
             posterior, recurrent = carry
-            action, eproj, first, k = inp
-            recurrent, posterior, post_logits, prior_logits = world_model.apply(
+            action, eproj, first, g = inp
+            recurrent, posterior, post_logits = world_model.apply(
                 {"params": wm_params},
                 posterior,
                 recurrent,
                 action,
                 eproj,
                 first,
-                k,
-                method=WorldModel.dynamic_projected,
+                init_post,
+                None,
+                g,
+                method=WorldModel.dynamic_posterior,
             )
-            return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
+            return (posterior, recurrent), (recurrent, posterior, post_logits)
 
-        keys = jax.random.split(key, T)
-        (_, _), (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
+        # pre-draw the posterior sampling noise for the whole sequence in one
+        # vectorized call; the scan body is left with add+argmax only
+        S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
+        gumbels = jax.random.gumbel(key, (T, B, S, D))
+        (_, _), (recurrents, posteriors, post_logits) = jax.lax.scan(
             step,
             (jnp.zeros((B, stoch_flat)), jnp.zeros((B, rec_size))),
-            (batch_actions, embed_proj, is_first, keys),
+            (batch_actions, embed_proj, is_first, gumbels),
         )
+        # prior (transition) logits never feed back into the loop: batch them
+        # over the whole [T, B] recurrent-state sequence after the scan
+        prior_logits = wm_apply(wm_params, WorldModel.prior_logits, recurrents)
         latents = jnp.concatenate([posteriors, recurrents], -1)
         recon = wm_apply(wm_params, WorldModel.decode, latents)
         po = {k: MSEDistribution(recon[k], dims=3) for k in cnn_dec_keys}
@@ -172,7 +185,6 @@ def build_train_fn(
         pc = continue_distribution(
             wm_apply(wm_params, WorldModel.continue_logits, latents)
         )
-        S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
         loss, metrics = reconstruction_loss(
             po,
             batch_obs,
@@ -214,23 +226,29 @@ def build_train_fn(
         k0, key = jax.random.split(key)
         a0 = policy(latent0, k0)
 
-        def step(carry, k):
+        def step(carry, inp):
             prior, recurrent, action = carry
-            k_img, k_act = jax.random.split(k)
+            g_img, k_act = inp
             prior, recurrent = world_model.apply(
                 {"params": wm_params},
                 prior,
                 recurrent,
                 action,
-                k_img,
+                None,
+                g_img,
                 method=WorldModel.imagination,
             )
             latent = jnp.concatenate([prior, recurrent], -1)
             action = policy(latent, k_act)
             return (prior, recurrent, action), (latent, action)
 
+        # prior-sampling noise for the whole horizon drawn in one call; only
+        # the actor's (distribution-dependent) sampling still consumes keys
+        k_gum, key = jax.random.split(key)
+        S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
+        gumbels = jax.random.gumbel(k_gum, (horizon, prior.shape[0], S, D))
         keys = jax.random.split(key, horizon)
-        _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, a0), keys)
+        _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, a0), (gumbels, keys))
         trajectories = jnp.concatenate([latent0[None], latents], 0)
         actions = jnp.concatenate([a0[None], acts], 0)
         return trajectories, actions

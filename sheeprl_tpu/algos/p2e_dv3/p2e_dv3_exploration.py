@@ -123,6 +123,8 @@ def build_train_fn(
 
     # -- world model loss: identical to DV3 (reference train :121-245) -----
 
+    S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
+
     def wm_loss_fn(wm_params, data, key):
         T, B = data["rewards"].shape[:2]
         batch_obs = {k: data[k] / 255.0 for k in cnn_keys}
@@ -132,26 +134,33 @@ def build_train_fn(
             [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
         )
         embedded = wm_apply(wm_params, WorldModel.encode, batch_obs)
-        # hoist the embed half of the posterior trunk out of the time scan
-        # (same optimization as dreamer_v3.py wm_loss_fn)
+        # hoist the non-sequential work out of the time scan (same
+        # optimization as dreamer_v3.py wm_loss_fn): embed projection and
+        # prior logits are batched over [T, B]; the is_first reset posterior
+        # is the constant prior mode at a zeroed recurrent state
         embed_proj = wm_apply(wm_params, WorldModel.project_embed, embedded)
+        init_post = wm_apply(
+            wm_params, WorldModel.initial_posterior, jnp.zeros((1, rec_size))
+        )
 
         def step(carry, inp):
             posterior, recurrent = carry
-            action, eproj, first, k = inp
-            recurrent, posterior, post_logits, prior_logits = world_model.apply(
+            action, eproj, first, g = inp
+            recurrent, posterior, post_logits = world_model.apply(
                 {"params": wm_params},
-                posterior, recurrent, action, eproj, first, k,
-                method=WorldModel.dynamic_projected,
+                posterior, recurrent, action, eproj, first, init_post, None, g,
+                method=WorldModel.dynamic_posterior,
             )
-            return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
+            return (posterior, recurrent), (recurrent, posterior, post_logits)
 
-        keys = jax.random.split(key, T)
-        (_, _), (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
+        # posterior sampling noise for the whole sequence drawn in one call
+        gumbels = jax.random.gumbel(key, (T, B, S, D))
+        (_, _), (recurrents, posteriors, post_logits) = jax.lax.scan(
             step,
             (jnp.zeros((B, stoch_flat)), jnp.zeros((B, rec_size))),
-            (batch_actions, embed_proj, is_first, keys),
+            (batch_actions, embed_proj, is_first, gumbels),
         )
+        prior_logits = wm_apply(wm_params, WorldModel.prior_logits, recurrents)
         latents = jnp.concatenate([posteriors, recurrents], -1)
         recon = wm_apply(wm_params, WorldModel.decode, latents)
         po = {k: MSEDistribution(recon[k], dims=3) for k in cnn_dec_keys}
@@ -160,7 +169,6 @@ def build_train_fn(
             wm_apply(wm_params, WorldModel.reward_logits, latents), dims=1
         )
         pc = continue_distribution(wm_apply(wm_params, WorldModel.continue_logits, latents))
-        S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
         loss, metrics = reconstruction_loss(
             po, batch_obs, pr, data["rewards"],
             prior_logits.reshape(T, B, S, D), post_logits.reshape(T, B, S, D),
@@ -194,19 +202,22 @@ def build_train_fn(
         k0, key = jax.random.split(key)
         a0 = policy(latent0, k0)
 
-        def step(carry, k):
+        def step(carry, inp):
             prior, recurrent, action = carry
-            k_img, k_act = jax.random.split(k)
+            g_img, k_act = inp
             prior, recurrent = world_model.apply(
-                {"params": wm_params}, prior, recurrent, action, k_img,
+                {"params": wm_params}, prior, recurrent, action, None, g_img,
                 method=WorldModel.imagination,
             )
             latent = jnp.concatenate([prior, recurrent], -1)
             action = policy(latent, k_act)
             return (prior, recurrent, action), (latent, action)
 
+        # prior-sampling noise for the whole horizon drawn in one call
+        k_gum, key = jax.random.split(key)
+        gumbels = jax.random.gumbel(k_gum, (horizon, prior.shape[0], S, D))
         keys = jax.random.split(key, horizon)
-        _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, a0), keys)
+        _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, a0), (gumbels, keys))
         return (
             jnp.concatenate([latent0[None], latents], 0),
             jnp.concatenate([a0[None], acts], 0),
