@@ -66,12 +66,18 @@ def compute_stochastic_state(
     state_information: jnp.ndarray,
     key: Optional[jax.Array],
     min_std: float = 0.1,
+    noise: Optional[jnp.ndarray] = None,
 ) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
     """``[..., 2S]`` head output → ``((mean, std), sampled state)`` with
     ``std = softplus(raw) + min_std`` (reference dv1/utils.py:66-93). With no
-    key the mean is returned (the deterministic player-init path)."""
+    key the mean is returned (the deterministic player-init path).
+
+    ``noise`` is pre-drawn N(0,1): train scans draw it for the whole
+    sequence in one call outside the time loop (see the DV3 agent)."""
     mean, std = jnp.split(state_information, 2, axis=-1)
     std = jax.nn.softplus(std) + min_std
+    if noise is not None:
+        return (mean, std), mean + std * noise
     if key is None:
         return (mean, std), mean
     state = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
@@ -138,19 +144,27 @@ class RSSM(nn.Module):
         )
 
     def _transition(
-        self, recurrent_out: jnp.ndarray, key: Optional[jax.Array]
+        self,
+        recurrent_out: jnp.ndarray,
+        key: Optional[jax.Array],
+        noise: Optional[jnp.ndarray] = None,
     ) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
         return compute_stochastic_state(
-            self.transition_model(recurrent_out), key, self.min_std
+            self.transition_model(recurrent_out), key, self.min_std, noise=noise
         )
 
     def _representation(
-        self, recurrent_state: jnp.ndarray, embedded_obs: jnp.ndarray, key: Optional[jax.Array]
+        self,
+        recurrent_state: jnp.ndarray,
+        embedded_obs: jnp.ndarray,
+        key: Optional[jax.Array],
+        noise: Optional[jnp.ndarray] = None,
     ) -> Tuple[Tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray]:
         return compute_stochastic_state(
             self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1)),
             key,
             self.min_std,
+            noise=noise,
         )
 
     def dynamic(
@@ -163,23 +177,51 @@ class RSSM(nn.Module):
     ):
         """One posterior step (reference :95-133). Returns ``(recurrent_state,
         posterior, (post_mean, post_std), (prior_mean, prior_std))``."""
+        recurrent_state, posterior, posterior_mean_std = self.dynamic_posterior(
+            posterior, recurrent_state, action, embedded_obs, key
+        )
+        prior_mean_std = self.prior_stats(recurrent_state)
+        return recurrent_state, posterior, posterior_mean_std, prior_mean_std
+
+    def dynamic_posterior(
+        self,
+        posterior: jnp.ndarray,
+        recurrent_state: jnp.ndarray,
+        action: jnp.ndarray,
+        embedded_obs: jnp.ndarray,
+        key: Optional[jax.Array],
+        noise: Optional[jnp.ndarray] = None,
+    ):
+        """Sequential core of ``dynamic``: the prior (transition) stats never
+        feed back into the time loop — train scans batch :meth:`prior_stats`
+        over the [T, B] output afterwards (same optimization as DV3)."""
         recurrent_state = self.recurrent_model(
             jnp.concatenate([posterior, action], -1), recurrent_state
         )
-        k1, k2 = jax.random.split(key)
-        prior_mean_std, _ = self._transition(recurrent_state, k1)
-        posterior_mean_std, posterior = self._representation(recurrent_state, embedded_obs, k2)
-        return recurrent_state, posterior, posterior_mean_std, prior_mean_std
+        if noise is None:
+            # same split as dynamic() (whose k1 sampled the discarded prior)
+            key = jax.random.split(key)[1]
+        posterior_mean_std, posterior = self._representation(
+            recurrent_state, embedded_obs, key, noise=noise
+        )
+        return recurrent_state, posterior, posterior_mean_std
+
+    def prior_stats(self, recurrent_states: jnp.ndarray):
+        """Prior ``(mean, std)`` — batchable over any leading shape."""
+        return compute_stochastic_state(
+            self.transition_model(recurrent_states), None, self.min_std
+        )[0]
 
     def imagination(
         self, stochastic_state: jnp.ndarray, recurrent_state: jnp.ndarray,
-        actions: jnp.ndarray, key: jax.Array,
+        actions: jnp.ndarray, key: Optional[jax.Array],
+        noise: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """One prior step in imagination (reference :171-191)."""
         recurrent_state = self.recurrent_model(
             jnp.concatenate([stochastic_state, actions], -1), recurrent_state
         )
-        _, imagined_prior = self._transition(recurrent_state, key)
+        _, imagined_prior = self._transition(recurrent_state, key, noise=noise)
         return imagined_prior, recurrent_state
 
     def __call__(self, posterior, recurrent_state, action, embedded_obs, key):
@@ -282,8 +324,16 @@ class WorldModel(nn.Module):
     def dynamic(self, posterior, recurrent_state, action, embedded_obs, key):
         return self.rssm.dynamic(posterior, recurrent_state, action, embedded_obs, key)
 
-    def imagination(self, prior, recurrent_state, actions, key):
-        return self.rssm.imagination(prior, recurrent_state, actions, key)
+    def dynamic_posterior(self, posterior, recurrent_state, action, embedded_obs, key, noise=None):
+        return self.rssm.dynamic_posterior(
+            posterior, recurrent_state, action, embedded_obs, key, noise
+        )
+
+    def prior_stats(self, recurrent_states):
+        return self.rssm.prior_stats(recurrent_states)
+
+    def imagination(self, prior, recurrent_state, actions, key, noise=None):
+        return self.rssm.imagination(prior, recurrent_state, actions, key, noise=noise)
 
     def recurrent_step(self, stochastic, actions, recurrent_state):
         return self.rssm.recurrent_model(

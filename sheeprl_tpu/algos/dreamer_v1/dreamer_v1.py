@@ -107,24 +107,29 @@ def build_train_fn(
 
         def step(carry, inp):
             posterior, recurrent = carry
-            action, embed, k = inp
-            recurrent, posterior, post_ms, prior_ms = world_model.apply(
+            action, embed, eps = inp
+            recurrent, posterior, post_ms = world_model.apply(
                 {"params": wm_params},
                 posterior,
                 recurrent,
                 action,
                 embed,
-                k,
-                method=WorldModel.dynamic,
+                None,
+                eps,
+                method=WorldModel.dynamic_posterior,
             )
-            return (posterior, recurrent), (recurrent, posterior, post_ms, prior_ms)
+            return (posterior, recurrent), (recurrent, posterior, post_ms)
 
-        keys = jax.random.split(key, T)
-        (_, _), (recurrents, posteriors, post_ms, prior_ms) = jax.lax.scan(
+        # posterior sampling noise for the whole sequence in one draw; the
+        # prior (transition) stats never feed back into the loop and are
+        # batched over [T, B] after the scan (same optimization as DV3)
+        noise = jax.random.normal(key, (T, B, stoch_size))
+        (_, _), (recurrents, posteriors, post_ms) = jax.lax.scan(
             step,
             (jnp.zeros((B, stoch_size)), jnp.zeros((B, rec_size))),
-            (data["actions"], embedded, keys),
+            (data["actions"], embedded, noise),
         )
+        prior_ms = wm_apply(wm_params, WorldModel.prior_stats, recurrents)
         latents = jnp.concatenate([posteriors, recurrents], -1)
         recon = wm_apply(wm_params, WorldModel.decode, latents)
         qo = {
@@ -175,23 +180,27 @@ def build_train_fn(
                 sample_actor_actions(dists, is_continuous, k, True), -1
             )
 
-        def step(carry, k):
+        def step(carry, inp):
             prior, recurrent, latent = carry
-            k_img, k_act = jax.random.split(k)
+            eps_img, k_act = inp
             action = policy(latent, k_act)
             prior, recurrent = world_model.apply(
                 {"params": wm_params},
                 prior,
                 recurrent,
                 action,
-                k_img,
+                None,
+                eps_img,
                 method=WorldModel.imagination,
             )
             latent = jnp.concatenate([prior, recurrent], -1)
             return (prior, recurrent, latent), latent
 
+        # prior-sampling noise for the whole horizon in one draw
+        k_eps, key = jax.random.split(key)
+        noise = jax.random.normal(k_eps, (horizon, prior.shape[0], stoch_size))
         keys = jax.random.split(key, horizon)
-        _, latents = jax.lax.scan(step, (prior, recurrent, latent), keys)
+        _, latents = jax.lax.scan(step, (prior, recurrent, latent), (noise, keys))
         return latents
 
     def actor_loss_fn(actor_params, wm_params, critic_params, posteriors, recurrents, key):

@@ -224,15 +224,30 @@ class _StochasticModel(nn.Module):
 
 
 def compute_stochastic_state(
-    logits: jnp.ndarray, discrete: int, key: Optional[jax.Array], sample: bool = True
+    logits: jnp.ndarray,
+    discrete: int,
+    key: Optional[jax.Array],
+    sample: bool = True,
+    gumbel: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Sample (straight-through) or take the mode of the categorical latent
     (reference dreamer_v2/utils.py:39-58). ``logits`` flat ``[..., S*D]`` →
-    flat state ``[..., S*D]``."""
+    flat state ``[..., S*D]``.
+
+    ``gumbel`` ([..., S, D]) is pre-drawn Gumbel(0,1) noise — train scans
+    draw it for the whole sequence outside the time loop (see the DV3 agent's
+    ``compute_stochastic_state``)."""
     from sheeprl_tpu.distributions import OneHotCategoricalStraightThrough
 
     shape = logits.shape
     logits = jnp.reshape(logits, shape[:-1] + (-1, discrete))
+    if sample and gumbel is not None:
+        one = jax.nn.one_hot(
+            jnp.argmax(logits + gumbel, axis=-1), discrete, dtype=logits.dtype
+        )
+        probs = jax.nn.softmax(logits, axis=-1)
+        state = one + probs - jax.lax.stop_gradient(probs)
+        return jnp.reshape(state, shape)
     dist = OneHotCategoricalStraightThrough(logits=logits)
     state = dist.rsample(key) if sample else dist.mode
     return jnp.reshape(state, shape)
@@ -278,18 +293,30 @@ class RSSM(nn.Module):
         )
 
     def _transition(
-        self, recurrent_out: jnp.ndarray, key: Optional[jax.Array], sample_state: bool = True
+        self,
+        recurrent_out: jnp.ndarray,
+        key: Optional[jax.Array],
+        sample_state: bool = True,
+        gumbel: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         logits = self.transition_model(recurrent_out)
-        return logits, compute_stochastic_state(logits, self.discrete_size, key, sample=sample_state)
+        return logits, compute_stochastic_state(
+            logits, self.discrete_size, key, sample=sample_state, gumbel=gumbel
+        )
 
     def _representation(
-        self, recurrent_state: jnp.ndarray, embedded_obs: jnp.ndarray, key: jax.Array
+        self,
+        recurrent_state: jnp.ndarray,
+        embedded_obs: jnp.ndarray,
+        key: Optional[jax.Array],
+        gumbel: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         logits = self.representation_model(
             jnp.concatenate([recurrent_state, embedded_obs], -1)
         )
-        return logits, compute_stochastic_state(logits, self.discrete_size, key)
+        return logits, compute_stochastic_state(
+            logits, self.discrete_size, key, gumbel=gumbel
+        )
 
     def dynamic(
         self,
@@ -303,25 +330,57 @@ class RSSM(nn.Module):
         """One posterior step (reference :327-363): zero-mask resets, then
         recurrent → prior → posterior. Returns ``(recurrent_state, posterior,
         posterior_logits, prior_logits)``."""
+        recurrent_state, posterior, posterior_logits = self.dynamic_posterior(
+            posterior, recurrent_state, action, embedded_obs, is_first, key
+        )
+        prior_logits = self.prior_logits(recurrent_state)
+        return recurrent_state, posterior, posterior_logits, prior_logits
+
+    def dynamic_posterior(
+        self,
+        posterior: jnp.ndarray,
+        recurrent_state: jnp.ndarray,
+        action: jnp.ndarray,
+        embedded_obs: jnp.ndarray,
+        is_first: jnp.ndarray,
+        key: Optional[jax.Array],
+        gumbel: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Sequential core of ``dynamic``: the prior (transition) logits never
+        feed back into the time loop, so train scans run this reduced step and
+        batch :meth:`prior_logits` over the whole [T, B] output afterwards
+        (same optimization as the DV3 RSSM)."""
         action = (1.0 - is_first) * action
         posterior = (1.0 - is_first) * posterior
         recurrent_state = (1.0 - is_first) * recurrent_state
         recurrent_state = self.recurrent_model(
             jnp.concatenate([posterior, action], -1), recurrent_state
         )
-        k1, k2 = jax.random.split(key)
-        prior_logits, _ = self._transition(recurrent_state, k1)
-        posterior_logits, posterior = self._representation(recurrent_state, embedded_obs, k2)
-        return recurrent_state, posterior, posterior_logits, prior_logits
+        if gumbel is None:
+            # same split as dynamic() (whose k1 sampled the discarded prior)
+            key = jax.random.split(key)[1]
+        posterior_logits, posterior = self._representation(
+            recurrent_state, embedded_obs, key, gumbel=gumbel
+        )
+        return recurrent_state, posterior, posterior_logits
+
+    def prior_logits(self, recurrent_states: jnp.ndarray) -> jnp.ndarray:
+        """Transition logits — batchable over any leading shape."""
+        return self.transition_model(recurrent_states)
 
     def imagination(
-        self, prior: jnp.ndarray, recurrent_state: jnp.ndarray, actions: jnp.ndarray, key: jax.Array
+        self,
+        prior: jnp.ndarray,
+        recurrent_state: jnp.ndarray,
+        actions: jnp.ndarray,
+        key: Optional[jax.Array],
+        gumbel: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """One prior step in imagination (reference :396-411)."""
         recurrent_state = self.recurrent_model(
             jnp.concatenate([prior, actions], -1), recurrent_state
         )
-        _, imagined_prior = self._transition(recurrent_state, key)
+        _, imagined_prior = self._transition(recurrent_state, key, gumbel=gumbel)
         return imagined_prior, recurrent_state
 
     def __call__(self, posterior, recurrent_state, action, embedded_obs, is_first, key):
@@ -454,8 +513,18 @@ class WorldModel(nn.Module):
     def dynamic(self, posterior, recurrent_state, action, embedded_obs, is_first, key):
         return self.rssm.dynamic(posterior, recurrent_state, action, embedded_obs, is_first, key)
 
-    def imagination(self, prior, recurrent_state, actions, key):
-        return self.rssm.imagination(prior, recurrent_state, actions, key)
+    def dynamic_posterior(
+        self, posterior, recurrent_state, action, embedded_obs, is_first, key, gumbel=None
+    ):
+        return self.rssm.dynamic_posterior(
+            posterior, recurrent_state, action, embedded_obs, is_first, key, gumbel
+        )
+
+    def prior_logits(self, recurrent_states):
+        return self.rssm.prior_logits(recurrent_states)
+
+    def imagination(self, prior, recurrent_state, actions, key, gumbel=None):
+        return self.rssm.imagination(prior, recurrent_state, actions, key, gumbel=gumbel)
 
     def recurrent_step(self, stochastic, actions, recurrent_state):
         return self.rssm.recurrent_model(

@@ -105,6 +105,8 @@ def build_train_fn(
     dims = tuple(int(d) for d in actions_dim)
     splits = list(np.cumsum(dims)[:-1])
 
+    S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
+
     def wm_apply(params, method, *args):
         return world_model.apply({"params": params}, *args, method=method)
 
@@ -121,25 +123,30 @@ def build_train_fn(
 
         def step(carry, inp):
             posterior, recurrent = carry
-            action, embed, first, k = inp
-            recurrent, posterior, post_logits, prior_logits = world_model.apply(
+            action, embed, first, g = inp
+            recurrent, posterior, post_logits = world_model.apply(
                 {"params": wm_params},
                 posterior,
                 recurrent,
                 action,
                 embed,
                 first,
-                k,
-                method=WorldModel.dynamic,
+                None,
+                g,
+                method=WorldModel.dynamic_posterior,
             )
-            return (posterior, recurrent), (recurrent, posterior, post_logits, prior_logits)
+            return (posterior, recurrent), (recurrent, posterior, post_logits)
 
-        keys = jax.random.split(key, T)
-        (_, _), (recurrents, posteriors, post_logits, prior_logits) = jax.lax.scan(
+        # posterior sampling noise for the whole sequence in one draw; the
+        # prior (transition) logits never feed back into the loop and are
+        # batched over [T, B] after the scan (same optimization as DV3)
+        gumbels = jax.random.gumbel(key, (T, B, S, D))
+        (_, _), (recurrents, posteriors, post_logits) = jax.lax.scan(
             step,
             (jnp.zeros((B, stoch_flat)), jnp.zeros((B, rec_size))),
-            (data["actions"], embedded, is_first, keys),
+            (data["actions"], embedded, is_first, gumbels),
         )
+        prior_logits = wm_apply(wm_params, WorldModel.prior_logits, recurrents)
         latents = jnp.concatenate([posteriors, recurrents], -1)
         recon = wm_apply(wm_params, WorldModel.decode, latents)
         po = {
@@ -152,7 +159,6 @@ def build_train_fn(
             continue_targets = (1.0 - data["dones"]) * gamma
         else:
             pc = continue_targets = None
-        S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
         loss, metrics = reconstruction_loss(
             po,
             batch_obs,
@@ -191,23 +197,27 @@ def build_train_fn(
                 sample_actor_actions(dists, is_continuous, k, True), -1
             )
 
-        def step(carry, k):
+        def step(carry, inp):
             prior, recurrent, latent = carry
-            k_img, k_act = jax.random.split(k)
+            g_img, k_act = inp
             action = policy(latent, k_act)
             prior, recurrent = world_model.apply(
                 {"params": wm_params},
                 prior,
                 recurrent,
                 action,
-                k_img,
+                None,
+                g_img,
                 method=WorldModel.imagination,
             )
             latent = jnp.concatenate([prior, recurrent], -1)
             return (prior, recurrent, latent), (latent, action)
 
+        # prior-sampling noise for the whole horizon in one draw
+        k_gum, key = jax.random.split(key)
+        gumbels = jax.random.gumbel(k_gum, (horizon, prior.shape[0], S, D))
         keys = jax.random.split(key, horizon)
-        _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, latent0), keys)
+        _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, latent0), (gumbels, keys))
         trajectories = jnp.concatenate([latent0[None], latents], 0)
         actions = jnp.concatenate([jnp.zeros_like(acts[:1]), acts], 0)
         return trajectories, actions

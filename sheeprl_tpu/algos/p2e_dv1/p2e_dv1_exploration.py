@@ -102,20 +102,22 @@ def build_train_fn(
 
         def step(carry, inp):
             posterior, recurrent = carry
-            action, embed, k = inp
-            recurrent, posterior, post_ms, prior_ms = world_model.apply(
+            action, embed, eps = inp
+            recurrent, posterior, post_ms = world_model.apply(
                 {"params": wm_params},
-                posterior, recurrent, action, embed, k,
-                method=WorldModel.dynamic,
+                posterior, recurrent, action, embed, None, eps,
+                method=WorldModel.dynamic_posterior,
             )
-            return (posterior, recurrent), (recurrent, posterior, post_ms, prior_ms)
+            return (posterior, recurrent), (recurrent, posterior, post_ms)
 
-        keys = jax.random.split(key, T)
-        (_, _), (recurrents, posteriors, post_ms, prior_ms) = jax.lax.scan(
+        # pre-drawn sampling noise + batched prior stats (same as DV1/DV3)
+        noise = jax.random.normal(key, (T, B, stoch_size))
+        (_, _), (recurrents, posteriors, post_ms) = jax.lax.scan(
             step,
             (jnp.zeros((B, stoch_size)), jnp.zeros((B, rec_size))),
-            (data["actions"], embedded, keys),
+            (data["actions"], embedded, noise),
         )
+        prior_ms = wm_apply(wm_params, WorldModel.prior_stats, recurrents)
         latents = jnp.concatenate([posteriors, recurrents], -1)
         recon = wm_apply(wm_params, WorldModel.decode, latents)
         qo = {k: gaussian_independent(recon[k], 1.0, 3 if k in cnn_keys else 1) for k in recon}
@@ -156,19 +158,21 @@ def build_train_fn(
             dists = build_actor_dists(pre, is_continuous, distribution, init_std, min_std, unimix=0.0)
             return jnp.concatenate(sample_actor_actions(dists, is_continuous, k, True), -1)
 
-        def step(carry, k):
+        def step(carry, inp):
             prior, recurrent, latent = carry
-            k_img, k_act = jax.random.split(k)
+            eps_img, k_act = inp
             action = policy(latent, k_act)
             prior, recurrent = world_model.apply(
-                {"params": wm_params}, prior, recurrent, action, k_img,
+                {"params": wm_params}, prior, recurrent, action, None, eps_img,
                 method=WorldModel.imagination,
             )
             latent = jnp.concatenate([prior, recurrent], -1)
             return (prior, recurrent, latent), (latent, action)
 
+        k_eps, key = jax.random.split(key)
+        noise = jax.random.normal(k_eps, (horizon, prior.shape[0], stoch_size))
         keys = jax.random.split(key, horizon)
-        _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, latent), keys)
+        _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, latent), (noise, keys))
         return latents, acts
 
     # -- shared behaviour-learning actor loss (reference :224-330 / :332-390)

@@ -86,6 +86,13 @@ def ring_attention(
     Call inside ``shard_map`` with the sequence dim sharded: ``q``/``k``/``v``
     are the *local* blocks ``[B, T_local, H, D]`` of a global ``[B, T, H, D]``.
     Returns the local output block. K/V travel the ring; Q stays put.
+
+    Known trade-off: with ``causal=True`` and contiguous block assignment,
+    devices holding early blocks compute fully-masked score/PV matmuls on
+    ~half the ring steps (SPMD runs the same program everywhere, so the work
+    cannot be branched away). A zigzag/striped block assignment would balance
+    this; at the ring sizes the framework targets (≤ one pod slice) the
+    imbalance is bounded by 2× on the attention FLOPs only.
     """
     p = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -177,8 +184,11 @@ def ring_self_attention(
     ``seq_axis`` (and B over ``batch_axis`` when the mesh has one)."""
     if q.shape[1] % mesh.shape[seq_axis] != 0:
         raise ValueError(
-            f"sequence length {q.shape[1]} must divide over seq axis {mesh.shape[seq_axis]} "
-            "(pad with parallel.mesh.pad_to_multiple)"
+            f"sequence length {q.shape[1]} must divide over seq axis {mesh.shape[seq_axis]}. "
+            "With causal=True you can right-pad q/k/v (parallel.mesh.pad_to_multiple) and "
+            "slice the output — padded positions sit in the future and cannot affect real "
+            "ones. With causal=False there is no key-padding mask, so padding would let "
+            "every query attend to the pad keys; pad the batch layout upstream instead."
         )
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
     ba = batch_axis if batch_axis in mesh.shape else None
