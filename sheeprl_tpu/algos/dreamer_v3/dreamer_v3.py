@@ -56,6 +56,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     update_moments,
 )
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.ops.imagination import fused_imagination_supported
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.distributions import MSEDistribution, SymlogDistribution, TwoHotEncodingDistribution
@@ -165,7 +166,6 @@ def build_train_fn(
 
         # pre-draw the posterior sampling noise for the whole sequence in one
         # vectorized call; the scan body is left with add+argmax only
-        S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
         gumbels = jax.random.gumbel(key, (T, B, S, D))
         (_, _), (recurrents, posteriors, post_logits) = jax.lax.scan(
             step,
@@ -206,10 +206,72 @@ def build_train_fn(
     # actor loss via imagination (reference train :230-345)
     # ------------------------------------------------------------------
 
+    # Fused pallas rollout (ops/imagination.py): single discrete action head
+    # on TPU. The discrete objective is REINFORCE on re-evaluated log-probs,
+    # so the rollout is gradient-free and a forward-only kernel applies —
+    # every weight stays VMEM-resident across the whole horizon. Measured on
+    # v5e: 1.6x over the lax scan standalone (2.06 vs 3.28 ms), but inside
+    # the full train step the pack gathers, d-major layout fixup, and the
+    # custom-call scheduling barrier (XLA can no longer overlap its async
+    # weight prefetches across the region) give it back — 15.5 vs 15.0 ms
+    # per step. Off by default until the in-graph friction is removed.
+    use_fused = (
+        bool(cfg.algo.get("fused_imagination", False))
+        and fused_imagination_supported(is_continuous, dims)
+        and fabric.device.platform == "tpu"
+    )
+    S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
+    n_actor_layers = int(cfg.algo.actor.mlp_layers)
+    from sheeprl_tpu.fabric import compute_dtype_from_precision
+
+    compute_dtype = compute_dtype_from_precision(cfg.fabric.get("precision", "32-true"))
+
+    def fused_rollout(wm_params, actor_params, posteriors, recurrents, key):
+        from sheeprl_tpu.ops.imagination import dmajor_perm, pack_params, rollout_pallas
+
+        # the discrete rollout is gradient-free (REINFORCE objective); cut
+        # tangents at the kernel inputs — pallas_call has no JVP rule and the
+        # actor params being differentiated would otherwise be traced into it
+        z0 = sg(posteriors.reshape(-1, stoch_flat))
+        h0 = sg(recurrents.reshape(-1, rec_size))
+        latent0 = jnp.concatenate([z0, h0], -1)
+        n = z0.shape[0]
+        packed = sg(
+            pack_params(
+                actor_params, wm_params["rssm"], n_actor_layers, S, D, rec_size,
+                dtype=compute_dtype or jnp.float32,
+            )
+        )
+        kz, ka = jax.random.split(key)
+        gz = jax.random.gumbel(kz, (horizon + 1, n, stoch_flat))
+        ga = jax.random.gumbel(ka, (horizon + 1, n, dims[0]))
+        lat_dm, actions = rollout_pallas(
+            packed, z0[:, dmajor_perm(S, D)], h0, gz, ga,
+            H=horizon + 1, S=S, D=D, A=dims[0], rec=rec_size,
+            n_actor_layers=n_actor_layers, unimix=unimix, tile=256,
+        )
+        # undo the kernel's d-major latent layout: [.., D, S] -> [.., S, D]
+        z_sm = (
+            lat_dm[:horizon, :, :stoch_flat]
+            .reshape(horizon, n, D, S)
+            .transpose(0, 1, 3, 2)
+            .reshape(horizon, n, stoch_flat)
+        )
+        latents = jnp.concatenate([z_sm, lat_dm[:horizon, :, stoch_flat:]], -1)
+        traj = jnp.concatenate([latent0[None], latents], 0)
+        return sg(traj), sg(actions)
+
     def imagination_rollout(wm_params, actor_params, posteriors, recurrents, key):
         """15-step prior rollout from every (t, b) posterior. Returns
-        ``(trajectories [H+1, BT, L], actions [H+1, BT, A])`` with gradients
-        flowing through the actor's straight-through/rsample actions."""
+        ``(trajectories [H+1, BT, L], actions [H+1, BT, A])``.
+
+        Lax path: gradients flow through the actor's straight-through /
+        rsample actions (needed by the continuous dynamics-backprop
+        objective). Fused pallas path (discrete/REINFORCE only): fully
+        stop-gradient'd — valid because that objective re-evaluates
+        log-probs on ``sg(traj)``/``sg(a)`` outside the rollout."""
+        if use_fused:
+            return fused_rollout(wm_params, actor_params, posteriors, recurrents, key)
         prior = posteriors.reshape(-1, stoch_flat)
         recurrent = recurrents.reshape(-1, rec_size)
         latent0 = jnp.concatenate([prior, recurrent], -1)
@@ -245,7 +307,6 @@ def build_train_fn(
         # prior-sampling noise for the whole horizon drawn in one call; only
         # the actor's (distribution-dependent) sampling still consumes keys
         k_gum, key = jax.random.split(key)
-        S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
         gumbels = jax.random.gumbel(k_gum, (horizon, prior.shape[0], S, D))
         keys = jax.random.split(key, horizon)
         _, (latents, acts) = jax.lax.scan(step, (prior, recurrent, a0), (gumbels, keys))
