@@ -39,6 +39,9 @@ def main() -> None:
     # (Fabric.launch pins this for training runs; the bench drives the step
     # function directly)
     jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    from sheeprl_tpu.utils.utils import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
 
     cfg = compose(
         "config",

@@ -454,7 +454,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 jnp.float32(cfg.algo.clip_coef),
                 jnp.float32(cfg.algo.ent_coef),
             )
-            losses = np.asarray(losses)  # blocks → train_time is honest
+            if not timer.disabled or (aggregator and not aggregator.disabled):
+                losses = np.asarray(losses)  # blocks → train_time is honest
         play_params = to_host(params)
         train_step += world_size
 

@@ -29,7 +29,7 @@ from sheeprl_tpu.utils.registry import (
     registered_algorithm_names,
 )
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import dotdict, print_config
+from sheeprl_tpu.utils.utils import enable_persistent_compilation_cache, dotdict, print_config
 
 
 def _load_run_config(ckpt_path: str):
@@ -233,6 +233,7 @@ def _compose_from_argv(args: Optional[Sequence[str]], **kwargs) -> Any:
 
 def run(args: Optional[Sequence[str]] = None) -> None:
     """Train entrypoint (reference cli.py:265-273)."""
+    enable_persistent_compilation_cache()
     sheeprl_tpu.register_algorithms()
     cfg = _compose_from_argv(args)
     if cfg.metric.log_level > 0:
@@ -246,6 +247,7 @@ def run(args: Optional[Sequence[str]] = None) -> None:
 def evaluation(args: Optional[Sequence[str]] = None) -> None:
     """Eval entrypoint (reference cli.py:276-312): re-reads the run's persisted
     config, forces a single-device single-env setup, and keeps the seed."""
+    enable_persistent_compilation_cache()
     sheeprl_tpu.register_algorithms()
     overrides = list(args) if args is not None else sys.argv[1:]
     # the eval CLI takes checkpoint_path=... plus optional fabric overrides

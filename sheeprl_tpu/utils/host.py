@@ -62,8 +62,13 @@ class HostParamMirror:
         if not self.enabled:
             return tree
         if self._cache is None or self._calls % self.refresh_every == 0:
-            flat = np.asarray(self._pack(tree))
-            self._cache = jax.device_put(self._unravel(flat), self._host)
+            # async D2H: device_put of the packed vector to the host enqueues
+            # the transfer without blocking (over a remote-attached TPU the
+            # blocking pull costs a full tunnel round trip); the unravel runs
+            # on the CPU backend and only waits when the player first reads
+            # the params, by which time env bookkeeping has overlapped it
+            flat = jax.device_put(self._pack(tree), self._host)
+            self._cache = self._unravel(flat)
         self._calls += 1
         return self._cache
 

@@ -10,6 +10,7 @@ reverse loop.
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -180,6 +181,24 @@ def save_configs(cfg, log_dir: str) -> None:
     data = cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg)
     with open(os.path.join(log_dir, ".hydra", "config.yaml"), "w") as f:
         yaml.safe_dump(data, f, sort_keys=False)
+
+
+def enable_persistent_compilation_cache(path: str = None) -> None:
+    """Point jax's persistent XLA compilation cache at a durable directory so
+    repeated runs skip recompiles (~7 s of a short PPO benchmark; the
+    reference's torch has no compile step to amortize). Override the
+    location with ``SHEEPRL_JAX_CACHE``; set it to ``0`` to disable."""
+    loc = os.environ.get("SHEEPRL_JAX_CACHE", path) or os.path.join(
+        os.path.expanduser("~"), ".cache", "sheeprl_tpu", "xla_cache"
+    )
+    if loc == "0":
+        return
+    try:
+        os.makedirs(loc, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", loc)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except Exception as exc:  # pragma: no cover - cache is best-effort
+        warnings.warn(f"persistent compilation cache disabled: {exc}")
 
 
 def unwrap_fabric(module):  # pragma: no cover - parity shim

@@ -26,7 +26,7 @@ S, D, A, REC, DENSE, H, N = 4, 4, 5, 8, 8, 3, 8
 @pytest.fixture(scope="module")
 def tiny_agent():
     from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
-    from sheeprl_tpu.config.engine import compose
+    from sheeprl_tpu.config.engine import compose  # noqa: I001
 
     cfg = compose(
         "config",
@@ -46,9 +46,7 @@ def tiny_agent():
         ],
     )
     obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
-    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent as ba
-
-    world_model, actor, critic, params = ba(
+    world_model, actor, critic, params = build_agent(
         cfg, (A,), False, obs_space, jax.random.PRNGKey(0)
     )
     return cfg, world_model, actor, params
