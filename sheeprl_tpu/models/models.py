@@ -387,3 +387,36 @@ class MultiDecoder(nn.Module):
                 parts = [rec]
             out.update({k: v for k, v in zip(self.mlp_keys, parts)})
         return out
+
+
+def per_layer_ortho_init_weights(
+    params, gain: float = 1.0, bias: float = 0.0, key=None
+):
+    """Re-initialize every 2-D kernel in ``params`` orthogonally and set
+    biases to a constant (reference utils/model.py:141-161, which recurses
+    over torch containers; flax params are already one tree so this is a
+    single tree_map). Conv kernels are orthogonalized over the flattened
+    receptive field. Returns the new param tree."""
+    import jax
+
+    key = jax.random.PRNGKey(0) if key is None else key
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    keys = jax.random.split(key, max(len(flat), 1))
+    init = jax.nn.initializers.orthogonal(scale=gain)
+
+    def path_str(path):
+        return "/".join(getattr(p, "key", str(p)) for p in path)
+
+    new = {}
+    for k, (path, leaf) in zip(keys, flat):
+        p = path_str(path)
+        if p.endswith("kernel") and leaf.ndim >= 2:
+            flat2d = (int(np.prod(leaf.shape[:-1])), leaf.shape[-1])
+            new[p] = init(k, flat2d, leaf.dtype).reshape(leaf.shape)
+        elif p.endswith("bias"):
+            new[p] = jnp.full_like(leaf, bias)
+        else:
+            new[p] = leaf
+    import jax.tree_util as jtu
+
+    return jtu.tree_map_with_path(lambda path, leaf: new[path_str(path)], params)

@@ -154,3 +154,16 @@ def test_dv3_encoder_output_width_matches_formula():
             cnn_keys, mlp_keys, (screen, screen), 4, stages, 16
         )
         assert feat.shape == (2, want), (cnn_keys, mlp_keys, feat.shape, want)
+
+
+def test_per_layer_ortho_init_weights():
+    from sheeprl_tpu.models.models import per_layer_ortho_init_weights
+
+    mlp = MLP(hidden_sizes=(8, 8), output_dim=4)
+    params = mlp.init(jax.random.PRNGKey(0), jnp.zeros((1, 6)))["params"]
+    new = per_layer_ortho_init_weights(params, gain=2.0, bias=0.5)
+    w = np.asarray(new["Dense_1"]["kernel"])  # [8, 8] square -> exactly orthogonal*gain
+    np.testing.assert_allclose(w.T @ w, 4.0 * np.eye(8), atol=1e-4)
+    assert np.all(np.asarray(new["Dense_0"]["bias"]) == 0.5)
+    out = mlp.apply({"params": new}, jnp.ones((2, 6)))
+    assert np.isfinite(np.asarray(out)).all()
