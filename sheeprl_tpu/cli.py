@@ -234,8 +234,14 @@ def _compose_from_argv(args: Optional[Sequence[str]], **kwargs) -> Any:
 def run(args: Optional[Sequence[str]] = None) -> None:
     """Train entrypoint (reference cli.py:265-273)."""
     enable_persistent_compilation_cache()
-    sheeprl_tpu.register_algorithms()
     cfg = _compose_from_argv(args)
+    if int(cfg.fabric.get("num_nodes", 1)) > 1:
+        # must precede any backend initialization (fabric device queries,
+        # algorithm imports that build jit caches, ...)
+        from sheeprl_tpu.fabric import init_distributed
+
+        init_distributed()
+    sheeprl_tpu.register_algorithms()
     if cfg.metric.log_level > 0:
         print_config(cfg)
     if cfg.checkpoint.resume_from:

@@ -75,6 +75,42 @@ def compute_dtype_from_precision(precision: Any):
     )
 
 
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize ``jax.distributed`` for multi-host meshes (the TPU-native
+    replacement for the reference's NCCL/Gloo process groups, SURVEY §5.8).
+
+    MUST run before anything touches a jax backend (the CLI calls it right
+    after config composition when ``fabric.num_nodes > 1``); once backends
+    are up it is a no-op reporting the current state. On TPU pods the
+    runtime auto-discovers topology, so all arguments stay ``None``; jax
+    itself honors ``JAX_COORDINATOR_ADDRESS`` & friends for everything
+    else. Launch one process per host — collectives then ride ICI within a
+    slice and DCN across hosts with the same SPMD program. Returns True
+    when a multi-process runtime is (or already was) up.
+    """
+    import jax.distributed
+
+    try:
+        from jax._src import xla_bridge
+
+        backends_up = xla_bridge.backends_are_initialized()
+    except Exception:  # pragma: no cover - private-API drift
+        backends_up = True
+    if backends_up:
+        # initialize() would raise; just report what we're running under
+        return jax.process_count() > 1
+    try:
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    except Exception as exc:
+        warnings.warn(f"jax.distributed initialization failed ({exc}); running single-host")
+        return False
+    return jax.process_count() > 1
+
+
 class Fabric:
     """Mesh-owning runtime handed to every algorithm entrypoint as ``fabric``."""
 
@@ -186,9 +222,13 @@ class Fabric:
         ``jax.distributed``), so this just validates topology and calls in."""
         self._launched = True
         if self.num_nodes > 1 and jax.process_count() == 1:
+            # too late to bring up jax.distributed here (backends are already
+            # initialized by the device query in __init__) — the CLI calls
+            # init_distributed() before constructing Fabric
             warnings.warn(
                 f"fabric.num_nodes={self.num_nodes} but jax.distributed is not initialized; "
-                "running single-host"
+                "running single-host (call sheeprl_tpu.fabric.init_distributed() before "
+                "creating Fabric, or launch via the CLI which does)"
             )
         # Eager host-side work in the entrypoint (flax param init, PRNG key
         # math, staging) defaults to the local CPU: every op traced eagerly

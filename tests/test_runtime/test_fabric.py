@@ -187,3 +187,27 @@ def test_sgd_with_clipping_steps():
     updates, state = tx.update(big_grads, state, params)
     # grad clipped to norm 1 then scaled by lr
     assert float(jnp.linalg.norm(updates["w"])) == pytest.approx(0.1, rel=1e-4)
+
+
+def test_init_distributed_after_backend_is_noop(monkeypatch):
+    """Once jax backends are up (always true inside the test process),
+    init_distributed must not raise or attempt initialization — it reports
+    the current (single-process) state."""
+    import jax
+
+    from sheeprl_tpu.fabric import init_distributed
+
+    jax.devices()  # ensure backends are initialized
+    assert init_distributed() is (jax.process_count() > 1)
+
+
+def test_fabric_num_nodes_warns_single_host():
+    import warnings as w
+
+    from sheeprl_tpu.fabric import Fabric
+
+    fabric = Fabric(devices=1, accelerator="cpu", num_nodes=2)
+    with w.catch_warnings(record=True) as caught:
+        w.simplefilter("always")
+        fabric.launch(lambda f: None)
+    assert any("single-host" in str(c.message) for c in caught)
