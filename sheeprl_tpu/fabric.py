@@ -99,15 +99,17 @@ def init_distributed(
 
         backends_up = xla_bridge.backends_are_initialized()
     except Exception:  # pragma: no cover - private-API drift
-        backends_up = True
+        # assume not-yet-up: at the CLI call site that is true, and a wrong
+        # guess surfaces as initialize()'s own "must be called before any
+        # backend" error instead of silently skipping multi-host init
+        backends_up = False
     if backends_up:
         # initialize() would raise; just report what we're running under
         return jax.process_count() > 1
-    try:
-        jax.distributed.initialize(coordinator_address, num_processes, process_id)
-    except Exception as exc:
-        warnings.warn(f"jax.distributed initialization failed ({exc}); running single-host")
-        return False
+    # an explicitly requested multi-host run must not silently degrade to N
+    # independent single-host trainings racing on the same run dir — let
+    # coordinator failures propagate
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
     return jax.process_count() > 1
 
 

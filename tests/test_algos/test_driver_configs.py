@@ -1,0 +1,62 @@
+"""Driver-config smokes on real simulators (BASELINE.json configs #2/#3).
+
+Gated on the optional deps being importable — the reference gates its env
+adapters the same way (sheeprl/utils/imports.py)."""
+
+import pytest
+
+from sheeprl_tpu import cli
+
+
+def test_sac_dmc_walker_walk(tmp_path, monkeypatch):
+    pytest.importorskip("dm_control")
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        [
+            "exp=sac",
+            "env=dmc",
+            "env.id=walker_walk",
+            "dry_run=True",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "env.num_envs=1",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "env.wrapper.from_pixels=False",
+            "env.wrapper.from_vectors=True",
+            "mlp_keys.encoder=[state]",
+            "algo.learning_starts=0",
+            "per_rank_batch_size=8",
+            "buffer.memmap=False",
+            "checkpoint.save_last=False",
+            f"root_dir={tmp_path}/logs",
+            "run_name=test",
+        ]
+    )
+
+
+def test_ppo_decoupled_lunarlander_two_devices(tmp_path, monkeypatch):
+    pytest.importorskip("Box2D")
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        [
+            "exp=ppo_decoupled",
+            "env=gym",
+            "env.id=LunarLander-v3",
+            "dry_run=True",
+            "fabric.accelerator=cpu",
+            "fabric.devices=2",
+            "metric.log_level=0",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.rollout_steps=8",
+            "per_rank_batch_size=8",
+            "buffer.memmap=False",
+            "checkpoint.save_last=False",
+            "mlp_keys.encoder=[state]",
+            "cnn_keys.encoder=[]",
+            f"root_dir={tmp_path}/logs",
+            "run_name=test",
+        ]
+    )
