@@ -1,13 +1,18 @@
-"""DreamerV3 training-throughput benchmark on the attached accelerator.
+"""Dreamer-family training-throughput benchmark on the attached accelerator.
 
-Measures steady-state gradient-steps/sec of the full fused DV3 train step
-(world model + actor + critic, T=64 sequences, batch 16, the S/M preset of
-the Atari-100K recipe) — the quantity that dominates Atari-100K wall-clock
-(~100k gradient steps at ``train_every=1``).
+Measures steady-state gradient-steps/sec of the full fused train step
+(world model + actor + critic) for any Dreamer generation:
 
-Prints ONE JSON line like bench.py. Baseline: the reference trains
-Atari-100K in 14 h on a single RTX 3080 (`BASELINE.md`), i.e. ≈2.0
-grad-steps/s end-to-end.
+    python bench_dreamer.py                       # DreamerV3, Atari-100K S preset
+    python bench_dreamer.py bench.family=dv2      # DreamerV2
+    python bench_dreamer.py bench.family=dv1      # DreamerV1
+    python bench_dreamer.py fabric.precision=bf16-mixed ...
+
+Prints ONE JSON line like bench.py. The ``vs_baseline`` ratio is only
+populated for DV3 at the S/512 preset, against the reference's effective
+Atari-100K rate (14 h on a single RTX 3080 ≈ 2 grad-steps/s end-to-end,
+`BASELINE.md`); the reference's DV1/DV2 numbers are full-training
+wall-clocks on CPU and not comparable to a pure grad-step rate.
 """
 
 from __future__ import annotations
@@ -15,10 +20,17 @@ from __future__ import annotations
 import json
 import time
 
-BASELINE_STEPS_PER_SEC = 100000 / (14 * 3600)  # reference 100K wall-clock
+BASELINE_STEPS_PER_SEC = 100000 / (14 * 3600)  # reference DV3 100K wall-clock
+
+_FAMILIES = {
+    "dv1": ("dreamer_v1", "exp=dreamer_v1", False),
+    "dv2": ("dreamer_v2", "exp=dreamer_v2_ms_pacman", True),
+    "dv3": ("dreamer_v3", "exp=dreamer_v3_100k_ms_pacman", True),
+}
 
 
 def main() -> None:
+    import importlib
     import sys
 
     import gymnasium as gym
@@ -26,33 +38,35 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
-    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
-        build_optimizers_and_state,
-        build_train_fn,
-    )
     from sheeprl_tpu.config.engine import compose
+    from sheeprl_tpu.config.instantiate import instantiate
     from sheeprl_tpu.fabric import Fabric
 
     # eager work (init, key math) stays on the host — over a remote-attached
     # TPU every eager op is otherwise a ~100 ms compile+dispatch round trip
-    # (Fabric.launch pins this for training runs; the bench drives the step
-    # function directly)
     jax.config.update("jax_default_device", jax.devices("cpu")[0])
     from sheeprl_tpu.utils.utils import enable_persistent_compilation_cache
 
     enable_persistent_compilation_cache()
 
+    overrides = list(sys.argv[1:])
+    family = "dv3"
+    for ov in list(overrides):
+        if ov.startswith("bench.family="):
+            family = ov.split("=", 1)[1]
+            overrides.remove(ov)
+    module_name, exp, has_tau = _FAMILIES[family]
+
     cfg = compose(
         "config",
         overrides=[
-            "exp=dreamer_v3_100k_ms_pacman",
+            exp,
             "env=dummy",
             "env.id=discrete_dummy",
             "metric.log_level=0",
             "buffer.checkpoint=False",
             "checkpoint.every=1000000",
-            *sys.argv[1:],  # e.g. fabric.precision=bf16-mixed
+            *overrides,  # e.g. fabric.precision=bf16-mixed
         ],
     )
     fabric = Fabric(
@@ -60,24 +74,48 @@ def main() -> None:
         accelerator=cfg.fabric.get("accelerator", "auto"),
         precision=cfg.fabric.get("precision", "32-true"),
     )
+    agent_mod = importlib.import_module(f"sheeprl_tpu.algos.{module_name}.agent")
+    algo_mod = importlib.import_module(f"sheeprl_tpu.algos.{module_name}.{module_name}")
+
     obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
     # action count follows the benched preset (+bench.actions=17 for Crafter);
     # MsPacman's 9 is the default
     actions_dim = (int(cfg.get("bench", {}).get("actions", 9)),)
-    world_model, actor, critic, params = build_agent(
+    world_model, actor, critic, params = agent_mod.build_agent(
         cfg, actions_dim, False, obs_space, jax.random.PRNGKey(0)
     )
-    world_tx, actor_tx, critic_tx, agent_state = build_optimizers_and_state(cfg, params)
+    if hasattr(algo_mod, "build_optimizers_and_state"):  # DV3 (+ Moments)
+        world_tx, actor_tx, critic_tx, agent_state = algo_mod.build_optimizers_and_state(
+            cfg, params
+        )
+    else:
+        world_tx = instantiate(
+            cfg.algo.world_model.optimizer, max_grad_norm=cfg.algo.world_model.clip_gradients
+        )
+        actor_tx = instantiate(
+            cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients
+        )
+        critic_tx = instantiate(
+            cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients
+        )
+        agent_state = {
+            "params": params,
+            "opt": {
+                "world_model": world_tx.init(params["world_model"]),
+                "actor": actor_tx.init(params["actor"]),
+                "critic": critic_tx.init(params["critic"]),
+            },
+        }
     agent_state = jax.device_put(agent_state, fabric.replicated)
-    train_fn = build_train_fn(
+    train_fn = algo_mod.build_train_fn(
         world_model, actor, critic, world_tx, actor_tx, critic_tx,
         cfg, fabric, actions_dim, False,
     )
 
     T, B = int(cfg.per_rank_sequence_length), int(cfg.per_rank_batch_size)
     rng = np.random.default_rng(0)
-    # uint8 pixels: what the real training loop ships (dreamer_v3.py stages
-    # native dtypes host->HBM; the train step normalizes on device)
+    # uint8 pixels: what the real training loop ships (the train step
+    # normalizes on device)
     data = {
         "rgb": rng.integers(0, 256, size=(T, B, 3, 64, 64)).astype(np.uint8),
         "actions": np.eye(actions_dim[0], dtype=np.float32)[
@@ -92,27 +130,35 @@ def main() -> None:
         fabric.sharding(None, fabric.data_axis),
     )
 
-    # compile + warmup; keys/tau prepared outside the timed loop
-    tau_first, tau = jnp.float32(1.0), jnp.float32(0.02)
+    def step(state, key, tau):
+        if has_tau:
+            return train_fn(state, batch, key, jnp.float32(tau))
+        return train_fn(state, batch, key)
+
+    # compile + warmup; keys prepared outside the timed loop
     n = 20
     keys = [jax.random.PRNGKey(i) for i in range(n + 1)]
-    agent_state, metrics = train_fn(agent_state, batch, keys[n], tau_first)
+    agent_state, metrics = step(agent_state, keys[n], 1.0)
     float(np.asarray(metrics["Loss/world_model_loss"]))
 
     start = time.perf_counter()
     for i in range(n):
-        agent_state, metrics = train_fn(agent_state, batch, keys[i], tau)
+        agent_state, metrics = step(agent_state, keys[i], 0.02 if family == "dv3" else 0.0)
     float(np.asarray(metrics["Loss/world_model_loss"]))  # block
     steps_per_sec = n / (time.perf_counter() - start)
 
-    # the Atari-100K wall-clock baseline only compares against the default
+    # the Atari-100K wall-clock baseline only compares against DV3's default
     # (S/512) preset it was measured for
     rec_size = int(cfg.algo.world_model.recurrent_model.recurrent_state_size)
-    vs_baseline = round(steps_per_sec / BASELINE_STEPS_PER_SEC, 2) if rec_size == 512 else None
+    vs_baseline = (
+        round(steps_per_sec / BASELINE_STEPS_PER_SEC, 2)
+        if family == "dv3" and rec_size == 512
+        else None
+    )
     print(
         json.dumps(
             {
-                "metric": "dreamer_v3_grad_steps_per_sec",
+                "metric": f"dreamer_{family}_grad_steps_per_sec",
                 "recurrent_state_size": rec_size,
                 "actions": int(actions_dim[0]),
                 "precision": str(cfg.fabric.get("precision", "32-true")),
