@@ -39,7 +39,6 @@ def main() -> None:
     import numpy as np
 
     from sheeprl_tpu.config.engine import compose
-    from sheeprl_tpu.config.instantiate import instantiate
     from sheeprl_tpu.fabric import Fabric
 
     # eager work (init, key math) stays on the host — over a remote-attached
@@ -55,6 +54,8 @@ def main() -> None:
         if ov.startswith("bench.family="):
             family = ov.split("=", 1)[1]
             overrides.remove(ov)
+    if family not in _FAMILIES:
+        sys.exit(f"Unknown bench.family={family!r}; choose from {sorted(_FAMILIES)}")
     module_name, exp, has_tau = _FAMILIES[family]
 
     cfg = compose(
@@ -84,28 +85,10 @@ def main() -> None:
     world_model, actor, critic, params = agent_mod.build_agent(
         cfg, actions_dim, False, obs_space, jax.random.PRNGKey(0)
     )
-    if hasattr(algo_mod, "build_optimizers_and_state"):  # DV3 (+ Moments)
-        world_tx, actor_tx, critic_tx, agent_state = algo_mod.build_optimizers_and_state(
-            cfg, params
-        )
-    else:
-        world_tx = instantiate(
-            cfg.algo.world_model.optimizer, max_grad_norm=cfg.algo.world_model.clip_gradients
-        )
-        actor_tx = instantiate(
-            cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients
-        )
-        critic_tx = instantiate(
-            cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients
-        )
-        agent_state = {
-            "params": params,
-            "opt": {
-                "world_model": world_tx.init(params["world_model"]),
-                "actor": actor_tx.init(params["actor"]),
-                "critic": critic_tx.init(params["critic"]),
-            },
-        }
+    # every family shares the real training wiring so the bench can't drift
+    world_tx, actor_tx, critic_tx, agent_state = algo_mod.build_optimizers_and_state(
+        cfg, params
+    )
     agent_state = jax.device_put(agent_state, fabric.replicated)
     train_fn = algo_mod.build_train_fn(
         world_model, actor, critic, world_tx, actor_tx, critic_tx,
@@ -158,7 +141,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"dreamer_{family}_grad_steps_per_sec",
+                "metric": f"{module_name}_grad_steps_per_sec",
                 "recurrent_state_size": rec_size,
                 "actions": int(actions_dim[0]),
                 "precision": str(cfg.fabric.get("precision", "32-true")),

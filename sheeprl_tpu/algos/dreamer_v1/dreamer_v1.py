@@ -309,6 +309,26 @@ def build_train_fn(
     return jax.jit(shmapped, donate_argnums=(0,))
 
 
+def build_optimizers_and_state(cfg, params):
+    """The three labeled optimizers + the initial agent-state pytree
+    (shared with bench_dreamer.py so benchmarks can't drift from the real
+    training wiring)."""
+    world_tx = instantiate(
+        cfg.algo.world_model.optimizer, max_grad_norm=cfg.algo.world_model.clip_gradients
+    )
+    actor_tx = instantiate(cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients)
+    critic_tx = instantiate(cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients)
+    agent_state = {
+        "params": params,
+        "opt": {
+            "world_model": world_tx.init(params["world_model"]),
+            "actor": actor_tx.init(params["actor"]),
+            "critic": critic_tx.init(params["critic"]),
+        },
+    }
+    return world_tx, actor_tx, critic_tx, agent_state
+
+
 @register_algorithm()
 def main(fabric, cfg: Dict[str, Any]):
     world_size = fabric.world_size
@@ -378,19 +398,7 @@ def main(fabric, cfg: Dict[str, Any]):
     world_model, actor, critic, params = build_agent(
         cfg, actions_dim, is_continuous, observation_space, build_key
     )
-    world_tx = instantiate(
-        cfg.algo.world_model.optimizer, max_grad_norm=cfg.algo.world_model.clip_gradients
-    )
-    actor_tx = instantiate(cfg.algo.actor.optimizer, max_grad_norm=cfg.algo.actor.clip_gradients)
-    critic_tx = instantiate(cfg.algo.critic.optimizer, max_grad_norm=cfg.algo.critic.clip_gradients)
-    agent_state = {
-        "params": params,
-        "opt": {
-            "world_model": world_tx.init(params["world_model"]),
-            "actor": actor_tx.init(params["actor"]),
-            "critic": critic_tx.init(params["critic"]),
-        },
-    }
+    world_tx, actor_tx, critic_tx, agent_state = build_optimizers_and_state(cfg, params)
 
     expl_decay_steps = 0
     state = None
