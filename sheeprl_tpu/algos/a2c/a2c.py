@@ -40,7 +40,7 @@ from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, normalize_tensor, save_configs
+from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_tensor, save_configs
 
 
 def build_update_fn(
@@ -324,7 +324,7 @@ def main(fabric, cfg: Dict[str, Any]):
         with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
             root_key, update_key = jax.random.split(root_key)
             params, opt_state, losses = update_fn(params, opt_state, local_data, update_key)
-            losses = np.asarray(losses)
+            losses = fetch_losses_if_observed(losses, aggregator)
         play_params = to_host(params)
         train_step += world_size
 

@@ -45,7 +45,7 @@ from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import save_configs
+from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 
 
 def build_train_fn(
@@ -359,7 +359,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 agent_state, opt_states, losses = train_fn(
                     agent_state, opt_states, critic_batch, actor_batch, train_key
                 )
-                losses = np.asarray(losses)
+                losses = fetch_losses_if_observed(losses, aggregator)
                 play_actor = actor_mirror(agent_state["actor"])
             train_step += world_size
 

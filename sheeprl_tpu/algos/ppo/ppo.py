@@ -52,7 +52,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import set_lr
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_tensor, polynomial_decay, save_configs
 
 
 def make_vector_env(cfg, fabric, log_dir: str, n_envs: int):
@@ -454,8 +454,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 jnp.float32(cfg.algo.clip_coef),
                 jnp.float32(cfg.algo.ent_coef),
             )
-            if not timer.disabled or (aggregator and not aggregator.disabled):
-                losses = np.asarray(losses)  # blocks → train_time is honest
+            losses = fetch_losses_if_observed(losses, aggregator)
         play_params = to_host(params)
         train_step += world_size
 

@@ -48,7 +48,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.optim import set_lr
-from sheeprl_tpu.utils.utils import gae, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, polynomial_decay, save_configs
 
 
 @register_algorithm(decoupled=True)
@@ -318,7 +318,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     jnp.float32(cfg.algo.clip_coef),
                     jnp.float32(cfg.algo.ent_coef),
                 )
-                losses = np.asarray(losses)
+                losses = fetch_losses_if_observed(losses, aggregator)
             train_step += world_size
 
             # the new parameters become visible to the player (the reference's

@@ -46,7 +46,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.optim import set_lr
-from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_tensor, polynomial_decay, save_configs
 
 
 def build_update_fn(
@@ -449,7 +449,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 jnp.float32(cfg.algo.clip_coef),
                 jnp.float32(cfg.algo.ent_coef),
             )
-            losses = np.asarray(losses)
+            losses = fetch_losses_if_observed(losses, aggregator)
         train_step += world_size
 
         if aggregator and not aggregator.disabled:

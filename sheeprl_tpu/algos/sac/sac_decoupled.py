@@ -43,7 +43,7 @@ from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import save_configs
+from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 
 
 @register_algorithm(decoupled=True)
@@ -296,7 +296,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     agent_state, opt_states, losses = train_fn(
                         agent_state, opt_states, batch, train_key, do_ema
                     )
-                    losses = np.asarray(losses)
+                    losses = fetch_losses_if_observed(losses, aggregator)
                 train_step += world_size
                 # parameter broadcast to the player (reference :525-529)
                 param_cell["actor"] = actor_mirror(agent_state["actor"])

@@ -40,7 +40,7 @@ from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import save_configs
+from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 
 sg = jax.lax.stop_gradient
 
@@ -490,7 +490,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 agent_state, opt_states, losses = train_fn(
                     agent_state, opt_states, batch, train_key, gates
                 )
-                losses = np.asarray(losses)
+                losses = fetch_losses_if_observed(losses, aggregator)
             play_params = actor_mirror(_acting_subtree(agent_state))
             train_step += world_size
 

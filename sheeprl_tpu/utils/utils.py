@@ -183,6 +183,19 @@ def save_configs(cfg, log_dir: str) -> None:
         yaml.safe_dump(data, f, sort_keys=False)
 
 
+def fetch_losses_if_observed(losses, aggregator=None):
+    """Materialize a device loss vector only when something will read it —
+    the metric aggregator — or when the global timer is live (the blocking
+    fetch keeps Time/train_time honest). With both disabled the fetch is a
+    pure device->host round trip per update (expensive on remote-attached
+    accelerators), so the array is returned un-materialized."""
+    from sheeprl_tpu.utils.timer import timer
+
+    if not timer.disabled or (aggregator is not None and not aggregator.disabled):
+        return np.asarray(losses)
+    return losses
+
+
 def enable_persistent_compilation_cache(path: str = None) -> None:
     """Point jax's persistent XLA compilation cache at a durable directory so
     repeated runs skip recompiles (~7 s of a short PPO benchmark; the
