@@ -1,0 +1,56 @@
+"""Summarize a jax.profiler xplane trace: per-step device time + hottest ops.
+
+    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python tools/parse_xplane.py <trace_dir> [n_steps]
+
+Reads the newest ``*.xplane.pb`` under <trace_dir>/plugins/profile/*/ with
+the proto bundled in tensorflow (the tensorboard-plugin-profile converter is
+version-incompatible in this image). Self-times are computed with a stack
+sweep over the nested 'XLA Ops' events; 'Async XLA Ops' durations overlap
+and must not be summed.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import sys
+
+
+def main(trace_dir: str, n_steps: int = 5) -> None:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    files = sorted(glob.glob(f"{trace_dir}/plugins/profile/*/*.xplane.pb"))
+    if not files:
+        sys.exit(f"no xplane.pb under {trace_dir}")
+    xs = xplane_pb2.XSpace()
+    with open(files[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    plane = next(p for p in xs.planes if "TPU" in p.name or "GPU" in p.name)
+    ev_meta = plane.event_metadata
+
+    for line in plane.lines:
+        if line.name in ("Steps", "XLA Modules"):
+            total = sum(e.duration_ps for e in line.events) / 1e6
+            print(f"{line.name}: {total / max(n_steps, 1):.0f} us/step over {len(line.events)} events")
+
+    line = next(l for l in plane.lines if l.name == "XLA Ops")
+    evs = sorted(
+        (e.offset_ps, e.offset_ps + e.duration_ps, ev_meta[e.metadata_id].name)
+        for e in line.events
+    )
+    self_time: collections.Counter = collections.Counter()
+    stack = []
+    for start, end, name in evs:
+        while stack and stack[-1][1] <= start:
+            stack.pop()
+        if stack:
+            self_time[stack[-1][2]] -= min(end, stack[-1][1]) - start
+        self_time[name] += end - start
+        stack.append((start, end, name))
+    print("\ntop self-time ops (us/step):")
+    for name, ps in self_time.most_common(20):
+        print(f"  {ps / 1e6 / max(n_steps, 1):9.1f}  {name[:140]}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 5)

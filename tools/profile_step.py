@@ -1,0 +1,76 @@
+"""Capture + summarize an XLA device profile of a Dreamer train step.
+
+Usage (on the TPU host):
+
+    python tools/profile_step.py [config overrides...]
+    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python tools/parse_xplane.py /tmp/dv3_trace
+
+Wall-clock through the remote-attach tunnel is noisy (dispatch round trips,
+shared relay); the xplane's 'XLA Modules' line is the trustworthy per-step
+device time. See howto/logs_and_checkpoints.md for trace capture inside
+training runs (metric.profiler=<dir>).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(out_dir: str = "/tmp/dv3_trace") -> None:
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
+        build_optimizers_and_state,
+        build_train_fn,
+    )
+    from sheeprl_tpu.config.engine import compose
+    from sheeprl_tpu.fabric import Fabric
+
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    cfg = compose(
+        "config",
+        overrides=[
+            "exp=dreamer_v3_100k_ms_pacman",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "metric.log_level=0",
+            "checkpoint.every=1000000",
+            "fabric.precision=bf16-mixed",
+            *sys.argv[1:],
+        ],
+    )
+    fabric = Fabric(devices=1, accelerator="auto", precision=cfg.fabric.precision)
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    wm, actor, critic, params = build_agent(cfg, (9,), False, obs_space, jax.random.PRNGKey(0))
+    wtx, atx, ctx, state = build_optimizers_and_state(cfg, params)
+    state = jax.device_put(state, fabric.replicated)
+    train_fn = build_train_fn(wm, actor, critic, wtx, atx, ctx, cfg, fabric, (9,), False)
+
+    T, B = int(cfg.per_rank_sequence_length), int(cfg.per_rank_batch_size)
+    rng = np.random.default_rng(0)
+    batch = jax.device_put(
+        {
+            "rgb": jnp.asarray(rng.integers(0, 256, (T, B, 3, 64, 64)).astype(np.uint8)),
+            "actions": jnp.asarray(np.eye(9, dtype=np.float32)[rng.integers(0, 9, (T, B))]),
+            "rewards": jnp.asarray(rng.normal(size=(T, B, 1)).astype(np.float32)),
+            "dones": jnp.zeros((T, B, 1), jnp.float32),
+            "is_first": jnp.zeros((T, B, 1), jnp.float32),
+        },
+        fabric.sharding(None, fabric.data_axis),
+    )
+    state, m = train_fn(state, batch, jax.random.PRNGKey(99), jnp.float32(1.0))
+    float(np.asarray(m["Loss/world_model_loss"]))  # finish compile+warmup
+    jax.profiler.start_trace(out_dir)
+    for i in range(5):
+        state, m = train_fn(state, batch, jax.random.PRNGKey(i), jnp.float32(0.02))
+    float(np.asarray(m["Loss/world_model_loss"]))
+    jax.profiler.stop_trace()
+    print(f"trace written to {out_dir}; parse with tools/parse_xplane.py")
+
+
+if __name__ == "__main__":
+    main()
