@@ -40,7 +40,11 @@ def evaluate_p2e_dv3(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     world_model, actor, critic, _, _ = build_agent(
         cfg, actions_dim, is_continuous, observation_space, jax.random.PRNGKey(cfg.seed)
     )
-    params = jax.tree_util.tree_map(np.asarray, state["agent"]["params"])
+    from sheeprl_tpu.utils.utils import migrate_dv3_checkpoint
+
+    params = jax.tree_util.tree_map(
+        np.asarray, migrate_dv3_checkpoint(state["agent"]["params"])
+    )
     # exploration checkpoints carry actor_task; finetuning checkpoints carry actor
     actor_params = params.get("actor_task", params.get("actor"))
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)

@@ -51,7 +51,9 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
 
     resume_from_checkpoint = bool(cfg.checkpoint.resume_from)
     ckpt_path = cfg.checkpoint.resume_from or cfg.checkpoint.exploration_ckpt_path
-    state = fabric.load(ckpt_path)
+    from sheeprl_tpu.utils.utils import migrate_dv3_checkpoint
+
+    state = migrate_dv3_checkpoint(fabric.load(ckpt_path))
 
     # All the models must be equal to the ones of the exploration phase
     # (reference :48-74)

@@ -315,6 +315,11 @@ class TwoHotEncodingDistribution(Distribution):
         transfwd=symlog,
         transbwd=symexp,
     ):
+        if logits.shape[-1] < 2:
+            raise ValueError(
+                "TwoHotEncodingDistribution needs at least 2 bins to place "
+                f"probability mass between bin edges, got {logits.shape[-1]}"
+            )
         self.logits = logits
         self._dims = dims
         self.bins = jnp.linspace(low, high, logits.shape[-1], dtype=logits.dtype)

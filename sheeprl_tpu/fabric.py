@@ -304,12 +304,13 @@ class Fabric:
         optional replay-buffer snapshot kept raw at top level)."""
         import orbax.checkpoint as ocp
 
-        from sheeprl_tpu.utils.utils import conform_pytree
+        from sheeprl_tpu.utils.utils import conform_pytree, migrate_legacy_checkpoint
 
         path = os.path.abspath(path)
         with ocp.PyTreeCheckpointer() as ckptr:
             restored = ckptr.restore(path)
         if state is not None:
+            restored = migrate_legacy_checkpoint(state, restored)
             out = conform_pytree(state, restored)
             if isinstance(restored, dict):
                 for k in restored:

@@ -248,3 +248,93 @@ def conform_pytree(template: Any, restored: Any) -> Any:
             )
         return type(template)(conform_pytree(t, r) for t, r in zip(template, restored))
     return restored
+
+
+def _rename_trunk_params(value: dict) -> None:
+    mlp = value.pop("MLP_0")
+    dense = mlp.get("Dense_0", {})
+    if "kernel" in dense:
+        value["trunk_kernel"] = dense["kernel"]
+    if "bias" in dense:
+        value["trunk_bias"] = dense["bias"]
+    if "LayerNorm_0" in mlp:
+        value["trunk_ln"] = mlp["LayerNorm_0"]
+
+
+def migrate_legacy_checkpoint(template: Any, restored: Any) -> Any:
+    """Rename pre-split posterior-trunk parameters in-place and return the tree.
+
+    The DV3-family ``_RepresentationModel`` used to be a plain
+    ``_StochasticModel`` (MLP + head); splitting the embed projection out of
+    the RSSM scan renamed its parameters without changing the math — the
+    joint first-layer kernel is still stored as one ``[h+embed, hidden]``
+    matrix:
+
+    - ``representation_model/MLP_0/Dense_0/kernel`` -> ``trunk_kernel``
+    - ``representation_model/MLP_0/Dense_0/bias``   -> ``trunk_bias``
+    - ``representation_model/MLP_0/LayerNorm_0``    -> ``trunk_ln``
+
+    Checkpoints written before the rename load transparently through this
+    shim (applied by ``Fabric.load`` before structure conforming).
+
+    The walk is guided by ``template`` (the caller's live state pytree): a
+    subtree is renamed only where the template *expects* the split layout
+    (has ``trunk_kernel``) — DV1/DV2 still use the joint ``MLP_0`` layout
+    under the same ``representation_model`` key and must pass through
+    untouched.  Traversal mirrors ``conform_pytree``'s container handling so
+    optimizer moments (optax NamedTuple chains restored as lists, whose
+    mu/nu trees mirror the param structure) migrate too.
+    """
+    if isinstance(template, dict) and isinstance(restored, dict):
+        for key, t_val in template.items():
+            if key not in restored:
+                continue
+            r_val = restored[key]
+            if (
+                key == "representation_model"
+                and isinstance(t_val, dict)
+                and "trunk_kernel" in t_val
+                and isinstance(r_val, dict)
+                and "MLP_0" in r_val
+                and "trunk_kernel" not in r_val
+            ):
+                _rename_trunk_params(r_val)
+            migrate_legacy_checkpoint(t_val, r_val)
+        return restored
+    if isinstance(template, tuple) and hasattr(template, "_fields"):  # NamedTuple
+        vals = restored
+        if isinstance(restored, dict):
+            vals = [restored.get(f) for f in template._fields]
+        if isinstance(vals, (list, tuple)):
+            for t_val, r_val in zip(template, vals):
+                migrate_legacy_checkpoint(t_val, r_val)
+        return restored
+    if isinstance(template, (list, tuple)) and isinstance(restored, (list, tuple)):
+        for t_val, r_val in zip(template, restored):
+            migrate_legacy_checkpoint(t_val, r_val)
+        return restored
+    return restored
+
+
+def migrate_dv3_checkpoint(restored: Any) -> Any:
+    """Template-free variant of ``migrate_legacy_checkpoint`` for consumers
+    that load a checkpoint *known* to be DV3-family without a live state tree
+    (evaluation and P2E-DV3 finetuning load stateless, then build the agent
+    from the stored config): every ``representation_model/MLP_0`` subtree in
+    a DV3-family checkpoint is pre-rename by definition, so rename them all.
+    Do NOT use on DV1/DV2 checkpoints — their current layout looks identical.
+    """
+    if isinstance(restored, dict):
+        for key, value in restored.items():
+            if (
+                key == "representation_model"
+                and isinstance(value, dict)
+                and "MLP_0" in value
+                and "trunk_kernel" not in value
+            ):
+                _rename_trunk_params(value)
+            migrate_dv3_checkpoint(value)
+    elif isinstance(restored, (list, tuple)):
+        for value in restored:
+            migrate_dv3_checkpoint(value)
+    return restored

@@ -149,3 +149,11 @@ def test_distributions_jittable():
 
     out = f(jnp.zeros((4, 8)), jax.random.PRNGKey(0))
     assert out.shape == (4,)
+
+
+def test_two_hot_rejects_single_bin():
+    # 1-bin support has no pair of edges to spread mass across; the old
+    # searchsorted path degraded later with a ZeroDivisionError at sampling
+    # time — now it's an explicit construction-time error.
+    with pytest.raises(ValueError, match="at least 2 bins"):
+        TwoHotEncodingDistribution(jnp.zeros((3, 1)))

@@ -1,0 +1,131 @@
+"""Legacy-checkpoint migration: the DV3 posterior-trunk rename
+(_StochasticModel -> _RepresentationModel split) must load transparently
+(advisor round-1 finding on agent.py _RepresentationModel)."""
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import _RepresentationModel, _StochasticModel
+from sheeprl_tpu.utils.utils import conform_pytree, migrate_legacy_checkpoint
+
+
+def _old_and_new_params(h_size=6, embed_size=14, hidden=8, stoch=12):
+    old = _StochasticModel(hidden_size=hidden, stoch_size=stoch)
+    p_old = old.init(jax.random.PRNGKey(0), jnp.zeros((1, h_size + embed_size)))
+    new = _RepresentationModel(
+        hidden_size=hidden, stoch_size=stoch, h_size=h_size, embed_size=embed_size
+    )
+    p_new = new.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, h_size)),
+        jnp.zeros((1, embed_size)),
+        method=lambda m, h, e: m.from_projected(h, m.project_embed(e)),
+    )
+    return old, p_old, new, p_new
+
+
+def test_migrate_renames_trunk_params():
+    _, p_old, _, p_new = _old_and_new_params()
+    template = {"world_model": {"rssm": {"representation_model": p_new["params"]}}}
+    tree = {"world_model": {"rssm": {"representation_model": p_old["params"]}}}
+    migrated = migrate_legacy_checkpoint(template, tree)
+    rep = migrated["world_model"]["rssm"]["representation_model"]
+    assert "MLP_0" not in rep
+    assert rep["trunk_kernel"].shape == (20, 8)
+    assert set(rep["trunk_ln"]) == {"scale", "bias"}
+    assert set(rep["head"]) == {"kernel", "bias"}
+
+
+def test_migrated_params_are_numerically_identical():
+    h_size, embed_size = 6, 14
+    old, p_old, new, p_new = _old_and_new_params(h_size, embed_size)
+    h = jax.random.normal(jax.random.PRNGKey(1), (3, h_size))
+    embed = jax.random.normal(jax.random.PRNGKey(2), (3, embed_size))
+    want = old.apply(p_old, jnp.concatenate([h, embed], axis=-1))
+
+    rep = migrate_legacy_checkpoint(
+        {"representation_model": p_new["params"]},
+        {"representation_model": p_old["params"]},
+    )
+    got = new.apply(
+        {"params": rep["representation_model"]},
+        h,
+        embed,
+        method=lambda m, h, e: m.from_projected(h, m.project_embed(e)),
+    )
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), rtol=1e-5, atol=1e-5)
+
+
+def test_migrate_is_noop_on_current_layout():
+    _, _, _, p_new = _old_and_new_params()
+    tree = {"representation_model": dict(p_new["params"])}
+    template = {"representation_model": dict(p_new["params"])}
+    before = jax.tree_util.tree_structure(tree)
+    assert (
+        jax.tree_util.tree_structure(migrate_legacy_checkpoint(template, tree))
+        == before
+    )
+
+
+def test_migrate_leaves_dv1_dv2_layout_alone():
+    # DV1/DV2 representation models legitimately still use the joint MLP_0
+    # layout — a template that also expects MLP_0 must pass through untouched
+    # (round-1 code-review finding: the unscoped shim corrupted every valid
+    # DV2 checkpoint and then conform_pytree raised KeyError 'MLP_0').
+    _, p_old, _, _ = _old_and_new_params()
+    template = {"representation_model": jax.tree_util.tree_map(lambda x: x, p_old["params"])}
+    tree = {"representation_model": p_old["params"]}
+    migrated = migrate_legacy_checkpoint(template, tree)
+    assert "MLP_0" in migrated["representation_model"]
+    conformed = conform_pytree(template, migrated)  # must not raise
+    assert "MLP_0" in conformed["representation_model"]
+
+
+def test_migrate_traverses_optimizer_state_lists():
+    # Optax chain states are NamedTuples saved as tuples and restored by
+    # orbax as *lists*; the Adam mu/nu trees inside mirror the param
+    # structure and must migrate too (round-1 code-review finding: dict-only
+    # recursion left them in the MLP_0 layout and resume crashed).
+    _, p_old, _, p_new = _old_and_new_params()
+    ScaleByAdamState = collections.namedtuple("ScaleByAdamState", ["count", "mu", "nu"])
+    template_opt = [
+        ScaleByAdamState(
+            count=jnp.zeros((), jnp.int32),
+            mu={"representation_model": p_new["params"]},
+            nu={"representation_model": p_new["params"]},
+        )
+    ]
+    restored_opt = [
+        # orbax restores NamedTuples as field-name dicts inside lists
+        {
+            "count": np.zeros((), np.int32),
+            "mu": {"representation_model": jax.tree_util.tree_map(np.asarray, p_old["params"])},
+            "nu": {"representation_model": jax.tree_util.tree_map(np.asarray, p_old["params"])},
+        }
+    ]
+    migrated = migrate_legacy_checkpoint({"opt": template_opt}, {"opt": restored_opt})
+    for moment in ("mu", "nu"):
+        rep = migrated["opt"][0][moment]["representation_model"]
+        assert "MLP_0" not in rep and "trunk_kernel" in rep
+    conformed = conform_pytree({"opt": template_opt}, migrated)  # must not raise
+    assert isinstance(conformed["opt"][0], ScaleByAdamState)
+
+
+def test_migrate_dv3_template_free_handles_lists_and_dicts():
+    from sheeprl_tpu.utils.utils import migrate_dv3_checkpoint
+
+    _, p_old, _, _ = _old_and_new_params()
+    tree = {
+        "agent": {
+            "params": {"world_model": {"rssm": {"representation_model": dict(p_old["params"])}}},
+            "opt": [{"mu": {"representation_model": dict(p_old["params"])}}],
+        }
+    }
+    migrated = migrate_dv3_checkpoint(tree)
+    rep = migrated["agent"]["params"]["world_model"]["rssm"]["representation_model"]
+    assert "MLP_0" not in rep and "trunk_kernel" in rep
+    rep_mu = migrated["agent"]["opt"][0]["mu"]["representation_model"]
+    assert "MLP_0" not in rep_mu and "trunk_kernel" in rep_mu
