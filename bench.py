@@ -1,30 +1,87 @@
-"""Benchmark entry: PPO CartPole throughput vs the reference baseline.
+"""Benchmark entry: DreamerV3 grad-step rate + PPO CartPole wall-clock.
 
-Matches the reference's own PPO benchmark protocol (`README.md:92-104` /
-`benchmarks/benchmark.py:10-41`): 64 envs × 1024 rollout-collection steps
-(65536 policy steps) with test/logging/checkpoints disabled, wall-clock
-timed around `cli.run`. Reference baseline: 80.81 s for sheeprl v0.5.2
-(numpy buffers) on 4 CPUs (`BASELINE.md`).
+Prints TWO JSON lines; the LAST is the headline PPO number (the driver's
+parser takes the last line; the tail captures both):
 
-Two complete runs; the reported value is the min and both are disclosed in
-"runs". Run 1 pays one-time XLA compiles (amortized by the persistent cache
-across processes) plus any shared-relay latency spike; run 2 is the
-steady-state framework speed — the apples-to-apples number against torch,
-which has no compile step. Training state does not carry over (fresh envs,
-buffers, params per run).
+1. DreamerV3 S-preset (Atari-100K MsPacman config, bf16) gradient-steps/s
+   with the profiled device-ms per step — the north-star workload
+   (`BASELINE.md`: 100K policy steps in 14 h on a 3080 ≈ 2 grad-steps/s).
+   Run in a subprocess (`bench_dreamer.py`) so a failure there cannot take
+   down the headline bench.
+2. PPO CartPole, the reference's own benchmark protocol (`README.md:92-104`
+   / `benchmarks/benchmark.py:10-41`): 64 envs × 1024 rollout-collection
+   steps (65536 policy steps), test/logging/checkpoints disabled,
+   wall-clock around `cli.run`. Reference baseline: 80.81 s (v0.5.2 numpy
+   buffers, 4 CPUs, single run).
 
-Prints ONE JSON line: {"metric", "value", "unit", "runs", "vs_baseline"}.
+PPO protocol: two complete runs, both disclosed in "runs". Run 1 pays
+one-time XLA compiles (amortized by the persistent cache across processes)
+plus any shared-relay latency spikes; run 2 is steady state. "value" is the
+min; "vs_baseline_steady" rates the second run explicitly so the headline
+ratio can be read against a like-for-like steady-state number (the
+reference's 80.81 s is a single-run protocol).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 BASELINE_SECONDS = 80.81  # reference README.md:92-104, PPO 1 device
 
 
+def _dreamer_line() -> None:
+    """Run the DV3 micro-bench in a subprocess and forward its JSON line."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo, "bench_dreamer.py"),
+                "fabric.precision=bf16-mixed",
+                "bench.profile=1",
+            ],
+            cwd=repo,
+            capture_output=True,
+            text=True,
+            timeout=1200,
+        )
+        line = next(
+            (l for l in reversed(proc.stdout.splitlines()) if l.startswith("{")), None
+        )
+        if proc.returncode == 0 and line:
+            print(line, flush=True)
+        else:
+            tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+            print(
+                json.dumps(
+                    {
+                        "metric": "dreamer_v3_grad_steps_per_sec",
+                        "value": None,
+                        "error": " | ".join(tail)[-400:],
+                    }
+                ),
+                flush=True,
+            )
+    except Exception as exc:
+        print(
+            json.dumps(
+                {
+                    "metric": "dreamer_v3_grad_steps_per_sec",
+                    "value": None,
+                    "error": repr(exc)[:400],
+                }
+            ),
+            flush=True,
+        )
+
+
 def main() -> None:
+    _dreamer_line()
+
     from sheeprl_tpu import cli
 
     args = [
@@ -46,8 +103,8 @@ def main() -> None:
     ]
     # best of two runs, both disclosed: the shared axon relay adds run-to-run
     # wall-clock spikes of up to 2x that have nothing to do with the
-    # framework (see howto: the device-side step time is stable); the first
-    # run also warms the persistent XLA compilation cache
+    # framework (the device-side step time is stable); the first run also
+    # warms the persistent XLA compilation cache
     runs = []
     for _ in range(2):
         start = time.perf_counter()
@@ -62,6 +119,7 @@ def main() -> None:
                 "unit": "s",
                 "runs": runs,
                 "vs_baseline": round(BASELINE_SECONDS / elapsed, 3),
+                "vs_baseline_steady": round(BASELINE_SECONDS / runs[-1], 3),
             }
         )
     )

@@ -50,9 +50,13 @@ def main() -> None:
 
     overrides = list(sys.argv[1:])
     family = "dv3"
+    profile = False
     for ov in list(overrides):
         if ov.startswith("bench.family="):
             family = ov.split("=", 1)[1]
+            overrides.remove(ov)
+        elif ov.startswith("bench.profile="):
+            profile = ov.split("=", 1)[1].lower() in ("1", "true", "yes")
             overrides.remove(ov)
     if family not in _FAMILIES:
         sys.exit(f"Unknown bench.family={family!r}; choose from {sorted(_FAMILIES)}")
@@ -130,6 +134,31 @@ def main() -> None:
     float(np.asarray(metrics["Loss/world_model_loss"]))  # block
     steps_per_sec = n / (time.perf_counter() - start)
 
+    # wall-clock through the tunnel is noisy; with bench.profile=1 also
+    # capture an xplane trace and report the device-side per-step time (the
+    # 'XLA Modules' line — the trustworthy number)
+    device_us = None
+    if profile and jax.devices()[0].platform != "cpu":
+        import os
+        import tempfile
+
+        os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+        trace_dir = tempfile.mkdtemp(prefix=f"bench_{family}_trace_")
+        n_prof = 5
+        jax.profiler.start_trace(trace_dir)
+        for i in range(n_prof):
+            agent_state, metrics = step(
+                agent_state, keys[i], 0.02 if family == "dv3" else 0.0
+            )
+        float(np.asarray(metrics["Loss/world_model_loss"]))  # block
+        jax.profiler.stop_trace()
+        try:
+            from tools.parse_xplane import summarize
+
+            device_us = summarize(trace_dir, n_prof)["modules_us_per_step"]
+        except Exception as exc:  # missing tf proto etc. — keep the bench alive
+            print(f"# profile parse failed: {exc}", file=sys.stderr)
+
     # the Atari-100K wall-clock baseline only compares against DV3's default
     # (S/512) preset it was measured for
     rec_size = int(cfg.algo.world_model.recurrent_model.recurrent_state_size)
@@ -147,6 +176,9 @@ def main() -> None:
                 "precision": str(cfg.fabric.get("precision", "32-true")),
                 "value": round(steps_per_sec, 2),
                 "unit": "steps/s",
+                "device_ms_per_step": (
+                    round(device_us / 1e3, 2) if device_us is not None else None
+                ),
                 "vs_baseline": vs_baseline,
             }
         )

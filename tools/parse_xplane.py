@@ -16,40 +16,71 @@ import glob
 import sys
 
 
-def main(trace_dir: str, n_steps: int = 5) -> None:
+def summarize(trace_dir: str, n_steps: int = 5) -> dict:
+    """Parse the newest xplane under ``trace_dir``.
+
+    Returns ``{"modules_us_per_step", "steps_us_per_step", "top_ops"}`` —
+    ``modules_us_per_step`` (the 'XLA Modules' line) is the trustworthy
+    per-step device time; ``top_ops`` maps op name -> self-time us/step.
+    Requires ``PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python`` to be set
+    before any protobuf import (the caller's job).
+    """
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     files = sorted(glob.glob(f"{trace_dir}/plugins/profile/*/*.xplane.pb"))
     if not files:
-        sys.exit(f"no xplane.pb under {trace_dir}")
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
     xs = xplane_pb2.XSpace()
     with open(files[-1], "rb") as f:
         xs.ParseFromString(f.read())
-    plane = next(p for p in xs.planes if "TPU" in p.name or "GPU" in p.name)
+    plane = next((p for p in xs.planes if "TPU" in p.name or "GPU" in p.name), None)
+    if plane is None:
+        raise FileNotFoundError(
+            f"no TPU/GPU plane in {files[-1]} (planes: {[p.name for p in xs.planes]})"
+            " — device profiles only; the host-CPU plane has no 'XLA Modules' line"
+        )
     ev_meta = plane.event_metadata
 
+    out: dict = {"modules_us_per_step": None, "steps_us_per_step": None, "top_ops": {}}
+    denom = max(n_steps, 1)
     for line in plane.lines:
-        if line.name in ("Steps", "XLA Modules"):
-            total = sum(e.duration_ps for e in line.events) / 1e6
-            print(f"{line.name}: {total / max(n_steps, 1):.0f} us/step over {len(line.events)} events")
+        if line.name == "XLA Modules":
+            out["modules_us_per_step"] = sum(e.duration_ps for e in line.events) / 1e6 / denom
+        elif line.name == "Steps":
+            out["steps_us_per_step"] = sum(e.duration_ps for e in line.events) / 1e6 / denom
 
-    line = next(l for l in plane.lines if l.name == "XLA Ops")
-    evs = sorted(
-        (e.offset_ps, e.offset_ps + e.duration_ps, ev_meta[e.metadata_id].name)
-        for e in line.events
-    )
-    self_time: collections.Counter = collections.Counter()
-    stack = []
-    for start, end, name in evs:
-        while stack and stack[-1][1] <= start:
-            stack.pop()
-        if stack:
-            self_time[stack[-1][2]] -= min(end, stack[-1][1]) - start
-        self_time[name] += end - start
-        stack.append((start, end, name))
+    ops_line = next((l for l in plane.lines if l.name == "XLA Ops"), None)
+    if ops_line is not None:
+        evs = sorted(
+            (e.offset_ps, e.offset_ps + e.duration_ps, ev_meta[e.metadata_id].name)
+            for e in ops_line.events
+        )
+        self_time: collections.Counter = collections.Counter()
+        stack = []
+        for start, end, name in evs:
+            while stack and stack[-1][1] <= start:
+                stack.pop()
+            if stack:
+                self_time[stack[-1][2]] -= min(end, stack[-1][1]) - start
+            self_time[name] += end - start
+            stack.append((start, end, name))
+        out["top_ops"] = {
+            name: ps / 1e6 / denom for name, ps in self_time.most_common(30)
+        }
+    return out
+
+
+def main(trace_dir: str, n_steps: int = 5) -> None:
+    try:
+        s = summarize(trace_dir, n_steps)
+    except FileNotFoundError as exc:
+        sys.exit(str(exc))
+    for key in ("steps_us_per_step", "modules_us_per_step"):
+        if s[key] is not None:
+            print(f"{key}: {s[key]:.0f} us/step")
     print("\ntop self-time ops (us/step):")
-    for name, ps in self_time.most_common(20):
-        print(f"  {ps / 1e6 / max(n_steps, 1):9.1f}  {name[:140]}")
+    for name, us in list(s["top_ops"].items())[:20]:
+        print(f"  {us:9.1f}  {name[:140]}")
 
 
 if __name__ == "__main__":
