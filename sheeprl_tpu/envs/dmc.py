@@ -18,7 +18,14 @@ if not _IS_DMC_AVAILABLE:
         "dm_control is required for the DMC environments: pip install dm_control"
     )
 
+import os
 from typing import Any, Dict, Optional, Tuple
+
+# Headless hosts (no DISPLAY — every TPU VM) need an offscreen GL backend
+# for pixel observations; EGL works in this image. Respect an explicit
+# user choice.
+if "DISPLAY" not in os.environ:
+    os.environ.setdefault("MUJOCO_GL", "egl")
 
 import gymnasium as gym
 import numpy as np

@@ -106,6 +106,15 @@ def init_distributed(
     if backends_up:
         # initialize() would raise; just report what we're running under
         return jax.process_count() > 1
+    # Cross-process collectives on the CPU backend need an explicit
+    # implementation (default 'none' fails at execute time) — this is the
+    # multi-process CPU test mode, the analog of the reference's 2-process
+    # Gloo CI (reference tests/test_algos/test_algos.py:16-52; same Gloo!).
+    # The knob only affects the CPU backend, so set it unconditionally.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - knob renamed upstream
+        pass
     # an explicitly requested multi-host run must not silently degrade to N
     # independent single-host trainings racing on the same run dir — let
     # coordinator failures propagate

@@ -201,11 +201,27 @@ def enable_persistent_compilation_cache(path: str = None) -> None:
     repeated runs skip recompiles (~7 s of a short PPO benchmark; the
     reference's torch has no compile step to amortize). Override the
     location with ``SHEEPRL_JAX_CACHE``; set it to ``0`` to disable."""
-    loc = os.environ.get("SHEEPRL_JAX_CACHE", path) or os.path.join(
-        os.path.expanduser("~"), ".cache", "sheeprl_tpu", "xla_cache"
-    )
+    loc = os.environ.get("SHEEPRL_JAX_CACHE", path)
     if loc == "0":
         return
+    if not loc:
+        # Partition the default cache by host-CPU fingerprint: XLA:CPU AOT
+        # entries bake in the compile machine's ISA features, and loading
+        # them on a different host (containers migrate between rounds)
+        # warns about potential SIGILL. A TPU entry keyed the same way just
+        # recompiles once per host.
+        import hashlib
+        import platform
+
+        try:
+            with open("/proc/cpuinfo") as f:
+                flags = next((l for l in f if l.startswith("flags")), platform.machine())
+        except OSError:
+            flags = platform.machine()
+        fp = hashlib.sha1(flags.encode()).hexdigest()[:10]
+        loc = os.path.join(
+            os.path.expanduser("~"), ".cache", "sheeprl_tpu", f"xla_cache_{fp}"
+        )
     try:
         os.makedirs(loc, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", loc)

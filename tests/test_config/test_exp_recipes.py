@@ -20,7 +20,7 @@ _EXPS = sorted(
 @pytest.mark.parametrize("exp", _EXPS)
 def test_exp_recipe_composes(exp):
     overrides = [f"exp={exp}"]
-    if "finetuning" in exp:
+    if "finetuning" in exp or "fntn" in exp:
         overrides.append("checkpoint.exploration_ckpt_path=/tmp/dummy")
     cfg = compose("config", overrides=overrides)
     assert cfg.algo.name
@@ -41,3 +41,42 @@ def test_headline_recipes_carry_reference_presets():
     cfg = compose("config", overrides=["exp=dreamer_v2_ms_pacman"])
     assert cfg.buffer.type == "episode" and cfg.buffer.prioritize_ends
     assert cfg.algo.world_model.use_continues
+
+
+def test_doapp_recipes_carry_reference_presets():
+    # the four DOA++ DIAMBRA recipes (reference exp/*doapp*.yaml): L-preset
+    # model sizes, pixel+vector key sets, and the combo-discrete env setup
+    cfg = compose("config", overrides=["exp=dreamer_v3_L_doapp"])
+    assert cfg.total_steps == 5_000_000 and cfg.env.num_envs == 8
+    assert cfg.algo.world_model.recurrent_model.recurrent_state_size == 2048
+    assert cfg.algo.world_model.encoder.cnn_channels_multiplier == 64
+    assert cfg.cnn_keys.encoder == ["frame"] and "stage" in cfg.mlp_keys.encoder
+
+    cfg = compose(
+        "config", overrides=["exp=dreamer_v3_L_doapp_128px_gray_combo_discrete"]
+    )
+    assert cfg.env.screen_size == 128 and cfg.env.grayscale
+    assert cfg.env.reward_as_observation
+    assert "reward" in cfg.mlp_keys.encoder and "reward" not in cfg.mlp_keys.decoder
+    assert cfg.per_rank_batch_size == 8
+
+    cfg = compose(
+        "config",
+        overrides=["exp=p2e_dv3_expl_L_doapp_128px_gray_combo_discrete_15Mexpl_20Mstps"],
+    )
+    assert cfg.total_steps == 20_000_000 and cfg.env.num_envs == 16
+    assert cfg.algo.world_model.encoder.cnn_channels_multiplier == 48
+    assert cfg.algo.world_model.recurrent_model.recurrent_state_size == 1024
+    assert cfg.algo.learning_starts == 131072 and cfg.algo.train_every == 1
+    assert cfg.fabric.precision == "bf16-mixed"
+
+    cfg = compose(
+        "config",
+        overrides=[
+            "exp=p2e_dv3_fntn_L_doapp_64px_gray_combo_discrete_5Mstps",
+            "checkpoint.exploration_ckpt_path=/tmp/dummy",
+        ],
+    )
+    assert cfg.total_steps == 5_000_000 and cfg.per_rank_batch_size == 16
+    assert cfg.env.screen_size == 64 and cfg.env.grayscale
+    assert cfg.algo.world_model.recurrent_model.recurrent_state_size == 1024
