@@ -53,3 +53,48 @@ def test_ppo_learns_cartpole(tmp_path, monkeypatch):
     # ~10-20 random-policy episodes while still requiring real learning
     assert late > 150, f"PPO failed to learn CartPole: early={early:.1f}, late={late:.1f}"
     assert late > 3 * early, f"no improvement: early={early:.1f}, late={late:.1f}"
+
+
+def test_sac_learns_pendulum(tmp_path, monkeypatch):
+    """SAC must actually *improve* on Pendulum (reward trend over ~33k
+    policy steps) — a sign flip in the actor loss or a broken target EMA
+    passes every dry-run test but fails this."""
+    monkeypatch.chdir(tmp_path)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli.run(
+            [
+                "exp=sac",
+                "env=gym",
+                "env.id=Pendulum-v1",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "total_steps=32768",
+                "env.num_envs=4",
+                "algo.learning_starts=1000",
+                "per_rank_batch_size=128",
+                "fabric.devices=1",
+                "fabric.accelerator=cpu",
+                "metric.log_level=1",
+                "metric.log_every=100000",
+                "buffer.memmap=False",
+                "checkpoint.save_last=False",
+                "checkpoint.every=100000000",
+                "algo.run_test=False",
+                "seed=3",
+                f"root_dir={tmp_path}/logs",
+                "run_name=sac_learning_smoke",
+            ]
+        )
+    rewards = [
+        float(line.rsplit("=", 1)[-1])
+        for line in buf.getvalue().splitlines()
+        if "reward_env" in line
+    ]
+    assert len(rewards) > 30, "too few finished episodes to judge learning"
+    early = float(np.mean(rewards[:10]))
+    late = float(np.mean(rewards[-10:]))
+    # random policy: ~-1200..-1600; a learning SAC reaches > -400 by 8k
+    # steps/env. -700 leaves slack for seed noise while requiring learning.
+    assert late > -700, f"SAC failed to learn Pendulum: early={early:.1f}, late={late:.1f}"
+    assert late > early + 300, f"no improvement: early={early:.1f}, late={late:.1f}"
