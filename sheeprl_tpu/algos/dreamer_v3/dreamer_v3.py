@@ -206,15 +206,20 @@ def build_train_fn(
     # actor loss via imagination (reference train :230-345)
     # ------------------------------------------------------------------
 
-    # Fused pallas rollout (ops/imagination.py): single discrete action head
-    # on TPU. The discrete objective is REINFORCE on re-evaluated log-probs,
-    # so the rollout is gradient-free and a forward-only kernel applies —
-    # every weight stays VMEM-resident across the whole horizon. Measured on
-    # v5e: 1.6x over the lax scan standalone (2.06 vs 3.28 ms), but inside
-    # the full train step the pack gathers, d-major layout fixup, and the
-    # custom-call scheduling barrier (XLA can no longer overlap its async
-    # weight prefetches across the region) give it back — 15.5 vs 15.0 ms
-    # per step. Off by default until the in-graph friction is removed.
+    # EXPERIMENTAL fused pallas rollout (ops/imagination.py): single discrete
+    # action head on TPU. The discrete objective is REINFORCE on re-evaluated
+    # log-probs, so the rollout is gradient-free and a forward-only kernel
+    # applies — every weight stays VMEM-resident across the whole horizon.
+    # Measured on v5e: 1.6x over the lax scan standalone (2.06 vs 3.28 ms).
+    # In-graph (S preset, bf16, rbg): 14.67 vs 14.55 ms — the d-major
+    # consumer-kernel permutation (dmajor_module_params) eliminated the
+    # round-1 trajectory transpose (+0.5 -> +0.12 ms), but the remaining
+    # custom-call scheduling barrier (XLA cannot overlap async weight
+    # prefetches across the pallas region) plus the per-step pack gathers
+    # still edge out the kernel's standalone win. Off by default; flipping it
+    # on is correct and tested, just not faster. The remaining idea that
+    # could make it win: absorb the reward/critic head evaluation into the
+    # kernel so the barrier buys fewer downstream reads.
     use_fused = (
         bool(cfg.algo.get("fused_imagination", False))
         and fused_imagination_supported(is_continuous, dims)
@@ -234,7 +239,6 @@ def build_train_fn(
         # actor params being differentiated would otherwise be traced into it
         z0 = sg(posteriors.reshape(-1, stoch_flat))
         h0 = sg(recurrents.reshape(-1, rec_size))
-        latent0 = jnp.concatenate([z0, h0], -1)
         n = z0.shape[0]
         packed = sg(
             pack_params(
@@ -245,21 +249,25 @@ def build_train_fn(
         kz, ka = jax.random.split(key)
         gz = jax.random.gumbel(kz, (horizon + 1, n, stoch_flat))
         ga = jax.random.gumbel(ka, (horizon + 1, n, dims[0]))
+        z0_dm = z0[:, dmajor_perm(S, D)]
         lat_dm, actions = rollout_pallas(
-            packed, z0[:, dmajor_perm(S, D)], h0, gz, ga,
+            packed, z0_dm, h0, gz, ga,
             H=horizon + 1, S=S, D=D, A=dims[0], rec=rec_size,
             n_actor_layers=n_actor_layers, unimix=unimix, tile=256,
         )
-        # undo the kernel's d-major latent layout: [.., D, S] -> [.., S, D]
-        z_sm = (
-            lat_dm[:horizon, :, :stoch_flat]
-            .reshape(horizon, n, D, S)
-            .transpose(0, 1, 3, 2)
-            .reshape(horizon, n, stoch_flat)
-        )
-        latents = jnp.concatenate([z_sm, lat_dm[:horizon, :, stoch_flat:]], -1)
-        traj = jnp.concatenate([latent0[None], latents], 0)
-        return sg(traj), sg(actions)
+        # keep the kernel's d-major latent layout: instead of physically
+        # transposing the [H, N, S*D] trajectory back to s-major (a 60 MB
+        # copy at the S preset), every downstream consumer's *first-layer
+        # kernel z-rows* are permuted to d-major (a few [S*D, units] weight
+        # gathers — see _dmajor_params)
+        latent0_dm = jnp.concatenate([z0_dm, h0], -1)
+        traj_dm = jnp.concatenate([latent0_dm[None], lat_dm[:horizon]], 0)
+        return sg(traj_dm), sg(actions)
+
+    def _dmajor_params(mparams):
+        from sheeprl_tpu.ops.imagination import dmajor_module_params
+
+        return dmajor_module_params(mparams, S, D)
 
     def imagination_rollout(wm_params, actor_params, posteriors, recurrents, key):
         """15-step prior rollout from every (t, b) posterior. Returns
@@ -319,14 +327,22 @@ def build_train_fn(
         traj, imagined_actions = imagination_rollout(
             wm_params, actor_params, posteriors, recurrents, key
         )
+        # fused path: traj latents are d-major; permute each consumer's
+        # first-layer kernel instead of transposing the trajectory
+        actor_c, critic_c, wm_rm, wm_cm = actor_params, critic_params, wm_params, wm_params
+        if use_fused:
+            actor_c = _dmajor_params(actor_params)
+            critic_c = _dmajor_params(critic_params)
+            wm_rm = {**wm_params, "reward_model": _dmajor_params(wm_params["reward_model"])}
+            wm_cm = {**wm_params, "continue_model": _dmajor_params(wm_params["continue_model"])}
         predicted_values = TwoHotEncodingDistribution(
-            critic.apply({"params": critic_params}, traj), dims=1
+            critic.apply({"params": critic_c}, traj), dims=1
         ).mean
         predicted_rewards = TwoHotEncodingDistribution(
-            wm_apply(wm_params, WorldModel.reward_logits, traj), dims=1
+            wm_apply(wm_rm, WorldModel.reward_logits, traj), dims=1
         ).mean
         continues = continue_distribution(
-            wm_apply(wm_params, WorldModel.continue_logits, traj)
+            wm_apply(wm_cm, WorldModel.continue_logits, traj)
         ).base.mode
         continues = jnp.concatenate([true_continue[None], continues[1:]], 0)
 
@@ -335,7 +351,7 @@ def build_train_fn(
         )
         discount = sg(jnp.cumprod(continues * gamma, axis=0) / gamma)
 
-        pre = actor.apply({"params": actor_params}, sg(traj))
+        pre = actor.apply({"params": actor_c}, sg(traj))
         policies = build_actor_dists(
             pre, is_continuous, distribution, init_std, min_std, unimix
         )
@@ -375,11 +391,13 @@ def build_train_fn(
     # ------------------------------------------------------------------
 
     def critic_loss_fn(critic_params, target_params, traj, lambda_values, discount):
+        critic_c = _dmajor_params(critic_params) if use_fused else critic_params
+        target_c = _dmajor_params(target_params) if use_fused else target_params
         qv = TwoHotEncodingDistribution(
-            critic.apply({"params": critic_params}, traj[:-1]), dims=1
+            critic.apply({"params": critic_c}, traj[:-1]), dims=1
         )
         target_values = TwoHotEncodingDistribution(
-            critic.apply({"params": target_params}, traj[:-1]), dims=1
+            critic.apply({"params": target_c}, traj[:-1]), dims=1
         ).mean
         value_loss = -qv.log_prob(lambda_values) - qv.log_prob(sg(target_values))
         return jnp.mean(value_loss * discount[:-1, ..., 0])

@@ -143,3 +143,48 @@ def test_supported_predicate():
     assert fused_imagination_supported(False, (9,))
     assert not fused_imagination_supported(True, (6,))
     assert not fused_imagination_supported(False, (3, 4))
+
+
+def test_dmajor_module_params_matches_smajor_apply():
+    # consumer-side counterpart of the kernel's d-major layout: applying the
+    # row-permuted module to a d-major latent must equal the original module
+    # on the s-major latent (this is what lets the train step skip the
+    # trajectory transpose entirely)
+    import flax.linen as nn
+
+    from sheeprl_tpu.models.models import MLP
+    from sheeprl_tpu.ops.imagination import dmajor_module_params, dmajor_perm
+
+    S, D, rec, units = 4, 6, 8, 16
+
+    class Head(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = MLP(hidden_sizes=[units, units], layer_norm=True, bias=False)(x)
+            return nn.Dense(5, name="head")(x)
+
+    key = jax.random.PRNGKey(0)
+    m = Head()
+    x_sm = jax.random.normal(key, (7, S * D + rec))
+    params = m.init(key, x_sm)["params"]
+
+    perm = dmajor_perm(S, D)
+    x_dm = jnp.concatenate([x_sm[:, :S * D][:, perm], x_sm[:, S * D:]], axis=-1)
+    want = m.apply({"params": params}, x_sm)
+    got = m.apply({"params": dmajor_module_params(params, S, D)}, x_dm)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), rtol=1e-5, atol=1e-5)
+
+    # gradients scatter back onto the ORIGINAL layout: d/dk of the permuted
+    # apply equals d/dk of the plain apply
+    def loss_sm(p):
+        return jnp.sum(m.apply({"params": p}, x_sm) ** 2)
+
+    def loss_dm(p):
+        return jnp.sum(m.apply({"params": dmajor_module_params(p, S, D)}, x_dm) ** 2)
+
+    g_sm = jax.grad(loss_sm)(params)
+    g_dm = jax.grad(loss_dm)(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5),
+        g_sm, g_dm,
+    )
