@@ -1,0 +1,43 @@
+"""Print the observation space an agent would see for a given env config
+(reference ``examples/observation_space.py``):
+
+    python examples/observation_space.py agent=dreamer_v3 env=gym env.id=CartPole-v1
+    python examples/observation_space.py agent=ppo env=dummy env.id=discrete_dummy cnn_keys.encoder=[rgb]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gymnasium as gym
+
+from sheeprl_tpu.config.engine import compose
+from sheeprl_tpu.utils.env import make_env
+
+_KNOWN_AGENTS = {
+    "a2c", "dreamer_v1", "dreamer_v2", "dreamer_v3", "droq",
+    "p2e_dv1", "p2e_dv2", "p2e_dv3", "ppo", "ppo_decoupled",
+    "ppo_recurrent", "sac", "sac_ae", "sac_decoupled",
+}
+
+
+def main() -> None:
+    cfg = compose("env_config", overrides=list(sys.argv[1:]))
+    if cfg.agent not in _KNOWN_AGENTS:
+        raise ValueError(
+            f"Invalid selected agent `{cfg.agent}`: check the available agents "
+            "with `python -m sheeprl_tpu.available_agents`"
+        )
+    cfg.env.capture_video = False
+    env: gym.Env = make_env(cfg, cfg.seed, 0, "env_logs")()
+    print()
+    print(f"Observation space of `{cfg.env.id}` environment for `{cfg.agent}` agent:")
+    print(env.observation_space)
+    env.close()
+
+
+if __name__ == "__main__":
+    main()

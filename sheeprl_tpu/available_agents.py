@@ -1,0 +1,43 @@
+"""Print every registered algorithm and its evaluation entrypoint
+(reference ``sheeprl/available_agents.py``):
+
+    python -m sheeprl_tpu.available_agents
+"""
+
+if __name__ == "__main__":
+    from rich.console import Console
+    from rich.table import Table
+
+    import sheeprl_tpu
+    from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry
+
+    sheeprl_tpu.register_algorithms()
+
+    table = Table(title="SheepRL-TPU Agents")
+    table.add_column("Module")
+    table.add_column("Algorithm")
+    table.add_column("Entrypoint")
+    table.add_column("Decoupled")
+    table.add_column("Evaluated by")
+
+    for module, implementations in algorithm_registry.items():
+        for algo in implementations:
+            evaluation_entrypoint = "Undefined"
+            # evaluations register under their own module (the evaluate file);
+            # match by algorithm name across the whole evaluation registry
+            for ev_module, evaluations in evaluation_registry.items():
+                for evaluation in evaluations:
+                    if algo["name"] == evaluation["name"]:
+                        evaluation_entrypoint = f"{ev_module}.{evaluation['entrypoint']}"
+                        break
+                if evaluation_entrypoint != "Undefined":
+                    break
+            table.add_row(
+                module,
+                algo["name"],
+                algo["entrypoint"],
+                str(algo["decoupled"]),
+                evaluation_entrypoint,
+            )
+
+    Console().print(table)

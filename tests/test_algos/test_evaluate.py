@@ -79,3 +79,22 @@ def test_eval_cli_requires_checkpoint_path(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     with pytest.raises(ValueError):
         cli.evaluation(["fabric.accelerator=cpu"])
+
+
+def test_eval_cli_droq_delegates_to_sac(tmp_path, monkeypatch):
+    # droq/evaluate.py is a pure delegate to SAC's evaluation (the actor IS a
+    # SAC actor; the reference does the same semantically) — pin that the
+    # delegation actually round-trips a DroQ checkpoint end-to-end.
+    monkeypatch.chdir(tmp_path)
+    ckpt = _train(
+        tmp_path,
+        [
+            "exp=droq",
+            "env=gym",
+            "env.id=Pendulum-v1",
+            "per_rank_batch_size=4",
+            "algo.learning_starts=0",
+            "mlp_keys.encoder=[state]",
+        ],
+    )
+    cli.evaluation([f"checkpoint_path={ckpt}", "fabric.accelerator=cpu"])
