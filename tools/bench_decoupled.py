@@ -1,4 +1,4 @@
-"""Coupled vs decoupled PPO throughput on the virtual CPU mesh.
+"""Coupled vs decoupled PPO/SAC throughput on the virtual CPU mesh.
 
 Measures the player-thread/double-buffering win (round-1 VERDICT #10): the
 decoupled runner overlaps env stepping with the update program, so at
@@ -6,7 +6,9 @@ identical configs its wall-clock should beat the strictly-alternating
 coupled loop whenever env interaction is a non-trivial fraction of the
 update period.
 
-    python tools/bench_decoupled.py [total_steps] [devices]
+    python tools/bench_decoupled.py [total_steps] [devices] [family]
+
+``family`` is ``ppo`` (default, CartPole) or ``sac`` (Pendulum).
 
 Runs each variant once and prints one JSON line per variant plus a summary
 line with the speedup. Uses the 8-virtual-device CPU mesh (the same
@@ -28,6 +30,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> None:
     total_steps = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
     devices = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+    family = sys.argv[3] if len(sys.argv) > 3 else "ppo"
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
@@ -40,12 +43,11 @@ def main() -> None:
 
     common = [
         "env=gym",
-        "env.id=CartPole-v1",
+        "env.id=CartPole-v1" if family == "ppo" else "env.id=Pendulum-v1",
         "env.sync_env=True",
         "env.capture_video=False",
         f"total_steps={total_steps}",
         "env.num_envs=8",
-        "algo.rollout_steps=128",
         "per_rank_batch_size=64",
         f"fabric.devices={devices}",
         "fabric.accelerator=cpu",
@@ -56,8 +58,12 @@ def main() -> None:
         "algo.run_test=False",
         "seed=7",
     ]
+    if family == "ppo":
+        common.append("algo.rollout_steps=128")
+    else:
+        common.append("algo.learning_starts=1000")
     results = {}
-    for exp in ("ppo", "ppo_decoupled"):
+    for exp in (family, f"{family}_decoupled"):
         start = time.perf_counter()
         cli.run([f"exp={exp}", f"exp_name=bench_{exp}", *common])
         elapsed = time.perf_counter() - start
@@ -65,7 +71,7 @@ def main() -> None:
         print(
             json.dumps(
                 {
-                    "metric": f"{exp}_cartpole_{total_steps}_steps",
+                    "metric": f"{exp}_{'cartpole' if family == 'ppo' else 'pendulum'}_{total_steps}_steps",
                     "value": round(elapsed, 2),
                     "unit": "s",
                     "devices": devices,
@@ -76,11 +82,11 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "decoupled_overlap_speedup",
-                "value": round(results["ppo"] / results["ppo_decoupled"], 3),
+                "metric": f"{family}_decoupled_overlap_speedup",
+                "value": round(results[family] / results[f"{family}_decoupled"], 3),
                 "unit": "x",
-                "coupled_s": round(results["ppo"], 2),
-                "decoupled_s": round(results["ppo_decoupled"], 2),
+                "coupled_s": round(results[family], 2),
+                "decoupled_s": round(results[f"{family}_decoupled"], 2),
             }
         )
     )
