@@ -30,11 +30,17 @@ import subprocess
 import sys
 import time
 
+# Silence XLA's C++ warning spam (e.g. the per-process `cpu_aot_loader`
+# persistent-cache notes): each in-process run below would otherwise emit
+# ~2.5 KB of stderr that evicts the JSON evidence lines from a truncated
+# log tail. Must be set before jax initializes its backends.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 BASELINE_SECONDS = 80.81  # reference README.md:92-104, PPO 1 device
 
 
-def _dreamer_line() -> None:
-    """Run the DV3 micro-bench in a subprocess and forward its JSON line."""
+def _dreamer_line() -> str:
+    """Run the DV3 micro-bench in a subprocess and return its JSON line."""
     repo = os.path.dirname(os.path.abspath(__file__))
     try:
         proc = subprocess.run(
@@ -53,34 +59,31 @@ def _dreamer_line() -> None:
             (l for l in reversed(proc.stdout.splitlines()) if l.startswith("{")), None
         )
         if proc.returncode == 0 and line:
-            print(line, flush=True)
-        else:
-            tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
-            print(
-                json.dumps(
-                    {
-                        "metric": "dreamer_v3_grad_steps_per_sec",
-                        "value": None,
-                        "error": " | ".join(tail)[-400:],
-                    }
-                ),
-                flush=True,
-            )
+            return line
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+        return json.dumps(
+            {
+                "metric": "dreamer_v3_grad_steps_per_sec",
+                "value": None,
+                "error": " | ".join(tail)[-400:],
+            }
+        )
     except Exception as exc:
-        print(
-            json.dumps(
-                {
-                    "metric": "dreamer_v3_grad_steps_per_sec",
-                    "value": None,
-                    "error": repr(exc)[:400],
-                }
-            ),
-            flush=True,
+        return json.dumps(
+            {
+                "metric": "dreamer_v3_grad_steps_per_sec",
+                "value": None,
+                "error": repr(exc)[:400],
+            }
         )
 
 
 def main() -> None:
-    _dreamer_line()
+    # print the DV3 line immediately (so a PPO crash cannot lose it) AND
+    # re-print it after the PPO runs: the driver records a truncated *tail*
+    # of this output, so the evidence lines must be the last two lines
+    dv3_line = _dreamer_line()
+    print(dv3_line, flush=True)
 
     from sheeprl_tpu import cli
 
@@ -111,6 +114,7 @@ def main() -> None:
         cli.run(args)
         runs.append(round(time.perf_counter() - start, 2))
     elapsed = min(runs)
+    print(dv3_line, flush=True)
     print(
         json.dumps(
             {
@@ -121,7 +125,8 @@ def main() -> None:
                 "vs_baseline": round(BASELINE_SECONDS / elapsed, 3),
                 "vs_baseline_steady": round(BASELINE_SECONDS / runs[-1], 3),
             }
-        )
+        ),
+        flush=True,
     )
 
 

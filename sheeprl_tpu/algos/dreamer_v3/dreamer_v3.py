@@ -665,6 +665,19 @@ def main(fabric, cfg: Dict[str, Any]):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
         buffer_cls=SequentialReplayBuffer,
     )
+    # Device-resident ring: transitions stream to HBM once at collection and
+    # train batches are gathered on device — no per-gradient-step host→device
+    # pixel upload (data/device_ring.py). Single-mesh-device path for now.
+    use_device_ring = bool(cfg.buffer.get("device_ring", False)) and world_size == 1
+    if cfg.buffer.get("device_ring", False) and not use_device_ring:
+        warnings.warn(
+            "buffer.device_ring=True is only supported on single-device meshes; "
+            f"falling back to host-staged batches (world_size={world_size})."
+        )
+    if use_device_ring:
+        from sheeprl_tpu.data.device_ring import DeviceRingReplay
+
+        rb = DeviceRingReplay(rb, device=fabric.device, seed=cfg.seed)
     if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
         rb.load_state_dict(state["rb"])
 
@@ -776,10 +789,13 @@ def main(fabric, cfg: Dict[str, Any]):
         if "restart_on_exception" in infos:
             for i, env_roe in enumerate(infos["restart_on_exception"]):
                 if env_roe and not dones[i]:
-                    sub = rb.buffer[i]
-                    last_idx = (sub._pos - 1) % sub.buffer_size
-                    sub["dones"][last_idx] = np.ones_like(sub["dones"][last_idx])
-                    sub["is_first"][last_idx] = np.zeros_like(sub["is_first"][last_idx])
+                    if use_device_ring:
+                        rb.force_done_last(i)
+                    else:
+                        sub = rb.buffer[i]
+                        last_idx = (sub._pos - 1) % sub.buffer_size
+                        sub["dones"][last_idx] = np.ones_like(sub["dones"][last_idx])
+                        sub["is_first"][last_idx] = np.zeros_like(sub["is_first"][last_idx])
                     step_data["is_first"][0, i] = 1.0
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
@@ -849,11 +865,48 @@ def main(fabric, cfg: Dict[str, Any]):
                 if update == learning_starts
                 else cfg.algo.per_rank_gradient_steps
             )
-            local_data = rb.sample(
-                cfg.per_rank_batch_size * world_size,
-                sequence_length=cfg.per_rank_sequence_length,
-                n_samples=n_samples,
+            if use_device_ring:
+                local_data = rb.sample_device(
+                    cfg.per_rank_batch_size * world_size,
+                    sequence_length=cfg.per_rank_sequence_length,
+                    n_samples=n_samples,
+                )
+            else:
+                local_data = rb.sample(
+                    cfg.per_rank_batch_size * world_size,
+                    sequence_length=cfg.per_rank_sequence_length,
+                    n_samples=n_samples,
+                )
+            # On a bandwidth-limited host link every blocking device→host
+            # metric fetch costs a round trip; fetch_train_metrics_every=k
+            # samples the train metrics every k-th burst (always on the last
+            # burst before a log boundary), 1 = every burst (default),
+            # 0 = log boundaries only. Log boundaries are crossed by policy
+            # steps, not bursts, so look ahead one train_every window: if the
+            # threshold falls before the next burst, this is the burst whose
+            # metrics that log will see.
+            burst_updates = max(int(cfg.algo.train_every) // policy_steps_per_update, 1)
+            will_log = cfg.metric.log_level > 0 and (
+                policy_step - last_log + int(cfg.algo.train_every) >= cfg.metric.log_every
+                # the run's last burst feeds the final update==num_updates log
+                # even when that update itself is not a burst
+                or update + burst_updates > num_updates
             )
+            fetch_every = int(cfg.metric.get("fetch_train_metrics_every", 1))
+            fetch_metrics = (
+                aggregator is not None
+                and not aggregator.disabled
+                and (
+                    will_log
+                    or (fetch_every > 0 and (train_step // world_size) % fetch_every == 0)
+                )
+            )
+            # NOTE: when the metric fetch below is skipped, nothing in this
+            # block waits on the device — train_fn dispatch is async, so the
+            # timer records dispatch time and the device compute overlaps the
+            # next acting phase (that overlap is the point on a remote-
+            # attached chip). Time/sps_train is only device-accurate on
+            # bursts that fetch.
             with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
                 metrics = None
                 for i in range(n_samples):
@@ -861,19 +914,25 @@ def main(fabric, cfg: Dict[str, Any]):
                         tau = 1.0 if per_rank_gradient_steps == 0 else cfg.algo.critic.tau
                     else:
                         tau = 0.0
-                    # ship native dtypes (uint8 pixels = 4x less than f32
-                    # over the host->HBM link) straight to the sharding; the
-                    # train step normalizes on device
-                    batch = jax.device_put(
-                        {k: v[i] for k, v in local_data.items()}, data_sharding
-                    )
+                    if use_device_ring:
+                        # already on device: slice the sample dim in place
+                        batch = {k: v[i] for k, v in local_data.items()}
+                    else:
+                        # ship native dtypes (uint8 pixels = 4x less than f32
+                        # over the host->HBM link) straight to the sharding;
+                        # the train step normalizes on device
+                        batch = jax.device_put(
+                            {k: v[i] for k, v in local_data.items()}, data_sharding
+                        )
                     root_key, train_key = jax.random.split(root_key)
                     agent_state, metrics = train_fn(
                         agent_state, batch, train_key, jnp.float32(tau)
                     )
                     per_rank_gradient_steps += 1
-                if metrics is not None:
+                if metrics is not None and fetch_metrics:
                     metrics = jax.device_get(metrics)
+                else:
+                    metrics = None
                 play_wm = wm_mirror(agent_state["params"]["world_model"])
                 play_actor = actor_mirror(agent_state["params"]["actor"])
                 train_step += world_size

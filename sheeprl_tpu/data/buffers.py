@@ -336,6 +336,35 @@ class SequentialReplayBuffer(ReplayBuffer):
     the end of storage.
     """
 
+    def plan_starts(
+        self,
+        total: int,
+        sequence_length: int,
+        effective_len: Optional[int] = None,
+        rng: Optional[np.random.Generator] = None,
+    ) -> np.ndarray:
+        """Draw ``total`` valid sequence start indices — the single source of
+        the never-straddle-the-write-head semantics, shared by host sampling
+        and the device-ring gather planner (data/device_ring.py)."""
+        rng = self._rng if rng is None else rng
+        effective_len = sequence_length if effective_len is None else effective_len
+        if self._full:
+            max_offset = self._buffer_size - effective_len
+            if max_offset < 0:
+                raise ValueError(
+                    f"Cannot sample a sequence of length {sequence_length} from a buffer of size "
+                    f"{self._buffer_size}"
+                )
+            offsets = rng.integers(0, max_offset + 1, size=total)
+            return (self._pos + offsets) % self._buffer_size
+        max_start = self._pos - effective_len
+        if max_start < 0:
+            raise ValueError(
+                f"Cannot sample a sequence of length {sequence_length}: the buffer only "
+                f"contains {self._pos} steps"
+            )
+        return rng.integers(0, max_start + 1, size=total)
+
     def sample(
         self,
         batch_size: int,
@@ -355,23 +384,7 @@ class SequentialReplayBuffer(ReplayBuffer):
             raise ValueError("No sample has been added to the buffer")
         effective_len = sequence_length + (1 if sample_next_obs else 0)
         total = batch_size * n_samples
-        if self._full:
-            max_offset = self._buffer_size - effective_len
-            if max_offset < 0:
-                raise ValueError(
-                    f"Cannot sample a sequence of length {sequence_length} from a buffer of size "
-                    f"{self._buffer_size}"
-                )
-            offsets = self._rng.integers(0, max_offset + 1, size=total)
-            starts = (self._pos + offsets) % self._buffer_size
-        else:
-            max_start = self._pos - effective_len
-            if max_start < 0:
-                raise ValueError(
-                    f"Cannot sample a sequence of length {sequence_length}: the buffer only "
-                    f"contains {self._pos} steps"
-                )
-            starts = self._rng.integers(0, max_start + 1, size=total)
+        starts = self.plan_starts(total, sequence_length, effective_len)
         e_idx = self._rng.integers(0, self._n_envs, size=total)
         # [total, seq_len] absolute time indices (wrap-around safe)
         seq = (starts[:, None] + np.arange(sequence_length)[None, :]) % self._buffer_size
@@ -798,16 +811,24 @@ class EnvIndependentReplayBuffer:
                 validate_args=validate_args,
             )
 
+    def pick_envs(
+        self, batch_size: int, rng: Optional[np.random.Generator] = None
+    ) -> Tuple[List[int], np.ndarray]:
+        """Balanced env mix over the sub-buffers that hold data — shared by
+        host sampling and the device-ring gather planner."""
+        rng = self._rng if rng is None else rng
+        with_data = [i for i, b in enumerate(self._buf) if not b.empty and (b.full or b._pos > 0)]
+        if not with_data:
+            raise ValueError("No sample has been added to the buffer")
+        picks = rng.integers(0, len(with_data), size=batch_size)
+        return with_data, np.bincount(picks, minlength=len(with_data))
+
     def sample(self, batch_size: int, n_samples: int = 1, **kwargs: Any) -> Dict[str, np.ndarray]:
         if batch_size <= 0 or n_samples <= 0:
             raise ValueError(
                 f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
             )
-        with_data = [i for i, b in enumerate(self._buf) if not b.empty and (b.full or b._pos > 0)]
-        if not with_data:
-            raise ValueError("No sample has been added to the buffer")
-        picks = self._rng.integers(0, len(with_data), size=batch_size)
-        counts = np.bincount(picks, minlength=len(with_data))
+        with_data, counts = self.pick_envs(batch_size)
         parts = []
         for j, env in enumerate(with_data):
             if counts[j] == 0:

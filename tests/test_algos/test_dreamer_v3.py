@@ -124,6 +124,29 @@ def test_dreamer_v3_temporal_train(tmp_path, monkeypatch):
     )
 
 
+def test_dreamer_v3_device_ring_train(tmp_path, monkeypatch):
+    """buffer.device_ring=True: batches are gathered from the device-resident
+    replay mirror instead of staged from host per gradient step."""
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        dv3_args(
+            tmp_path,
+            [
+                "fabric.devices=1",
+                "env.id=discrete_dummy",
+                "dry_run=False",
+                "total_steps=16",
+                "per_rank_sequence_length=4",
+                "buffer.size=128",
+                "buffer.device_ring=True",
+                "algo.learning_starts=8",
+                "algo.train_every=4",
+                "metric.fetch_train_metrics_every=0",
+            ],
+        )
+    )
+
+
 def test_dreamer_v3_checkpoint_resume(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     cli.run(

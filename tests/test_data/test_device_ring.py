@@ -1,0 +1,157 @@
+"""Device-resident replay ring: the device mirror must agree with the host
+buffer byte-for-byte, under wrap-around, per-env routing, lazy flushing, and
+checkpoint restore (sheeprl_tpu/data/device_ring.py)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer, _as_np
+from sheeprl_tpu.data.device_ring import DeviceRingReplay
+
+
+def _make(buffer_size=16, n_envs=2, seed=3):
+    host = EnvIndependentReplayBuffer(
+        buffer_size,
+        n_envs,
+        obs_keys=("rgb",),
+        buffer_cls=SequentialReplayBuffer,
+    )
+    return DeviceRingReplay(host, seed=seed)
+
+
+def _step(i, n_envs, pix=4):
+    return {
+        "rgb": np.full((1, n_envs, 3, pix, pix), i % 256, np.uint8),
+        "actions": np.full((1, n_envs, 2), i, np.float32),
+        "rewards": np.full((1, n_envs, 1), float(i), np.float32),
+        "dones": np.zeros((1, n_envs, 1), np.float32),
+        "is_first": np.zeros((1, n_envs, 1), np.float32),
+    }
+
+
+def _ring_equals_host(ring):
+    """Flush, then compare the full device ring contents to the host buffer."""
+    ring._flush()
+    for env, sub in enumerate(ring.host.buffer):
+        if sub._buf is None:
+            continue
+        n_rows = sub.buffer_size if sub.full else sub._pos
+        for k, v in sub._buf.items():
+            host_arr = _as_np(v)[:n_rows, 0]
+            dev_arr = np.asarray(ring._buf[k])[:n_rows, env]
+            np.testing.assert_array_equal(dev_arr, host_arr, err_msg=f"{k} env {env}")
+
+
+def test_mirror_matches_host_simple():
+    ring = _make()
+    for i in range(10):
+        ring.add(_step(i, 2))
+    _ring_equals_host(ring)
+
+
+def test_mirror_matches_host_wraparound():
+    ring = _make(buffer_size=8)
+    for i in range(21):  # wraps 2.5x
+        ring.add(_step(i, 2))
+    _ring_equals_host(ring)
+    assert all(b.full for b in ring.host.buffer)
+
+
+def test_env_idx_routing():
+    ring = _make(buffer_size=8, n_envs=3)
+    for i in range(4):
+        ring.add(_step(i, 3))
+    # route an extra (reset) row to env 1 only — positions must diverge
+    one = {k: v[:, 1:2] for k, v in _step(99, 3).items()}
+    ring.add(one, env_idxes=[1])
+    _ring_equals_host(ring)
+    assert ring.host.buffer[1]._pos == ring.host.buffer[0]._pos + 1
+
+
+def test_sample_device_layout_and_content():
+    ring = _make(buffer_size=32, n_envs=2)
+    for i in range(32):
+        ring.add(_step(i, 2))
+    out = ring.sample_device(batch_size=4, sequence_length=5, n_samples=3)
+    assert out["rgb"].shape == (3, 5, 4, 3, 4, 4)
+    assert out["rewards"].shape == (3, 5, 4, 1)
+    # rewards were written as the step counter: every sampled sequence must be
+    # 5 consecutive integers (the ring is exactly full, no wrap ambiguity)
+    rew = np.asarray(out["rewards"])[..., 0]  # [n_samples, L, B]
+    for s in range(3):
+        for b in range(4):
+            seq = rew[s, :, b]
+            np.testing.assert_allclose(np.diff(seq), 1.0)
+
+
+def test_sample_sequences_are_contiguous_across_wrap():
+    ring = _make(buffer_size=8, n_envs=1)
+    for i in range(19):
+        ring.add(_step(i, 1))
+    out = ring.sample_device(batch_size=16, sequence_length=4, n_samples=2)
+    rew = np.asarray(out["rewards"])[..., 0]
+    # all stored rewards are the last 8 step counters; sequences must be
+    # consecutive and made only of live (non-overwritten) values
+    assert rew.min() >= 19 - 8
+    np.testing.assert_allclose(np.diff(rew, axis=1), 1.0)
+
+
+def test_sample_errors():
+    ring = _make(buffer_size=8)
+    with pytest.raises(ValueError, match="No sample"):
+        ring.sample_device(4, sequence_length=2)
+    ring.add(_step(0, 2))
+    with pytest.raises(ValueError, match="only contains"):
+        ring.sample_device(4, sequence_length=4)
+    with pytest.raises(ValueError, match="batch_size"):
+        ring.sample_device(0, sequence_length=1)
+
+
+def test_force_done_last_mirrors():
+    ring = _make(buffer_size=8)
+    for i in range(3):
+        ring.add(_step(i, 2))
+    ring.force_done_last(1)
+    _ring_equals_host(ring)
+    assert np.asarray(ring._buf["dones"])[2, 1, 0] == 1.0
+    assert np.asarray(ring._buf["dones"])[2, 0, 0] == 0.0
+
+
+def test_wrap_within_one_staging_window_keeps_newest():
+    """A ring that wraps before any flush stages duplicate (env, t) targets;
+    the dedupe must keep the newest row (XLA scatter is otherwise undefined
+    for duplicate indices)."""
+    ring = _make(buffer_size=4, n_envs=1)
+    for i in range(10):  # wraps 2.5x, no sample/flush in between
+        ring.add(_step(i, 1))
+    _ring_equals_host(ring)
+    rew = np.asarray(ring._buf["rewards"])[:, 0, 0]
+    np.testing.assert_allclose(np.sort(rew), [6.0, 7.0, 8.0, 9.0])
+
+
+def test_checkpoint_roundtrip_restores_device_copy():
+    ring = _make(buffer_size=8)
+    for i in range(13):
+        ring.add(_step(i, 2))
+    state = ring.state_dict()
+
+    fresh = _make(buffer_size=8)
+    fresh.load_state_dict(state)
+    _ring_equals_host(fresh)
+    assert all(b.full for b in fresh.host.buffer)
+    # and sampling still works post-restore
+    out = fresh.sample_device(batch_size=2, sequence_length=3, n_samples=1)
+    assert out["rgb"].shape == (1, 3, 2, 3, 4, 4)
+
+
+def test_flush_bucketing_reuses_compiled_programs():
+    ring = _make(buffer_size=64, n_envs=1)
+    for i in range(5):
+        ring.add(_step(i, 1))
+    ring._flush()
+    for i in range(7):
+        ring.add(_step(5 + i, 1))
+    ring._flush()
+    # both flushes pad to one bucket => one compiled scatter
+    assert list(ring._scatter_fns.keys()) == [DeviceRingReplay.FLUSH_BUCKET]
+    _ring_equals_host(ring)
