@@ -1,0 +1,110 @@
+"""Model-manager walkthrough — publish, version, stage, and reload agents.
+
+Runnable equivalent of the reference's ``examples/model_manager.ipynb``
+(which drives an MLflow-backed manager; MLflow is not available in this
+image, so this framework ships a filesystem/Orbax-backed registry with the
+same concepts — ``sheeprl_tpu/utils/model_manager.py``). The walkthrough:
+
+1. train a small PPO agent on CartPole and checkpoint it;
+2. **register** the checkpoint as version 1 of a named model;
+3. retrieve model info / the **latest version**;
+4. train a second agent (more steps) and register it as version 2;
+5. **transition** v2 to the ``production`` stage;
+6. **load** the production model back as a pytree (the same ``Fabric.load``
+   format used by training checkpoints) and evaluate it through the CLI;
+7. **delete** an old version.
+
+Run from the repo root (CPU is fine)::
+
+    JAX_PLATFORMS=cpu python examples/model_manager.py
+"""
+
+import glob
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu import cli
+from sheeprl_tpu.utils.model_manager import ModelManager
+
+
+def train_ppo(root: str, exp_name: str, total_steps: int) -> str:
+    """Train PPO on CartPole and return the last checkpoint path."""
+    cli.run(
+        [
+            "exp=ppo",
+            "env=gym",
+            "env.id=CartPole-v1",
+            "env.num_envs=4",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            f"total_steps={total_steps}",
+            "algo.rollout_steps=32",
+            "per_rank_batch_size=32",
+            f"checkpoint.every={total_steps}",
+            "checkpoint.save_last=True",
+            "metric.log_level=0",
+            "buffer.memmap=False",
+            "algo.run_test=False",
+            f"exp_name={exp_name}",
+            f"root_dir={root}/logs_{exp_name}",
+            "run_name=walkthrough",
+        ]
+    )
+    ckpts = sorted(glob.glob(f"{root}/logs_{exp_name}/**/checkpoint/ckpt_*", recursive=True))
+    assert ckpts, "training produced no checkpoint"
+    return ckpts[-1]
+
+
+def main() -> None:
+    root = tempfile.mkdtemp(prefix="model_manager_example_")
+    registry = ModelManager(os.path.join(root, "models"))
+
+    # 1-2: train briefly and register the checkpoint as v1
+    ckpt_v1 = train_ppo(root, "mm_example_v1", total_steps=256)
+    v1 = registry.register_model(
+        "ppo_cartpole_agent",
+        ckpt_v1,
+        description="PPO CartPole agent (short training run)",
+        metadata={"total_steps": 256},
+    )
+    print(f"registered version {v1} from {ckpt_v1}")
+
+    # 3: retrieve info
+    for name, versions in registry.list_models().items():
+        print("model:", name)
+        for meta in versions:
+            print("  ", {k: meta[k] for k in ("version", "stage", "description")})
+    latest = registry.get_metadata("ppo_cartpole_agent")  # latest by default
+    print("latest version:", latest["version"])
+
+    # 4: train longer, register as v2
+    ckpt_v2 = train_ppo(root, "mm_example_v2", total_steps=512)
+    v2 = registry.register_model(
+        "ppo_cartpole_agent",
+        ckpt_v2,
+        description="PPO CartPole agent (longer training run)",
+        metadata={"total_steps": 512},
+    )
+    print(f"registered version {v2}")
+
+    # 5: promote v2 to production
+    registry.transition_model("ppo_cartpole_agent", v2, "production")
+    print("stages:", {v: registry.get_metadata("ppo_cartpole_agent", v)["stage"]
+                      for v in (v1, v2)})
+
+    # 6: load the production model and evaluate it through the CLI
+    prod_ckpt = registry.get_model("ppo_cartpole_agent", v2)
+    print("production checkpoint:", prod_ckpt)
+    cli.evaluation([f"checkpoint_path={prod_ckpt}", "fabric.accelerator=cpu",
+                    "env.capture_video=False"])
+
+    # 7: drop the stale version
+    registry.delete_model("ppo_cartpole_agent", v1)
+    print("remaining:", {n: [m["version"] for m in vs] for n, vs in registry.list_models().items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -1263,9 +1263,39 @@ def build_player_fns(
         new_state = dict(new_state, actions=jnp.concatenate(expl, -1))
         return expl, new_state
 
+    # raw-obs variants: normalization happens INSIDE the jit, so acting is a
+    # single dispatch taking native-dtype (uint8 pixel) host arrays. On a
+    # remote-attached device the eager normalize of the plain variants would
+    # cost one extra round trip per obs key per env step, and f32 pixels are
+    # 4x the uint8 upload.
+    cnn_keys = tuple(cfg.cnn_keys.encoder)
+
+    def _normalize(raw_obs):
+        from sheeprl_tpu.algos.dreamer_v3.utils import normalize_obs_jnp
+
+        return normalize_obs_jnp(raw_obs, cnn_keys)
+
+    @jax.jit
+    def greedy_action_raw(wm_params, actor_params, state, raw_obs, key, masks=None):
+        return _step(
+            wm_params, actor_params, state, _normalize(raw_obs), key,
+            is_training=False, masks=masks,
+        )
+
+    @jax.jit
+    def exploration_action_raw(
+        wm_params, actor_params, state, raw_obs, key, expl_amount, masks=None
+    ):
+        return exploration_action(
+            wm_params, actor_params, state, _normalize(raw_obs), key, expl_amount,
+            masks=masks,
+        )
+
     return {
         "init_states": init_states,
         "reset_states": jax.jit(reset_states),
         "greedy_action": greedy_action,
         "exploration_action": exploration_action,
+        "greedy_action_raw": greedy_action_raw,
+        "exploration_action_raw": exploration_action_raw,
     }

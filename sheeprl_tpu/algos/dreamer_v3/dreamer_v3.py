@@ -50,7 +50,6 @@ from sheeprl_tpu.algos.dreamer_v3.loss import continue_distribution, reconstruct
 from sheeprl_tpu.algos.dreamer_v3.utils import (
     compute_lambda_values,
     init_moments,
-    normalize_obs_jnp,
     prepare_obs,
     test,
     update_moments,
@@ -247,6 +246,11 @@ def build_train_fn(
             )
         )
         kz, ka = jax.random.split(key)
+        # gz is drawn s-major but the kernel consumes it d-major: i.i.d.
+        # gumbel noise makes the layouts statistically equivalent, and
+        # skipping the transpose avoids a [H+1, n, S*D] relayout. This DOES
+        # break bit-parity with the lax path / the tests' d-major convention;
+        # transpose like tests/test_ops/test_imagination.py when A/B-ing.
         gz = jax.random.gumbel(kz, (horizon + 1, n, stoch_flat))
         ga = jax.random.gumbel(ka, (horizon + 1, n, dims[0]))
         z0_dm = z0[:, dmajor_perm(S, D)]
@@ -753,18 +757,19 @@ def main(fabric, cfg: Dict[str, Any]):
                         axis=-1,
                     )
             else:
-                norm_obs = normalize_obs_jnp(obs, cnn_keys)
                 masks = (
                     {k: jnp.asarray(np.asarray(o[k])) for k in mask_keys}
                     if is_minedojo
                     else None
                 )
                 root_key, act_key = jax.random.split(root_key)
-                actions_j, player_state = player_fns["exploration_action"](
+                # raw-obs variant: uint8 pixels cross the host→device link
+                # and are normalized inside the jit (one dispatch per step)
+                actions_j, player_state = player_fns["exploration_action_raw"](
                     play_wm,
                     play_actor,
                     player_state,
-                    norm_obs,
+                    obs,
                     act_key,
                     jnp.float32(expl_amount),
                     masks=masks,

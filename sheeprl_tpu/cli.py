@@ -33,19 +33,29 @@ from sheeprl_tpu.utils.utils import enable_persistent_compilation_cache, dotdict
 
 
 def _load_run_config(ckpt_path: str):
-    """Read the persisted ``.hydra/config.yaml`` of the run that produced a
-    checkpoint (checkpoints live at ``<log_dir>/checkpoint/ckpt_*``).
+    """Read the persisted config of the run that produced a checkpoint.
+
+    Two layouts are recognized: training runs
+    (``<log_dir>/checkpoint/ckpt_*`` with ``<log_dir>/.hydra/config.yaml``)
+    and model-registry versions (``<registry>/<name>/v<k>/checkpoint`` with
+    the config copied next to the checkpoint — utils/model_manager.py).
     Returns ``(cfg, log_dir)``."""
     import yaml
 
-    log_dir = os.path.dirname(os.path.dirname(os.path.abspath(ckpt_path)))
-    cfg_path = os.path.join(log_dir, ".hydra", "config.yaml")
-    if not os.path.isfile(cfg_path):
-        raise RuntimeError(
-            f"Cannot use checkpoint {ckpt_path}: missing persisted config at {cfg_path}"
-        )
-    with open(cfg_path) as f:
-        return dotdict(yaml.safe_load(f)), log_dir
+    ckpt_abs = os.path.abspath(ckpt_path)
+    log_dir = os.path.dirname(os.path.dirname(ckpt_abs))
+    candidates = [
+        (os.path.join(log_dir, ".hydra", "config.yaml"), log_dir),
+        (os.path.join(os.path.dirname(ckpt_abs), "config.yaml"), os.path.dirname(ckpt_abs)),
+    ]
+    for cfg_path, base in candidates:
+        if os.path.isfile(cfg_path):
+            with open(cfg_path) as f:
+                return dotdict(yaml.safe_load(f)), base
+    raise RuntimeError(
+        f"Cannot use checkpoint {ckpt_path}: missing persisted config at any of "
+        f"{[c for c, _ in candidates]}"
+    )
 
 
 def resume_from_checkpoint(cfg) -> Any:
@@ -272,6 +282,9 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
     )
     cfg.env.num_envs = 1
     cfg.env.capture_video = bool(eval_cfg.get("env", {}).get("capture_video", cfg.env.capture_video))
+    # keep the run's PRNG implementation at eval time (a threefry-trained
+    # run should not sample under the constructor-default rbg)
+    run_fabric = cfg.get("fabric", {}) or {}
     cfg.fabric = dotdict(
         {
             "_target_": "sheeprl_tpu.fabric.Fabric",
@@ -280,6 +293,7 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
             "strategy": "auto",
             "accelerator": eval_cfg.get("fabric", {}).get("accelerator", "auto"),
             "precision": eval_cfg.get("fabric", {}).get("precision", "32-true"),
+            "prng_impl": run_fabric.get("prng_impl", "rbg"),
             "callbacks": [],
         }
     )

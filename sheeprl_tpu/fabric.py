@@ -141,10 +141,16 @@ class Fabric:
             # threefry on TPU (pre-drawn scan/imagination noise is ~0.4 ms of
             # the DV3 step under threefry). Still deterministic per seed; set
             # fabric.prng_impl=threefry for jax's default counter-based keys.
-            try:
-                jax.config.update("jax_default_prng_impl", prng_impl)
-            except Exception:  # pragma: no cover - unknown impl name
-                warnings.warn(f"Unknown fabric.prng_impl {prng_impl!r}; keeping default")
+            # NOTE: this is process-global jax config — when two Fabrics with
+            # different impls coexist in one process, the last constructed
+            # wins for subsequently created keys.
+            prng_impl = {"threefry": "threefry2x32"}.get(prng_impl, prng_impl)
+            if prng_impl not in ("rbg", "threefry2x32", "unsafe_rbg"):
+                raise ValueError(
+                    f"Unknown fabric.prng_impl {prng_impl!r}; expected one of "
+                    "'rbg', 'threefry' (threefry2x32), 'unsafe_rbg'"
+                )
+            jax.config.update("jax_default_prng_impl", prng_impl)
         self.strategy = strategy or "auto"
         self.accelerator = accelerator or "auto"
         self.precision = precision or "32-true"

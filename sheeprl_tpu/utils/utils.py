@@ -268,6 +268,15 @@ def conform_pytree(template: Any, restored: Any) -> Any:
 
 def _rename_trunk_params(value: dict) -> None:
     mlp = value.pop("MLP_0")
+    unexpected = set(mlp) - {"Dense_0", "LayerNorm_0"}
+    if unexpected:
+        # fail loudly instead of silently dropping parameters if the stored
+        # trunk layout ever grows entries this migration doesn't carry over
+        raise ValueError(
+            "migrate_legacy_checkpoint: representation-model MLP_0 contains "
+            f"unexpected entries {sorted(unexpected)}; refusing to migrate a "
+            "layout this shim does not understand"
+        )
     dense = mlp.get("Dense_0", {})
     if "kernel" in dense:
         value["trunk_kernel"] = dense["kernel"]
