@@ -230,6 +230,8 @@ def build_train_fn(
             "lambda_values": sg(lambda_values),
             "discount": discount,
             "Loss/policy_loss": policy_loss,
+            "User/PredictedRewards": jnp.mean(sg(predicted_rewards)),
+            "User/LambdaValues": jnp.mean(sg(lambda_values)),
         }
         return policy_loss, aux
 
@@ -283,6 +285,8 @@ def build_train_fn(
 
         metrics = dict(wm_metrics)
         metrics["Loss/policy_loss"] = aux["Loss/policy_loss"]
+        metrics["User/PredictedRewards"] = aux["User/PredictedRewards"]
+        metrics["User/LambdaValues"] = aux["User/LambdaValues"]
         metrics["Loss/value_loss"] = critic_loss
         metrics["Grads/world_model"] = optax.global_norm(wm_grads)
         metrics["Grads/actor"] = optax.global_norm(actor_grads)

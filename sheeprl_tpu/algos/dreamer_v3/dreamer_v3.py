@@ -496,7 +496,56 @@ def build_train_fn(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(shmapped, donate_argnums=(0,))
+    step_fn = jax.jit(shmapped, donate_argnums=(0,))
+
+    # Burst variant: a whole training burst (n_samples gradient steps) as ONE
+    # program — a lax.scan over the stacked [n, T, B, ...] batches. On a
+    # remote-attached device every dispatch pays a per-call round trip that
+    # scales with the donated state's leaf count (~120 ms measured for this
+    # agent pytree over the tunnel); one scan dispatch per burst pays it once.
+    def local_burst(agent_state, data_stack, keys, taus):
+        from jax.flatten_util import ravel_pytree
+
+        def body(state, inp):
+            d, k, t = inp
+            return local_step(state, d, k, t)
+
+        state, metrics = jax.lax.scan(body, agent_state, (data_stack, keys, taus))
+        # the fresh acting params leave the program as ONE flat vector so the
+        # player's next dispatch marshals a single buffer (packed player fns)
+        packed = ravel_pytree(
+            {"wm": state["params"]["world_model"], "actor": state["params"]["actor"]}
+        )[0]
+        # the aggregator consumed only the burst's last metrics already
+        return state, jax.tree_util.tree_map(lambda m: m[-1], metrics), packed
+
+    burst_shmapped = jax.shard_map(
+        local_burst,
+        mesh=fabric.mesh,
+        in_specs=(P(), P(None, None, axis), P(), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    burst_fn = jax.jit(burst_shmapped, donate_argnums=(0,))
+    return TrainProgram(step_fn, burst_fn)
+
+
+class TrainProgram:
+    """One-gradient-step program plus the fused whole-burst variant.
+
+    Callable like the plain step (existing tests/benches), with ``.burst``
+    for the scan-over-samples program the train loop uses.
+    """
+
+    def __init__(self, step_fn, burst_fn):
+        self._step = step_fn
+        self.burst = burst_fn
+
+    def __call__(self, *args, **kwargs):
+        return self._step(*args, **kwargs)
+
+    def lower(self, *args, **kwargs):
+        return self._step.lower(*args, **kwargs)
 
 
 def build_optimizers_and_state(cfg, params):
@@ -543,7 +592,7 @@ def main(fabric, cfg: Dict[str, Any]):
     n_envs = int(cfg.env.num_envs) * world_size
     from functools import partial
 
-    from gymnasium.vector import AutoresetMode, SyncVectorEnv
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
 
     from sheeprl_tpu.envs.wrappers import RestartOnException
 
@@ -561,7 +610,13 @@ def main(fabric, cfg: Dict[str, Any]):
         )
         for i in range(n_envs)
     ]
-    envs = SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    # env.sync_env=False (default, like every other algo here and the
+    # reference's AsyncVectorEnv at dreamer_v3.py:407): worker processes keep
+    # simulator CPU burn out of this process, which matters doubly on a
+    # remote-attached device — the accelerator client's IO threads live here
+    # and starve behind a CPU-bound env loop
+    vectorized_env = SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv
+    envs = vectorized_env(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 
@@ -646,14 +701,34 @@ def main(fabric, cfg: Dict[str, Any]):
         actions_dim,
         is_continuous,
     )
-    player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
+    # Two acting modes: host-mirrored (player_on_host=True on an accelerator
+    # mesh — CPU snapshots refreshed per burst, utils/host.py) or packed
+    # device/local acting — params cross into the player jit as ONE flat
+    # vector that the train burst itself emits, so a remote-attached device
+    # pays one buffer-handle per dispatch instead of hundreds.
+    use_packed_player = not HostParamMirror.enabled_for(fabric, cfg)
+    packed_template = (
+        {"wm": params["world_model"], "actor": params["actor"]}
+        if use_packed_player
+        else None
+    )
+    player_fns = build_player_fns(
+        world_model, actor, cfg, actions_dim, is_continuous,
+        packed_template=packed_template,
+    )
 
-    # the player acts on the CPU host with mirrored world-model/actor
-    # snapshots, refreshed once per training burst (utils/host.py)
     wm_mirror = HostParamMirror.from_cfg(agent_state["params"]["world_model"], fabric, cfg)
     actor_mirror = HostParamMirror.from_cfg(agent_state["params"]["actor"], fabric, cfg)
     play_wm = wm_mirror(agent_state["params"]["world_model"])
     play_actor = actor_mirror(agent_state["params"]["actor"])
+    play_packed = None
+    if use_packed_player:
+        from jax.flatten_util import ravel_pytree
+
+        pack_fn = jax.jit(lambda t: ravel_pytree(t)[0])
+        play_packed = pack_fn(
+            {"wm": agent_state["params"]["world_model"], "actor": agent_state["params"]["actor"]}
+        )
 
     aggregator = None
     if not MetricAggregator.disabled:
@@ -681,7 +756,12 @@ def main(fabric, cfg: Dict[str, Any]):
     if use_device_ring:
         from sheeprl_tpu.data.device_ring import DeviceRingReplay
 
-        rb = DeviceRingReplay(rb, device=fabric.device, seed=cfg.seed)
+        rb = DeviceRingReplay(
+            rb,
+            device=fabric.device,
+            seed=cfg.seed,
+            sequence_overlap=int(cfg.per_rank_sequence_length),
+        )
     if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
         rb.load_state_dict(state["rb"])
 
@@ -728,7 +808,7 @@ def main(fabric, cfg: Dict[str, Any]):
         )
 
     # Data sharding for the train batch [T, B_total, ...]
-    data_sharding = fabric.sharding(None, fabric.data_axis)
+    burst_sharding = fabric.sharding(None, None, fabric.data_axis)
 
     # First observation (reference main :574-590)
     o = envs.reset(seed=cfg.seed)[0]
@@ -739,9 +819,38 @@ def main(fabric, cfg: Dict[str, Any]):
     step_data["is_first"] = np.ones((1, n_envs, 1), np.float32)
     player_state = player_fns["init_states"](play_wm, n_envs)
 
+    # SHEEPRL_LOOP_TRACE=1: per-phase wall-time means printed every 50
+    # updates — the remote-attached-device loop is latency-dominated and the
+    # TB timers can't see through async dispatch, so this is the ground truth
+    # for where a slow loop actually spends its time.
+    trace = os.environ.get("SHEEPRL_LOOP_TRACE") not in (None, "", "0")
+    trace_acc: Dict[str, float] = {}
+    trace_n = 0
+    import time as _time
+
+    def _tr(name: str, t0: float) -> float:
+        t1 = _time.perf_counter()
+        if trace:
+            trace_acc[name] = trace_acc.get(name, 0.0) + (t1 - t0)
+        return t1
+
+    # SHEEPRL_GC_TUNE=1: move everything built so far out of GC's reach and
+    # relax collection thresholds — the hot loop allocates heavily (numpy
+    # views, jax array wrappers) and full collections otherwise scan a
+    # steadily growing object graph.
+    if os.environ.get("SHEEPRL_GC_TUNE") not in (None, "", "0"):
+        import gc
+
+        gc.collect()
+        gc.freeze()
+        gc.set_threshold(100000, 50, 50)
+
     per_rank_gradient_steps = 0
+    expl_scalar = None
+    expl_scalar_val = None
     for update in range(start_step, num_updates + 1):
         policy_step += n_envs
+        _t = _time.perf_counter()
 
         with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
             if update <= learning_starts and cfg.checkpoint.resume_from is None:
@@ -763,17 +872,32 @@ def main(fabric, cfg: Dict[str, Any]):
                     else None
                 )
                 root_key, act_key = jax.random.split(root_key)
-                # raw-obs variant: uint8 pixels cross the host→device link
+                # raw-obs variants: uint8 pixels cross the host→device link
                 # and are normalized inside the jit (one dispatch per step)
-                actions_j, player_state = player_fns["exploration_action_raw"](
-                    play_wm,
-                    play_actor,
-                    player_state,
-                    obs,
-                    act_key,
-                    jnp.float32(expl_amount),
-                    masks=masks,
-                )
+                if use_packed_player:
+                    if expl_scalar is None or expl_scalar_val != expl_amount:
+                        # device scalar cached: creating it eagerly per step
+                        # would be one extra program dispatch per env step
+                        expl_scalar = jnp.float32(expl_amount)
+                        expl_scalar_val = expl_amount
+                    actions_j, player_state = player_fns["exploration_action_packed"](
+                        play_packed,
+                        player_state,
+                        obs,
+                        act_key,
+                        expl_scalar,
+                        masks=masks,
+                    )
+                else:
+                    actions_j, player_state = player_fns["exploration_action_raw"](
+                        play_wm,
+                        play_actor,
+                        player_state,
+                        obs,
+                        act_key,
+                        jnp.float32(expl_amount),
+                        masks=masks,
+                    )
                 actions = np.concatenate([np.asarray(a) for a in actions_j], -1)
                 if is_continuous:
                     real_actions = actions
@@ -782,13 +906,16 @@ def main(fabric, cfg: Dict[str, Any]):
                         [np.argmax(np.asarray(a), axis=-1) for a in actions_j], axis=-1
                     )
 
+            _t = _tr("act", _t)
             step_data["actions"] = actions.reshape(1, n_envs, -1).astype(np.float32)
             rb.add(step_data)
+            _t = _tr("rb_add", _t)
 
             o, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
             dones = np.logical_or(terminated, truncated).astype(np.float32)
+            _t = _tr("env_step", _t)
 
         step_data["is_first"] = np.zeros_like(step_data["dones"])
         if "restart_on_exception" in infos:
@@ -857,10 +984,16 @@ def main(fabric, cfg: Dict[str, Any]):
             step_data["is_first"][:, dones_idxes] = 1.0
             reset_mask = np.zeros((n_envs, 1), np.float32)
             reset_mask[dones_idxes] = 1.0
-            player_state = player_fns["reset_states"](
-                play_wm, player_state, jnp.asarray(reset_mask)
-            )
+            if use_packed_player:
+                player_state = player_fns["reset_states_packed"](
+                    play_packed, player_state, jnp.asarray(reset_mask)
+                )
+            else:
+                player_state = player_fns["reset_states"](
+                    play_wm, player_state, jnp.asarray(reset_mask)
+                )
 
+        _t = _tr("bookkeeping", _t)
         updates_before_training -= 1
 
         # Train the agent (reference main :719-765)
@@ -882,6 +1015,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     sequence_length=cfg.per_rank_sequence_length,
                     n_samples=n_samples,
                 )
+            _t = _tr("sample", _t)
             # On a bandwidth-limited host link every blocking device→host
             # metric fetch costs a round trip; fetch_train_metrics_every=k
             # samples the train metrics every k-th burst (always on the last
@@ -913,33 +1047,47 @@ def main(fabric, cfg: Dict[str, Any]):
             # attached chip). Time/sps_train is only device-accurate on
             # bursts that fetch.
             with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
-                metrics = None
+                # the whole burst (n_samples gradient steps) is ONE dispatch:
+                # per-call overhead on a remote-attached device scales with
+                # the state pytree's leaf count and would otherwise repeat
+                # per gradient step (build_train_fn burst notes)
+                taus = np.zeros(n_samples, np.float32)
                 for i in range(n_samples):
-                    if per_rank_gradient_steps % cfg.algo.critic.target_network_update_freq == 0:
-                        tau = 1.0 if per_rank_gradient_steps == 0 else cfg.algo.critic.tau
-                    else:
-                        tau = 0.0
-                    if use_device_ring:
-                        # already on device: slice the sample dim in place
-                        batch = {k: v[i] for k, v in local_data.items()}
-                    else:
-                        # ship native dtypes (uint8 pixels = 4x less than f32
-                        # over the host->HBM link) straight to the sharding;
-                        # the train step normalizes on device
-                        batch = jax.device_put(
-                            {k: v[i] for k, v in local_data.items()}, data_sharding
-                        )
-                    root_key, train_key = jax.random.split(root_key)
-                    agent_state, metrics = train_fn(
-                        agent_state, batch, train_key, jnp.float32(tau)
-                    )
-                    per_rank_gradient_steps += 1
+                    g = per_rank_gradient_steps + i
+                    if g % cfg.algo.critic.target_network_update_freq == 0:
+                        taus[i] = 1.0 if g == 0 else cfg.algo.critic.tau
+                if use_device_ring:
+                    batches = local_data  # already stacked on device
+                else:
+                    # ship native dtypes (uint8 pixels = 4x less than f32
+                    # over the host->HBM link) straight to the sharding;
+                    # the train step normalizes on device
+                    batches = jax.device_put(local_data, burst_sharding)
+                root_key, train_key = jax.random.split(root_key)
+                agent_state, metrics, play_packed_new = train_fn.burst(
+                    agent_state,
+                    batches,
+                    jax.random.split(train_key, n_samples),
+                    jnp.asarray(taus),
+                )
+                per_rank_gradient_steps += n_samples
+                _t = _tr("train_dispatch", _t)
                 if metrics is not None and fetch_metrics:
                     metrics = jax.device_get(metrics)
                 else:
+                    # pacing barrier: one scalar pull per burst bounds the
+                    # host's dispatch run-ahead. Unbounded run-ahead on a
+                    # remote-attached device lets per-call overhead compound
+                    # (measured: acting latency grows without this); on local
+                    # devices the wait is the device's own step time.
+                    np.asarray(metrics["Loss/world_model_loss"])
                     metrics = None
-                play_wm = wm_mirror(agent_state["params"]["world_model"])
-                play_actor = actor_mirror(agent_state["params"]["actor"])
+                _t = _tr("metric_fetch", _t)
+                if use_packed_player:
+                    play_packed = play_packed_new
+                else:
+                    play_wm = wm_mirror(agent_state["params"]["world_model"])
+                    play_actor = actor_mirror(agent_state["params"]["actor"])
                 train_step += world_size
             updates_before_training = cfg.algo.train_every // policy_steps_per_update
             if cfg.algo.actor.expl_decay:
@@ -993,6 +1141,15 @@ def main(fabric, cfg: Dict[str, Any]):
                 timer.reset()
             last_log = policy_step
             last_train = train_step
+
+        if trace:
+            trace_n += 1
+            if trace_n % 50 == 0:
+                parts = " ".join(
+                    f"{k}={v / 50 * 1000:.0f}ms" for k, v in sorted(trace_acc.items())
+                )
+                print(f"[loop-trace] update={update} mean/iter: {parts}", flush=True)
+                trace_acc.clear()
 
         # Checkpoint (reference main :803-830)
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (

@@ -78,6 +78,10 @@ def resume_from_checkpoint(cfg) -> Any:
     old_cfg.root_dir = cfg.root_dir
     old_cfg.run_name = cfg.run_name
     old_cfg.fabric = cfg.fabric
+    # the resuming command also controls the training horizon, so a finished
+    # run can be extended ("train for another N steps") — the counters inside
+    # the checkpoint keep the already-done progress either way
+    old_cfg.total_steps = cfg.total_steps
     return old_cfg
 
 

@@ -59,12 +59,21 @@ class DeviceRingReplay:
         host_rb: EnvIndependentReplayBuffer,
         device: Optional[Any] = None,
         seed: Optional[int] = None,
+        sequence_overlap: int = 64,
     ):
         import jax
 
         self._rb = host_rb
         self._capacity = int(host_rb.buffer_size)
         self._n_envs = int(host_rb.n_envs)
+        # Shadow region: the first `overlap` rows are mirrored past the tail
+        # so every sequence of length ≤ overlap is PHYSICALLY contiguous even
+        # when it wraps, and sampling can read contiguous blocks (vmapped
+        # dynamic_slice) instead of row-scattered gathers — on TPU a gather
+        # of thousands of random 12 KB rows from a GB-scale ring is ~100x
+        # slower than the same bytes as contiguous block DMA (measured:
+        # ~0.5 s/sample at 100k rows vs ~ms for blocks).
+        self._overlap = max(0, min(int(sequence_overlap), self._capacity))
         self._device = device if device is not None else jax.devices()[0]
         self._rng = np.random.default_rng(seed)
         # device storage, allocated lazily on the first add (dtypes/shapes
@@ -129,8 +138,17 @@ class DeviceRingReplay:
                 if sub._buf is not None and n_rows[env] > 0:
                     block[: n_rows[env], env] = _as_np(sub._buf[k])[: n_rows[env], 0]
             blocks[k] = block
+        cap, ov = self._capacity, self._overlap
+
+        def _set(v, b):
+            v = v.at[: b.shape[0]].set(b)
+            if ov:
+                # mirror the head into the shadow region
+                v = v.at[cap:].set(v[:ov])
+            return v
+
         set_block = jax.jit(
-            lambda buf, blk: {k: v.at[: blk[k].shape[0]].set(blk[k]) for k, v in buf.items()},
+            lambda buf, blk: {k: _set(v, blk[k]) for k, v in buf.items()},
             donate_argnums=(0,),
         )
         self._buf = set_block(self._buf, blocks)
@@ -175,7 +193,10 @@ class DeviceRingReplay:
 
         with jax.default_device(self._device):
             self._buf = {
-                k: jnp.zeros((self._capacity, self._n_envs) + np.asarray(v).shape, np.asarray(v).dtype)
+                k: jnp.zeros(
+                    (self._capacity + self._overlap, self._n_envs) + np.asarray(v).shape,
+                    np.asarray(v).dtype,
+                )
                 for k, v in example_row.items()
             }
 
@@ -206,16 +227,21 @@ class DeviceRingReplay:
         sub0 = self._rb.buffer[slots[0][0]]
         if self._buf is None:
             self._allocate({k: _as_np(v)[0, 0] for k, v in sub0._buf.items()})
+        # head rows are mirrored into the shadow region past the tail so
+        # wrapped sequences stay physically contiguous (value read from the
+        # same host slot)
+        slots.extend([(env, t + self._capacity) for env, t in slots if t < self._overlap])
         n = len(slots)
         padded = _round_up(n, self.FLUSH_BUCKET)
-        t_idx = np.full(padded, self._capacity, np.int32)  # OOB → dropped
+        oob = self._capacity + self._overlap
+        t_idx = np.full(padded, oob, np.int32)  # OOB → dropped
         e_idx = np.zeros(padded, np.int32)
         rows: Dict[str, np.ndarray] = {}
         for k, v0 in sub0._buf.items():
             first = _as_np(v0)[0, 0]
             stack = np.zeros((padded,) + first.shape, first.dtype)
             for i, (env, t) in enumerate(slots):
-                stack[i] = _as_np(self._rb.buffer[env]._buf[k])[t, 0]
+                stack[i] = _as_np(self._rb.buffer[env]._buf[k])[t % self._capacity, 0]
             rows[k] = stack
         for i, (env, t) in enumerate(slots):
             t_idx[i] = t
@@ -231,9 +257,11 @@ class DeviceRingReplay:
         """Host-side index plan reusing the host buffers' own sampling logic
         (``pick_envs`` + per-env ``plan_starts``).
 
-        Returns ``(seq [n_samples * batch, L], e_idx [n_samples * batch])``
+        Returns ``(starts [n_samples * batch], e_idx [n_samples * batch])``
         ordered sample-major with per-env column groups, matching the host
-        ``EnvIndependentReplayBuffer.sample`` concat layout.
+        ``EnvIndependentReplayBuffer.sample`` concat layout. Starts are
+        physical ring rows; a sequence always occupies the ``L`` contiguous
+        rows from its start thanks to the shadow region.
         """
         if batch_size <= 0 or n_samples <= 0:
             raise ValueError(
@@ -258,9 +286,7 @@ class DeviceRingReplay:
             [np.full((n_samples, s.shape[1]), e, np.int32) for s, e in zip(starts_by_env, envs_order)],
             axis=1,
         )
-        flat_starts = all_starts.reshape(-1)
-        seq = (flat_starts[:, None] + np.arange(L)[None, :]) % self._capacity
-        return seq.astype(np.int32), all_envs.reshape(-1).astype(np.int32)
+        return all_starts.reshape(-1).astype(np.int32), all_envs.reshape(-1).astype(np.int32)
 
     def _gather_fn(self, n_rows: int, L: int, n_samples: int):
         import jax
@@ -268,12 +294,24 @@ class DeviceRingReplay:
         key = (n_rows, L, n_samples)
         fn = self._gather_fns.get(key)
         if fn is None:
-            def gather(buf, seq, e_idx):
+            def gather(buf, starts, e_idx):
+                # contiguous-block reads (thanks to the shadow region): a
+                # vmapped dynamic_slice lowers to a gather of [L, ...] BLOCKS,
+                # not L scattered rows — the difference between ~ms and
+                # ~hundreds of ms per sample on a GB-scale TPU ring
+                def one(s, e):
+                    return {
+                        k: jax.lax.dynamic_slice(
+                            v, (s, e) + (0,) * (v.ndim - 2), (L, 1) + v.shape[2:]
+                        )[:, 0]
+                        for k, v in buf.items()
+                    }
+
+                sel = jax.vmap(one)(starts, e_idx)  # {k: [total, L, ...]}
                 out = {}
-                for k, v in buf.items():
-                    sel = v[seq, e_idx[:, None]]  # [total, L, ...]
-                    sel = sel.reshape((n_samples, n_rows // n_samples, L) + sel.shape[2:])
-                    out[k] = sel.swapaxes(1, 2)  # [n_samples, L, B, ...]
+                for k, v in sel.items():
+                    v = v.reshape((n_samples, n_rows // n_samples, L) + v.shape[2:])
+                    out[k] = v.swapaxes(1, 2)  # [n_samples, L, B, ...]
                 return out
 
             fn = jax.jit(gather)
@@ -285,9 +323,17 @@ class DeviceRingReplay:
     ) -> Dict[str, Any]:
         """Gather ``[n_samples, sequence_length, batch, ...]`` batches on
         device. The only host→device traffic is the int32 index plan."""
+        if sequence_length > max(self._overlap, 1) and any(
+            b.full for b in self._rb.buffer
+        ):
+            raise ValueError(
+                f"sequence_length {sequence_length} exceeds the ring's "
+                f"sequence_overlap {self._overlap}; construct DeviceRingReplay "
+                "with sequence_overlap >= the training sequence length"
+            )
         self._flush()
         if self._buf is None:
             raise ValueError("No sample has been added to the buffer")
-        seq, e_idx = self._plan_indices(batch_size, sequence_length, n_samples)
-        fn = self._gather_fn(seq.shape[0], sequence_length, n_samples)
-        return fn(self._buf, seq, e_idx)
+        starts, e_idx = self._plan_indices(batch_size, sequence_length, n_samples)
+        fn = self._gather_fn(starts.shape[0], sequence_length, n_samples)
+        return fn(self._buf, starts, e_idx)

@@ -125,8 +125,11 @@ def test_wrap_within_one_staging_window_keeps_newest():
     for i in range(10):  # wraps 2.5x, no sample/flush in between
         ring.add(_step(i, 1))
     _ring_equals_host(ring)
-    rew = np.asarray(ring._buf["rewards"])[:, 0, 0]
+    rew = np.asarray(ring._buf["rewards"])[:4, 0, 0]
     np.testing.assert_allclose(np.sort(rew), [6.0, 7.0, 8.0, 9.0])
+    # the shadow region mirrors the head so wrapped sequences read contiguous
+    shadow = np.asarray(ring._buf["rewards"])[4:, 0, 0]
+    np.testing.assert_allclose(shadow, rew[: len(shadow)])
 
 
 def test_checkpoint_roundtrip_restores_device_copy():
