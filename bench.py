@@ -1,31 +1,45 @@
-"""Benchmark entry: DreamerV3 grad-step rate + PPO CartPole wall-clock.
+"""Benchmark entry: the full framework-speed matrix vs BASELINE.md.
 
-Prints TWO JSON lines; the LAST is the headline PPO number (the driver's
-parser takes the last line; the tail captures both):
+Prints one JSON line per workload; the LAST is the headline PPO number (the
+driver's parser takes the last line; the tail captures the whole matrix):
 
 1. DreamerV3 S-preset (Atari-100K MsPacman config, bf16) gradient-steps/s
    with the profiled device-ms per step — the north-star workload
    (`BASELINE.md`: 100K policy steps in 14 h on a 3080 ≈ 2 grad-steps/s).
    Run in a subprocess (`bench_dreamer.py`) so a failure there cannot take
-   down the headline bench.
-2. PPO CartPole, the reference's own benchmark protocol (`README.md:92-104`
-   / `benchmarks/benchmark.py:10-41`): 64 envs × 1024 rollout-collection
+   down the headline bench. `device_ms_per_step` (in-run xplane profile) is
+   the trustworthy DV3 number; wall-clock through a shared relay is noisy.
+2. SAC: the reference's own protocol (`/root/reference/benchmarks/
+   benchmark_sb3.py:21-29`): LunarLanderContinuous, 4 envs, 1024*64 total
+   steps, test/logging/checkpoints disabled. Baseline 318.06 s (v0.5.2,
+   4 CPUs, 5 seeds). Gym retired the -v2 env; -v3 is physics-identical.
+3. DreamerV1 / DreamerV2 end-to-end micro-runs. The reference's
+   `dreamer_v{1,2}_benchmarks` exp configs are NOT in the snapshot, so the
+   rows 2921.38 s / 1148.1 s cannot be step-matched; each line carries the
+   exact workload we ran and `vs_baseline` is the raw wall-clock ratio with
+   that caveat recorded in `protocol`. Workload: default S recipes on the
+   64x64-pixel dummy env, total_steps past learning_starts so the measured
+   window covers prefill + real training bursts.
+4. PPO CartPole, the reference's own benchmark protocol (`README.md:92-104`
+   / `benchmarks/benchmark.py:10-41`): 64 envs x 1024 rollout-collection
    steps (65536 policy steps), test/logging/checkpoints disabled,
-   wall-clock around `cli.run`. Reference baseline: 80.81 s (v0.5.2 numpy
-   buffers, 4 CPUs, single run).
+   wall-clock around `cli.run`. Reference baseline: 80.81 s.
 
-PPO protocol: two complete runs, both disclosed in "runs". Run 1 pays
-one-time XLA compiles (amortized by the persistent cache across processes)
-plus any shared-relay latency spikes; run 2 is steady state. "value" is the
-min; "vs_baseline_steady" rates the second run explicitly so the headline
-ratio can be read against a like-for-like steady-state number (the
-reference's 80.81 s is a single-run protocol).
+Wall-clock protocol (round-4 de-noising): the SAC and PPO lines run one
+warm-up (compile/cache fill, disclosed) plus 3 measured repeats and report
+the MEDIAN with the full `runs` array and `spread` = (max-min)/median over
+the measured repeats. The shared axon relay adds run-to-run spikes of up to
+2x that have nothing to do with the framework; the median over 3 steady
+repeats bounds that noise. The minutes-long DV1/DV2 lines are a single
+measured run after one warm-up (disclosed in their `protocol`); read them
+as order-of-magnitude evidence, not de-noised measurements.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
 import subprocess
 import sys
 import time
@@ -36,21 +50,25 @@ import time
 # log tail. Must be set before jax initializes its backends.
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
-BASELINE_SECONDS = 80.81  # reference README.md:92-104, PPO 1 device
+PPO_BASELINE_SECONDS = 80.81  # reference README.md:92-98, PPO 1 device
+SAC_BASELINE_SECONDS = 318.06  # reference README.md:106-112, SAC 1 device
+DV1_BASELINE_SECONDS = 2921.38  # reference README.md:122-128 (protocol lost)
+DV2_BASELINE_SECONDS = 1148.1  # reference README.md:130-136 (protocol lost)
+
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 
 def _dreamer_line() -> str:
     """Run the DV3 micro-bench in a subprocess and return its JSON line."""
-    repo = os.path.dirname(os.path.abspath(__file__))
     try:
         proc = subprocess.run(
             [
                 sys.executable,
-                os.path.join(repo, "bench_dreamer.py"),
+                os.path.join(REPO, "bench_dreamer.py"),
                 "fabric.precision=bf16-mixed",
                 "bench.profile=1",
             ],
-            cwd=repo,
+            cwd=REPO,
             capture_output=True,
             text=True,
             timeout=1200,
@@ -78,56 +96,156 @@ def _dreamer_line() -> str:
         )
 
 
+def _timed_subprocess_run(args, timeout, env=None):
+    """One `python -m sheeprl_tpu <overrides>` run; returns wall seconds."""
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    start = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu", *args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=full_env,
+    )
+    elapsed = time.perf_counter() - start
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-4:]
+        raise RuntimeError(" | ".join(tail)[-400:])
+    return round(elapsed, 2)
+
+
+def _repeat_line(metric, run_once, baseline, protocol, repeats=3):
+    """Warm-up + `repeats` measured runs -> JSON line with median + spread."""
+    try:
+        warmup = run_once()
+        runs = [run_once() for _ in range(repeats)]
+        med = statistics.median(runs)
+        return json.dumps(
+            {
+                "metric": metric,
+                "value": round(med, 2),
+                "unit": "s",
+                "runs": runs,
+                "warmup_run": warmup,
+                "spread": round((max(runs) - min(runs)) / med, 3) if len(runs) > 1 else None,
+                "vs_baseline": round(baseline / med, 3),
+                "protocol": protocol,
+            }
+        )
+    except Exception as exc:
+        return json.dumps({"metric": metric, "value": None, "error": repr(exc)[:400]})
+
+
+_QUIET = [
+    "env.capture_video=False",
+    "checkpoint.every=1000000000",
+    "checkpoint.save_last=False",
+    "metric.log_level=0",
+    "buffer.memmap=False",
+    "algo.run_test=False",
+]
+
+
+def _sac_line() -> str:
+    # reference protocol (benchmark_sb3.py:21-29): LunarLanderContinuous,
+    # 4 envs, 65536 steps. SAC is one policy+one train dispatch per env step;
+    # a subprocess keeps its 16k dispatches from polluting the PPO headline
+    # process and discloses the full process lifetime like the reference.
+    args = [
+        "exp=sac",  # env defaults to LunarLanderContinuous-v3 (exp/sac.yaml)
+        "env.num_envs=4",
+        "env.sync_env=True",
+        "total_steps=65536",
+        "exp_name=bench_sac",
+        *_QUIET,
+    ]
+    return _repeat_line(
+        "sac_lunarlander_65536_steps",
+        lambda: _timed_subprocess_run(args, timeout=1800),
+        SAC_BASELINE_SECONDS,
+        "reference benchmark_sb3.py:21-29 (LunarLanderContinuous, 4 envs, "
+        "1024*64 steps, test/log/ckpt off); -v3 replaces the retired -v2",
+        repeats=3,
+    )
+
+
+def _dreamer_e2e_line(family, baseline, total_steps, extra=()) -> str:
+    args = [
+        f"exp={family}",  # defaults to the 64x64-pixel dummy env
+        "env.num_envs=1",
+        f"total_steps={total_steps}",
+        f"exp_name=bench_{family}",
+        *extra,
+        *_QUIET,
+    ]
+    return _repeat_line(
+        f"{family}_e2e_{total_steps}_steps",
+        lambda: _timed_subprocess_run(args, timeout=1800),
+        baseline,
+        f"default {family} S recipe, 64x64 pixel dummy env, {total_steps} "
+        "policy steps (prefill + training bursts). SINGLE measured run after "
+        "one warm-up (the 3-repeat protocol applies to the SAC/PPO lines; "
+        "these runs are minutes long). Reference bench exp configs absent "
+        "from snapshot: vs_baseline is the raw wall-clock ratio, NOT "
+        "step-matched",
+        repeats=1,
+    )
+
+
 def main() -> None:
-    # print the DV3 line immediately (so a PPO crash cannot lose it) AND
-    # re-print it after the PPO runs: the driver records a truncated *tail*
-    # of this output, so the evidence lines must be the last two lines
-    dv3_line = _dreamer_line()
-    print(dv3_line, flush=True)
+    # print every line as soon as it exists (a later crash cannot lose it)
+    # AND re-print the full matrix at the end: the driver records a truncated
+    # *tail* of this output, so the evidence lines must be the last lines,
+    # with the PPO headline last of all.
+    lines = []
+
+    def emit(line):
+        lines.append(line)
+        print(line, flush=True)
+
+    emit(_dreamer_line())
+    emit(_sac_line())
+    # DV1: learning_starts=5000, train_every=1000, 100 grad-steps per burst
+    # -> 6000 steps covers prefill + 2 bursts (200 grad steps)
+    emit(_dreamer_e2e_line("dreamer_v1", DV1_BASELINE_SECONDS, 6000))
+    # DV2: learning_starts=1000, train_every=5 -> 2500 steps = 1000 prefill
+    # + 300 single-grad-step bursts
+    emit(_dreamer_e2e_line("dreamer_v2", DV2_BASELINE_SECONDS, 2500))
 
     from sheeprl_tpu import cli
 
-    args = [
+    ppo_args = [
         "exp=ppo",
         "env=gym",
         "env.id=CartPole-v1",
         "env.num_envs=64",
         "env.sync_env=True",
-        "env.capture_video=False",
         "total_steps=65536",
         "algo.rollout_steps=128",
         "per_rank_batch_size=64",
-        "checkpoint.every=1000000000",
-        "checkpoint.save_last=False",
-        "metric.log_level=0",
-        "buffer.memmap=False",
-        "algo.run_test=False",
         "exp_name=bench_ppo",
+        *_QUIET,
     ]
-    # best of two runs, both disclosed: the shared axon relay adds run-to-run
-    # wall-clock spikes of up to 2x that have nothing to do with the
-    # framework (the device-side step time is stable); the first run also
-    # warms the persistent XLA compilation cache
-    runs = []
-    for _ in range(2):
+
+    def ppo_once():
         start = time.perf_counter()
-        cli.run(args)
-        runs.append(round(time.perf_counter() - start, 2))
-    elapsed = min(runs)
-    print(dv3_line, flush=True)
-    print(
-        json.dumps(
-            {
-                "metric": "ppo_cartpole_65536_steps",
-                "value": elapsed,
-                "unit": "s",
-                "runs": runs,
-                "vs_baseline": round(BASELINE_SECONDS / elapsed, 3),
-                "vs_baseline_steady": round(BASELINE_SECONDS / runs[-1], 3),
-            }
-        ),
-        flush=True,
+        cli.run(ppo_args)
+        return round(time.perf_counter() - start, 2)
+
+    ppo_line = _repeat_line(
+        "ppo_cartpole_65536_steps",
+        ppo_once,
+        PPO_BASELINE_SECONDS,
+        "reference benchmark.py:10-41 (CartPole-v1, 64 envs, 1024*64 steps, "
+        "test/log/ckpt off), in-process like the reference",
+        repeats=3,
     )
+    for line in lines:
+        print(line, flush=True)
+    print(ppo_line, flush=True)
 
 
 if __name__ == "__main__":

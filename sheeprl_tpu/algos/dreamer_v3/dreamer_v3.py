@@ -1003,92 +1003,100 @@ def main(fabric, cfg: Dict[str, Any]):
                 if update == learning_starts
                 else cfg.algo.per_rank_gradient_steps
             )
-            if use_device_ring:
-                local_data = rb.sample_device(
-                    cfg.per_rank_batch_size * world_size,
-                    sequence_length=cfg.per_rank_sequence_length,
-                    n_samples=n_samples,
-                )
+            if n_samples <= 0:
+                # a length-0 scan over the burst would fail at trace time;
+                # degrade to "no training this window" but keep the cadence
+                metrics = None
             else:
-                local_data = rb.sample(
-                    cfg.per_rank_batch_size * world_size,
-                    sequence_length=cfg.per_rank_sequence_length,
-                    n_samples=n_samples,
-                )
-            _t = _tr("sample", _t)
-            # On a bandwidth-limited host link every blocking device→host
-            # metric fetch costs a round trip; fetch_train_metrics_every=k
-            # samples the train metrics every k-th burst (always on the last
-            # burst before a log boundary), 1 = every burst (default),
-            # 0 = log boundaries only. Log boundaries are crossed by policy
-            # steps, not bursts, so look ahead one train_every window: if the
-            # threshold falls before the next burst, this is the burst whose
-            # metrics that log will see.
-            burst_updates = max(int(cfg.algo.train_every) // policy_steps_per_update, 1)
-            will_log = cfg.metric.log_level > 0 and (
-                policy_step - last_log + int(cfg.algo.train_every) >= cfg.metric.log_every
-                # the run's last burst feeds the final update==num_updates log
-                # even when that update itself is not a burst
-                or update + burst_updates > num_updates
-            )
-            fetch_every = int(cfg.metric.get("fetch_train_metrics_every", 1))
-            fetch_metrics = (
-                aggregator is not None
-                and not aggregator.disabled
-                and (
-                    will_log
-                    or (fetch_every > 0 and (train_step // world_size) % fetch_every == 0)
-                )
-            )
-            # NOTE: when the metric fetch below is skipped, nothing in this
-            # block waits on the device — train_fn dispatch is async, so the
-            # timer records dispatch time and the device compute overlaps the
-            # next acting phase (that overlap is the point on a remote-
-            # attached chip). Time/sps_train is only device-accurate on
-            # bursts that fetch.
-            with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
-                # the whole burst (n_samples gradient steps) is ONE dispatch:
-                # per-call overhead on a remote-attached device scales with
-                # the state pytree's leaf count and would otherwise repeat
-                # per gradient step (build_train_fn burst notes)
-                taus = np.zeros(n_samples, np.float32)
-                for i in range(n_samples):
-                    g = per_rank_gradient_steps + i
-                    if g % cfg.algo.critic.target_network_update_freq == 0:
-                        taus[i] = 1.0 if g == 0 else cfg.algo.critic.tau
                 if use_device_ring:
-                    batches = local_data  # already stacked on device
+                    local_data = rb.sample_device(
+                        cfg.per_rank_batch_size * world_size,
+                        sequence_length=cfg.per_rank_sequence_length,
+                        n_samples=n_samples,
+                    )
                 else:
-                    # ship native dtypes (uint8 pixels = 4x less than f32
-                    # over the host->HBM link) straight to the sharding;
-                    # the train step normalizes on device
-                    batches = jax.device_put(local_data, burst_sharding)
-                root_key, train_key = jax.random.split(root_key)
-                agent_state, metrics, play_packed_new = train_fn.burst(
-                    agent_state,
-                    batches,
-                    jax.random.split(train_key, n_samples),
-                    jnp.asarray(taus),
+                    local_data = rb.sample(
+                        cfg.per_rank_batch_size * world_size,
+                        sequence_length=cfg.per_rank_sequence_length,
+                        n_samples=n_samples,
+                    )
+                _t = _tr("sample", _t)
+                # On a bandwidth-limited host link every blocking device→host
+                # metric fetch costs a round trip; fetch_train_metrics_every=k
+                # samples the train metrics every k-th burst (always on the last
+                # burst before a log boundary), 1 = every burst (default),
+                # 0 = log boundaries only. Log boundaries are crossed by policy
+                # steps, not bursts, so look ahead one real burst period
+                # (bursts recur every max(train_every//update_steps,1) updates,
+                # NOT every train_every policy steps when the two don't divide):
+                # if the threshold falls before the next burst, this is the
+                # burst whose metrics that log will see.
+                burst_updates = max(int(cfg.algo.train_every) // policy_steps_per_update, 1)
+                burst_period = burst_updates * policy_steps_per_update
+                will_log = cfg.metric.log_level > 0 and (
+                    policy_step - last_log + burst_period >= cfg.metric.log_every
+                    # the run's last burst feeds the final update==num_updates log
+                    # even when that update itself is not a burst
+                    or update + burst_updates > num_updates
                 )
-                per_rank_gradient_steps += n_samples
-                _t = _tr("train_dispatch", _t)
-                if metrics is not None and fetch_metrics:
-                    metrics = jax.device_get(metrics)
-                else:
-                    # pacing barrier: one scalar pull per burst bounds the
-                    # host's dispatch run-ahead. Unbounded run-ahead on a
-                    # remote-attached device lets per-call overhead compound
-                    # (measured: acting latency grows without this); on local
-                    # devices the wait is the device's own step time.
-                    np.asarray(metrics["Loss/world_model_loss"])
-                    metrics = None
-                _t = _tr("metric_fetch", _t)
-                if use_packed_player:
-                    play_packed = play_packed_new
-                else:
-                    play_wm = wm_mirror(agent_state["params"]["world_model"])
-                    play_actor = actor_mirror(agent_state["params"]["actor"])
-                train_step += world_size
+                fetch_every = int(cfg.metric.get("fetch_train_metrics_every", 1))
+                fetch_metrics = (
+                    aggregator is not None
+                    and not aggregator.disabled
+                    and (
+                        will_log
+                        or (fetch_every > 0 and (train_step // world_size) % fetch_every == 0)
+                    )
+                )
+                # NOTE: when the metric fetch below is skipped, nothing in this
+                # block waits on the device — train_fn dispatch is async, so the
+                # timer records dispatch time and the device compute overlaps the
+                # next acting phase (that overlap is the point on a remote-
+                # attached chip). Time/sps_train is only device-accurate on
+                # bursts that fetch.
+                with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+                    # the whole burst (n_samples gradient steps) is ONE dispatch:
+                    # per-call overhead on a remote-attached device scales with
+                    # the state pytree's leaf count and would otherwise repeat
+                    # per gradient step (build_train_fn burst notes)
+                    taus = np.zeros(n_samples, np.float32)
+                    for i in range(n_samples):
+                        g = per_rank_gradient_steps + i
+                        if g % cfg.algo.critic.target_network_update_freq == 0:
+                            taus[i] = 1.0 if g == 0 else cfg.algo.critic.tau
+                    if use_device_ring:
+                        batches = local_data  # already stacked on device
+                    else:
+                        # ship native dtypes (uint8 pixels = 4x less than f32
+                        # over the host->HBM link) straight to the sharding;
+                        # the train step normalizes on device
+                        batches = jax.device_put(local_data, burst_sharding)
+                    root_key, train_key = jax.random.split(root_key)
+                    agent_state, metrics, play_packed_new = train_fn.burst(
+                        agent_state,
+                        batches,
+                        jax.random.split(train_key, n_samples),
+                        jnp.asarray(taus),
+                    )
+                    per_rank_gradient_steps += n_samples
+                    _t = _tr("train_dispatch", _t)
+                    if metrics is not None and fetch_metrics:
+                        metrics = jax.device_get(metrics)
+                    else:
+                        # pacing barrier: one scalar pull per burst bounds the
+                        # host's dispatch run-ahead. Unbounded run-ahead on a
+                        # remote-attached device lets per-call overhead compound
+                        # (measured: acting latency grows without this); on local
+                        # devices the wait is the device's own step time.
+                        np.asarray(metrics["Loss/world_model_loss"])
+                        metrics = None
+                    _t = _tr("metric_fetch", _t)
+                    if use_packed_player:
+                        play_packed = play_packed_new
+                    else:
+                        play_wm = wm_mirror(agent_state["params"]["world_model"])
+                        play_actor = actor_mirror(agent_state["params"]["actor"])
+                    train_step += world_size
             updates_before_training = cfg.algo.train_every // policy_steps_per_update
             if cfg.algo.actor.expl_decay:
                 expl_decay_steps += 1

@@ -58,9 +58,14 @@ def _load_run_config(ckpt_path: str):
     )
 
 
-def resume_from_checkpoint(cfg) -> Any:
+def resume_from_checkpoint(cfg, overrides: Optional[Sequence[str]] = None) -> Any:
     """Merge the checkpoint run's persisted config into the current one
-    (reference cli.py:22-45): the old config wins except for runtime keys."""
+    (reference cli.py:22-45): the old config wins except for runtime keys.
+
+    ``overrides`` is the raw CLI override list; the training horizon is only
+    taken from the resuming command when it was *explicitly* overridden there,
+    otherwise the checkpointed run's ``total_steps`` is preserved (a bare
+    resume must not silently reset the horizon to the exp default)."""
     ckpt_path = cfg.checkpoint.resume_from
     old_cfg, _ = _load_run_config(ckpt_path)
     if old_cfg.env.id != cfg.env.id:
@@ -78,10 +83,15 @@ def resume_from_checkpoint(cfg) -> Any:
     old_cfg.root_dir = cfg.root_dir
     old_cfg.run_name = cfg.run_name
     old_cfg.fabric = cfg.fabric
-    # the resuming command also controls the training horizon, so a finished
-    # run can be extended ("train for another N steps") — the counters inside
-    # the checkpoint keep the already-done progress either way
-    old_cfg.total_steps = cfg.total_steps
+    # the resuming command controls the training horizon only when it says so
+    # explicitly ("train for another N steps"); a bare resume keeps the
+    # checkpointed run's horizon — the counters inside the checkpoint keep the
+    # already-done progress either way
+    explicit_total = any(
+        o.split("=", 1)[0].lstrip("+~") == "total_steps" for o in (overrides or [])
+    )
+    if explicit_total:
+        old_cfg.total_steps = cfg.total_steps
     return old_cfg
 
 
@@ -259,7 +269,7 @@ def run(args: Optional[Sequence[str]] = None) -> None:
     if cfg.metric.log_level > 0:
         print_config(cfg)
     if cfg.checkpoint.resume_from:
-        cfg = resume_from_checkpoint(cfg)
+        cfg = resume_from_checkpoint(cfg, list(args) if args is not None else sys.argv[1:])
     check_configs(cfg)
     run_algorithm(cfg)
 

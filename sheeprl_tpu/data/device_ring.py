@@ -188,9 +188,46 @@ class DeviceRingReplay:
     # -- device plumbing ---------------------------------------------------
 
     def _allocate(self, example_row: Dict[str, np.ndarray]) -> None:
+        import warnings
+
         import jax
         import jax.numpy as jnp
 
+        # the ring is (capacity + overlap) x n_envs of EVERY key in HBM; with
+        # DV3's default buffer.size=1e6 of 64x64x3 uint8 pixels that is ~12 GB
+        # before model/optimizer state. Fail with the computed size (and the
+        # size that fits) instead of an opaque XLA allocation error later.
+        rows = self._capacity + self._overlap
+        bytes_per_row = sum(
+            int(np.prod(np.asarray(v).shape)) * np.asarray(v).dtype.itemsize * self._n_envs
+            for v in example_row.values()
+        )
+        total = rows * bytes_per_row
+        limit = None
+        try:
+            stats = self._device.memory_stats()
+            limit = stats.get("bytes_limit") if stats else None
+        except Exception:
+            pass
+        if limit and total > 0.95 * limit:
+            # certain OOM: the ring alone leaves no room for params/optimizer
+            fit_rows = max(int(0.5 * limit / max(bytes_per_row, 1)) - self._overlap, 0)
+            raise ValueError(
+                f"DeviceRingReplay would allocate {total / 2**30:.2f} GiB "
+                f"({rows} rows x {bytes_per_row} B) on a device with a "
+                f"{limit / 2**30:.2f} GiB limit; a ring of <= {fit_rows} per-env "
+                f"rows fits in half the device (buffer.size <= "
+                f"{fit_rows * self._n_envs} under the buffer.size//n_envs "
+                "convention), or disable buffer.device_ring"
+            )
+        if (limit and total > 0.6 * limit) or total > 4 * 2**30:
+            warnings.warn(
+                f"DeviceRingReplay allocating {total / 2**30:.2f} GiB of HBM "
+                f"({rows} per-env rows x {bytes_per_row} B"
+                + (f", device limit {limit / 2**30:.2f} GiB" if limit else "")
+                + "); lower buffer.size if the device OOMs",
+                UserWarning,
+            )
         with jax.default_device(self._device):
             self._buf = {
                 k: jnp.zeros(
@@ -236,16 +273,21 @@ class DeviceRingReplay:
         oob = self._capacity + self._overlap
         t_idx = np.full(padded, oob, np.int32)  # OOB → dropped
         e_idx = np.zeros(padded, np.int32)
+        slots_arr = np.asarray(slots, np.int64).reshape(n, 2)
+        envs, ts = slots_arr[:, 0], slots_arr[:, 1] % self._capacity
+        # group slots by env and gather each env's rows with one fancy-index
+        # read (the per-row Python loop was thousands of small copies per
+        # flush on a 1-core host, inside the env-interaction timer)
+        by_env = {int(env): np.nonzero(envs == env)[0] for env in np.unique(envs)}
         rows: Dict[str, np.ndarray] = {}
         for k, v0 in sub0._buf.items():
             first = _as_np(v0)[0, 0]
             stack = np.zeros((padded,) + first.shape, first.dtype)
-            for i, (env, t) in enumerate(slots):
-                stack[i] = _as_np(self._rb.buffer[env]._buf[k])[t % self._capacity, 0]
+            for env, pos in by_env.items():
+                stack[pos] = _as_np(self._rb.buffer[env]._buf[k])[ts[pos], 0]
             rows[k] = stack
-        for i, (env, t) in enumerate(slots):
-            t_idx[i] = t
-            e_idx[i] = env
+        t_idx[:n] = slots_arr[:, 1]
+        e_idx[:n] = envs
         self._buf = self._scatter_fn(padded)(self._buf, t_idx, e_idx, rows)
         self._staged.clear()
 

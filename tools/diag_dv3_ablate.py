@@ -1,0 +1,72 @@
+"""Ablations for the DV3 policy-improvement failure mechanism.
+
+Hypothesis: REINFORCE collapses onto an arbitrary action when the two-hot
+critic lags the (legitimately growing) lambda-returns, making the advantage
+all-positive while entropy regularization is too weak to keep exploring.
+If true, a faster critic (A) or a slower actor + stronger entropy (B)
+fixes it with NO change to the algorithm.
+
+Usage: python tools/diag_dv3_ablate.py A|B|C [n_steps]
+"""
+import importlib
+import sys
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from sheeprl_tpu.config.engine import compose
+from sheeprl_tpu.fabric import Fabric
+from tests.test_algos.test_policy_improvement import _SIZES, _action_reward_batch
+
+mode = sys.argv[1]
+N_STEPS = int(sys.argv[2]) if len(sys.argv) > 2 else 170
+
+ablate = {
+    # A: critic tracks 10x faster
+    "A": ["algo.actor.optimizer.lr=1e-2", "algo.critic.optimizer.lr=3e-2"],
+    # B: slower actor + 20x entropy bonus
+    "B": ["algo.actor.optimizer.lr=3e-3", "algo.actor.ent_coef=6e-3"],
+    # C: both moderate
+    "C": ["algo.actor.optimizer.lr=3e-3", "algo.critic.optimizer.lr=1e-2",
+          "algo.actor.ent_coef=3e-3"],
+}[mode]
+
+cfg = compose("config", overrides=[
+    "exp=dreamer_v3", "env=dummy", "env.id=discrete_dummy", *_SIZES,
+    "algo.world_model.stochastic_size=8",
+    "algo.world_model.discrete_size=8",
+    *ablate,
+])
+fabric = Fabric(devices=1, accelerator="cpu")
+agent_mod = importlib.import_module("sheeprl_tpu.algos.dreamer_v3.agent")
+algo_mod = importlib.import_module("sheeprl_tpu.algos.dreamer_v3.dreamer_v3")
+obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+world_model, actor, critic, params = agent_mod.build_agent(
+    cfg, (4,), False, obs_space, jax.random.PRNGKey(0)
+)
+world_tx, actor_tx, critic_tx, agent_state = algo_mod.build_optimizers_and_state(cfg, params)
+train_fn = algo_mod.build_train_fn(
+    world_model, actor, critic, world_tx, actor_tx, critic_tx, cfg, fabric, (4,), False
+)
+rng = np.random.default_rng(0)
+batch = {k: jnp.asarray(v) for k, v in _action_reward_batch(16, 8, 4, rng, True).items()}
+
+rew = []
+key = jax.random.PRNGKey(1)
+for i in range(N_STEPS):
+    key, k = jax.random.split(key)
+    agent_state, metrics = train_fn(agent_state, batch, k, jnp.float32(1.0 if i == 0 else 0.02))
+    rew.append(float(np.asarray(metrics["User/PredictedRewards"])))
+    if i % 20 == 0 or i == N_STEPS - 1:
+        pv = float(np.asarray(metrics["User/PredictedValues"]))
+        lam = float(np.asarray(metrics["User/LambdaValues"]))
+        adv = float(np.asarray(metrics["User/Advantages"]))
+        ent = float(np.asarray(metrics["User/Entropy"]))
+        print(f"[{mode}] step {i:4d}  pred_rew {rew[-1]:+.4f}  lambda {lam:+.4f}  "
+              f"value {pv:+.4f}  adv {adv:+.4f}  ent {ent:+.5f}", flush=True)
+
+early, late = np.mean(rew[:10]), np.mean(rew[-10:])
+print(f"[{mode}] early {early:.3f} late {late:.3f} -> {'PASS' if late > 0.45 else 'FAIL'}", flush=True)

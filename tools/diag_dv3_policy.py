@@ -1,0 +1,77 @@
+"""Diagnose the DV3 policy-improvement failure: is the actor moving toward
+or away from the rewarded action, and is the reward head even learned?
+
+Runs the exact test setup for N steps, probing:
+- p(action 0) under the actor on the data posteriors
+- reward-head prediction on latents where action 0 was / wasn't taken
+- the advantage sign correlation with action-0 log-prob
+"""
+import importlib
+import sys
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from sheeprl_tpu.config.engine import compose
+from sheeprl_tpu.fabric import Fabric
+from tests.test_algos.test_policy_improvement import _SIZES, _action_reward_batch
+
+N_STEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+
+cfg = compose("config", overrides=[
+    "exp=dreamer_v3", "env=dummy", "env.id=discrete_dummy", *_SIZES,
+    "algo.world_model.stochastic_size=8",
+    "algo.world_model.discrete_size=8",
+    "algo.actor.optimizer.lr=1e-2",
+])
+fabric = Fabric(devices=1, accelerator="cpu")
+agent_mod = importlib.import_module("sheeprl_tpu.algos.dreamer_v3.agent")
+algo_mod = importlib.import_module("sheeprl_tpu.algos.dreamer_v3.dreamer_v3")
+obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+world_model, actor, critic, params = agent_mod.build_agent(
+    cfg, (4,), False, obs_space, jax.random.PRNGKey(0)
+)
+world_tx, actor_tx, critic_tx, agent_state = algo_mod.build_optimizers_and_state(cfg, params)
+train_fn = algo_mod.build_train_fn(
+    world_model, actor, critic, world_tx, actor_tx, critic_tx, cfg, fabric, (4,), False
+)
+rng = np.random.default_rng(0)
+batch = {k: jnp.asarray(v) for k, v in _action_reward_batch(16, 8, 4, rng, True).items()}
+
+key = jax.random.PRNGKey(1)
+for i in range(N_STEPS):
+    key, k = jax.random.split(key)
+    agent_state, metrics = train_fn(agent_state, batch, k, jnp.float32(1.0 if i == 0 else 0.02))
+    if i % 20 == 0 or i == N_STEPS - 1:
+        # probe: actor's p(a=0) on the posterior latents from this batch
+        from sheeprl_tpu.algos.dreamer_v3.agent import WorldModel
+        pr = float(np.asarray(metrics["User/PredictedRewards"]))
+        adv = float(np.asarray(metrics["User/Advantages"]))
+        ent = float(np.asarray(metrics["User/Entropy"]))
+        lam = float(np.asarray(metrics["User/LambdaValues"]))
+        rl = float(np.asarray(metrics.get("Loss/reward_loss", np.nan)))
+        pl = float(np.asarray(metrics["Loss/policy_loss"]))
+        print(f"step {i:4d}  pred_rew {pr:+.4f}  lambda {lam:+.4f}  adv {adv:+.4f}  "
+              f"ent {ent:+.4f}  rew_loss {rl:.4f}  pol_loss {pl:+.5f}", flush=True)
+
+# final probe: run the actor on fresh posterior latents and report p(a=0)
+params = agent_state["params"]
+# embed the batch obs through the world model to get posteriors (reuse the
+# dynamic-learning path): easiest — call the wm loss path pieces via a tiny
+# rollout using actor on zero latent is not representative; instead sample
+# latents from imagination starting states by re-running one train step and
+# capturing pre-activations. Simpler: apply actor to a grid of random latents.
+S = int(cfg.algo.world_model.stochastic_size)
+D = int(cfg.algo.world_model.discrete_size)
+R = int(cfg.algo.world_model.recurrent_model.recurrent_state_size)
+k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+z = jax.nn.one_hot(jax.random.randint(k1, (256, S), 0, D), D).reshape(256, S * D)
+h = jax.random.normal(k2, (256, R)) * 0.5
+lat = jnp.concatenate([z, h], -1)
+pre = actor.apply({"params": params["actor"]}, lat)
+logits = pre[0] if isinstance(pre, (list, tuple)) else pre
+probs = jax.nn.softmax(logits, -1)
+print("mean action probs on random latents:", np.asarray(probs.mean(0)).round(4))
