@@ -128,8 +128,18 @@ def test_dreamer_v2_policy_improves_on_frozen_reward_structure():
 
 
 def test_dreamer_v3_policy_improves_on_frozen_reward_structure():
-    # DV3's two-hot symlog reward head + REINFORCE objective need more steps
-    # than the Gaussian-head families to clear the random-policy rate
+    # DV3 needs ~3.5x the budget of the Gaussian-head families (round-4
+    # root-cause, tools/diag_dv3_probe.py): the 255-bin two-hot reward head
+    # first converges to the constant marginal (~0.63 NLL) and only
+    # discriminates the action->reward mapping after ~400-500 joint steps —
+    # the action signal lives in a ~0.04-magnitude channel of the trained
+    # recurrent state (a fresh head fits it in ~400 steps; wiring verified
+    # action-sensitive at init and matching the reference's shifted-action
+    # convention). Until then REINFORCE sees an actor-independent reward
+    # landscape and drifts; once the head discriminates, the actor locks
+    # onto the rewarded action within ~50 steps (0.85 imagined rate by step
+    # 600 vs the 0.45 bar). 170 steps — the round-3 budget — fails every
+    # time for ANY correct implementation of this objective.
     _policy_improves(
         "dreamer_v3", "dreamer_v3",
         [
@@ -137,7 +147,7 @@ def test_dreamer_v3_policy_improves_on_frozen_reward_structure():
             "algo.world_model.discrete_size=8",
             "algo.actor.optimizer.lr=1e-2",
         ],
-        has_tau=True, shift=True, n_steps=170,
+        has_tau=True, shift=True, n_steps=600,
     )
 
 

@@ -1,0 +1,168 @@
+"""Probe whether the DV3 world model's IMAGINED latents carry the action
+signal the reward head needs.
+
+Trains wm+actor+critic on the synthetic action-0-pays batch for N steps,
+then rolls the imagination forward with FORCED action sequences (always
+action 0 vs always action 3) and reports the reward head's predictions per
+horizon step. A healthy world model predicts ~1 under forced-0 and ~0 under
+forced-3 from step 1 on; action-independent predictions mean the
+imagination path (prior/recurrent/reward wiring) loses the action.
+
+Also reports the reward head on the TRAINING posteriors (should track the
+data rewards) for contrast.
+"""
+import importlib
+import sys
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from sheeprl_tpu.config.engine import compose
+from sheeprl_tpu.fabric import Fabric
+from tests.test_algos.test_policy_improvement import _SIZES, _action_reward_batch
+
+N_STEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 170
+
+cfg = compose("config", overrides=[
+    "exp=dreamer_v3", "env=dummy", "env.id=discrete_dummy", *_SIZES,
+    "algo.world_model.stochastic_size=8",
+    "algo.world_model.discrete_size=8",
+    "algo.actor.optimizer.lr=1e-2",
+])
+fabric = Fabric(devices=1, accelerator="cpu")
+agent_mod = importlib.import_module("sheeprl_tpu.algos.dreamer_v3.agent")
+algo_mod = importlib.import_module("sheeprl_tpu.algos.dreamer_v3.dreamer_v3")
+from sheeprl_tpu.algos.dreamer_v3.agent import WorldModel
+from sheeprl_tpu.distributions.distributions import TwoHotEncodingDistribution
+
+obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+world_model, actor, critic, params = agent_mod.build_agent(
+    cfg, (4,), False, obs_space, jax.random.PRNGKey(0)
+)
+world_tx, actor_tx, critic_tx, agent_state = algo_mod.build_optimizers_and_state(cfg, params)
+train_fn = algo_mod.build_train_fn(
+    world_model, actor, critic, world_tx, actor_tx, critic_tx, cfg, fabric, (4,), False
+)
+rng = np.random.default_rng(0)
+np_batch = _action_reward_batch(16, 8, 4, rng, True)
+batch = {k: jnp.asarray(v) for k, v in np_batch.items()}
+
+key = jax.random.PRNGKey(1)
+for i in range(N_STEPS):
+    key, k = jax.random.split(key)
+    agent_state, metrics = train_fn(agent_state, batch, k, jnp.float32(1.0 if i == 0 else 0.02))
+print(f"trained {N_STEPS} steps; rew_loss={float(np.asarray(metrics['Loss/reward_loss'])):.4f}",
+      flush=True)
+
+wm_params = agent_state["params"]["world_model"]
+S, D = 8, 8
+rec_size = int(cfg.algo.world_model.recurrent_model.recurrent_state_size)
+T, B = 16, 8
+
+
+def wm_apply(method, *args):
+    return world_model.apply({"params": wm_params}, *args, method=method)
+
+
+# --- 1. reward head on TRAINING posteriors: replays the wm_loss_fn scan ---
+batch_obs = {"rgb": batch["rgb"] / 255.0}
+is_first = batch["is_first"].at[0].set(1.0)
+batch_actions = jnp.concatenate(
+    [jnp.zeros_like(batch["actions"][:1]), batch["actions"][:-1]], axis=0
+)
+embedded = wm_apply(WorldModel.encode, batch_obs)
+embed_proj = wm_apply(WorldModel.project_embed, embedded)
+init_post = wm_apply(WorldModel.initial_posterior, jnp.zeros((1, rec_size)))
+
+
+def step(carry, inp):
+    posterior, recurrent = carry
+    action, eproj, first, g = inp
+    recurrent, posterior, post_logits = world_model.apply(
+        {"params": wm_params}, posterior, recurrent, action, eproj, first,
+        init_post, None, g, method=WorldModel.dynamic_posterior,
+    )
+    return (posterior, recurrent), (recurrent, posterior)
+
+
+gumbels = jax.random.gumbel(jax.random.PRNGKey(5), (T, B, S, D))
+(_, _), (recurrents, posteriors) = jax.lax.scan(
+    step, (jnp.zeros((B, S * D)), jnp.zeros((B, rec_size))),
+    (batch_actions, embed_proj, is_first, gumbels),
+)
+latents = jnp.concatenate([posteriors, recurrents], -1)
+pred_r = TwoHotEncodingDistribution(wm_apply(WorldModel.reward_logits, latents), dims=1).mean
+true_r = np_batch["rewards"]
+pred_r = np.asarray(pred_r)
+m1 = true_r[..., 0] > 0.5
+print(f"training latents: pred_r | r=1: {pred_r[..., 0][m1].mean():+.4f}   "
+      f"pred_r | r=0: {pred_r[..., 0][~m1].mean():+.4f}", flush=True)
+
+# --- 1b. is the TRAINED recurrent state still action-sensitive? ---
+a0 = jnp.tile(jax.nn.one_hot(jnp.asarray([0]), 4), (z0_shape := 8, 1))
+a3 = jnp.tile(jax.nn.one_hot(jnp.asarray([3]), 4), (8, 1))
+zz = posteriors[5, :8]
+hh = recurrents[5, :8]
+g8 = jax.random.gumbel(jax.random.PRNGKey(3), (8, S, D))
+_, h_a0 = wm_apply(WorldModel.imagination, zz, hh, a0, None, g8)
+_, h_a3 = wm_apply(WorldModel.imagination, zz, hh, a3, None, g8)
+print(f"trained h action-sensitivity: max|h(a0)-h(a3)| = "
+      f"{float(jnp.abs(h_a0 - h_a3).max()):.6f}", flush=True)
+lat_a0 = jnp.concatenate([zz, h_a0], -1)
+lat_a3 = jnp.concatenate([zz, h_a3], -1)
+r_a0 = TwoHotEncodingDistribution(wm_apply(WorldModel.reward_logits, lat_a0), dims=1).mean
+r_a3 = TwoHotEncodingDistribution(wm_apply(WorldModel.reward_logits, lat_a3), dims=1).mean
+print(f"reward head on (z fixed, h(a0)) vs (z fixed, h(a3)): "
+      f"{float(r_a0.mean()):+.4f} vs {float(r_a3.mean()):+.4f}", flush=True)
+
+# --- 1c. can a FRESH head discriminate from the trained latents? ---
+import optax
+from sheeprl_tpu.algos.dreamer_v3.agent import MLPWithHead
+
+head = MLPWithHead(output_dim=255, mlp_layers=1, dense_units=32)
+hp = head.init(jax.random.PRNGKey(42), latents[:1, :1])["params"]
+htx = optax.adam(3e-3)
+hopt = htx.init(hp)
+lat_sg = jax.lax.stop_gradient(latents)
+rew_t = jnp.asarray(np_batch["rewards"])
+
+
+def hloss(p):
+    d = TwoHotEncodingDistribution(head.apply({"params": p}, lat_sg), dims=1)
+    return -d.log_prob(rew_t).mean(), d.mean
+
+
+@jax.jit
+def hstep(p, o):
+    (l, m), g = jax.value_and_grad(hloss, has_aux=True)(p)
+    up, o = htx.update(g, o, p)
+    return optax.apply_updates(p, up), o, l, m
+
+
+for i in range(400):
+    hp, hopt, hl, hm = hstep(hp, hopt)
+hm = np.asarray(hm)[..., 0]
+m1 = np_batch["rewards"][..., 0] > 0.5
+print(f"fresh head on trained latents (400 steps): loss {float(hl):.4f}  "
+      f"pred|1 {hm[m1].mean():+.4f}  pred|0 {hm[~m1].mean():+.4f}", flush=True)
+
+# --- 2. imagination with FORCED actions ---
+z0 = posteriors.reshape(-1, S * D)
+h0 = recurrents.reshape(-1, rec_size)
+for forced in (0, 3):
+    a = jnp.tile(jax.nn.one_hot(jnp.asarray([forced]), 4), (z0.shape[0], 1))
+    z, h = z0, h0
+    preds = []
+    k = jax.random.PRNGKey(9)
+    for t in range(5):
+        k, kk = jax.random.split(k)
+        g = jax.random.gumbel(kk, (z.shape[0], S, D))
+        z, h = wm_apply(WorldModel.imagination, z, h, a, None, g)
+        lat = jnp.concatenate([z, h], -1)
+        r = TwoHotEncodingDistribution(wm_apply(WorldModel.reward_logits, lat), dims=1).mean
+        preds.append(float(np.asarray(r).mean()))
+    print(f"imagined rollout, forced action {forced}: per-step pred_r "
+          + " ".join(f"{p:+.4f}" for p in preds), flush=True)
