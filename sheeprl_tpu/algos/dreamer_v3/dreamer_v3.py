@@ -746,13 +746,17 @@ def main(fabric, cfg: Dict[str, Any]):
     )
     # Device-resident ring: transitions stream to HBM once at collection and
     # train batches are gathered on device — no per-gradient-step host→device
-    # pixel upload (data/device_ring.py). Single-mesh-device path for now.
-    use_device_ring = bool(cfg.buffer.get("device_ring", False)) and world_size == 1
-    if cfg.buffer.get("device_ring", False) and not use_device_ring:
+    # pixel upload (data/device_ring.py). On a multi-device mesh the ring
+    # shards itself env-wise over the data axis: each device keeps a private
+    # ring shard and gathers exactly the batch slice it consumes.
+    use_device_ring = bool(cfg.buffer.get("device_ring", False))
+    if use_device_ring and world_size > 1 and n_envs % world_size != 0:
         warnings.warn(
-            "buffer.device_ring=True is only supported on single-device meshes; "
-            f"falling back to host-staged batches (world_size={world_size})."
+            "buffer.device_ring=True needs env.num_envs divisible by the "
+            f"data-axis device count (got {n_envs} envs over {world_size} "
+            "devices); falling back to host-staged batches."
         )
+        use_device_ring = False
     if use_device_ring:
         from sheeprl_tpu.data.device_ring import DeviceRingReplay
 
@@ -761,6 +765,9 @@ def main(fabric, cfg: Dict[str, Any]):
             device=fabric.device,
             seed=cfg.seed,
             sequence_overlap=int(cfg.per_rank_sequence_length),
+            batch_sharding=(
+                fabric.sharding(None, None, fabric.data_axis) if world_size > 1 else None
+            ),
         )
     if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
         rb.load_state_dict(state["rb"])

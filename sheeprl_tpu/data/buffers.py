@@ -812,14 +812,27 @@ class EnvIndependentReplayBuffer:
             )
 
     def pick_envs(
-        self, batch_size: int, rng: Optional[np.random.Generator] = None
+        self,
+        batch_size: int,
+        rng: Optional[np.random.Generator] = None,
+        envs: Optional[Sequence[int]] = None,
     ) -> Tuple[List[int], np.ndarray]:
         """Balanced env mix over the sub-buffers that hold data — shared by
-        host sampling and the device-ring gather planner."""
+        host sampling and the device-ring gather planner (which restricts
+        ``envs`` to one mesh shard's group so the eligibility rule lives in
+        exactly one place)."""
         rng = self._rng if rng is None else rng
-        with_data = [i for i, b in enumerate(self._buf) if not b.empty and (b.full or b._pos > 0)]
+        candidates = range(len(self._buf)) if envs is None else envs
+        with_data = [
+            int(i) for i in candidates
+            if not self._buf[i].empty and (self._buf[i].full or self._buf[i]._pos > 0)
+        ]
         if not with_data:
-            raise ValueError("No sample has been added to the buffer")
+            raise ValueError(
+                "No sample has been added to the buffer"
+                if envs is None
+                else f"No sample has been added to any of envs {list(envs)}"
+            )
         picks = rng.integers(0, len(with_data), size=batch_size)
         return with_data, np.bincount(picks, minlength=len(with_data))
 

@@ -17,13 +17,25 @@ Design:
   per-env geometry.
 - **Index planning stays on the host and reuses the host buffers' own
   logic** (:meth:`SequentialReplayBuffer.plan_starts`,
-  :meth:`EnvIndependentReplayBuffer.pick_envs`), so sampling semantics can
-  never diverge between the two paths; only the final *gather* runs on
-  device.
+  :meth:`EnvIndependentReplayBuffer.pick_envs` semantics), so sampling
+  semantics can never diverge between the two paths; only the final
+  *gather* runs on device.
 - Writes are **staged and flushed lazily** (one scatter per training burst,
   padded to shape buckets so XLA compiles a handful of programs); padding
   rows carry out-of-bounds targets and are dropped by the scatter
   (``mode="drop"``).
+- **Multi-chip**: pass ``batch_sharding`` (the train burst's
+  ``NamedSharding``, batch axis sharded over the mesh ``data`` axis) and the
+  ring shards itself over the mesh: envs are split into one contiguous group
+  per data-axis device — group *g* homed on exactly the device that consumes
+  batch slice *g* (derived from the sharding's index map) — and every device
+  owns a private ring shard with device-local scatter/gather jits.
+  ``sample_device`` plans each device's batch columns among its *local* envs
+  (uniform within the group, like the host's ``pick_envs`` is uniform
+  globally) and assembles the global ``[n, L, B, ...]`` batch with
+  :func:`jax.make_array_from_single_device_arrays` — transitions cross the
+  host link once to their home device, gathers are local DMA, and the
+  assembled batch needs **no resharding collective** inside the train step.
 """
 
 from __future__ import annotations
@@ -41,13 +53,27 @@ def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def _batch_shard_count(batch_sharding) -> int:
+    """Distinct shards along the batch axis (dim 2) of the burst sharding."""
+    spec = tuple(batch_sharding.spec)
+    entry = spec[2] if len(spec) > 2 else None
+    if entry is None:
+        return 1
+    axes = (entry,) if isinstance(entry, str) else tuple(entry)
+    size = 1
+    for a in axes:
+        size *= int(batch_sharding.mesh.shape[a])
+    return size
+
+
 class DeviceRingReplay:
     """Wrap an :class:`EnvIndependentReplayBuffer` with a device-side mirror.
 
     ``add`` forwards to the host buffer and stages the same rows for the
     device ring; ``sample_device`` returns a dict of **device** arrays shaped
     ``[n_samples, sequence_length, batch, ...]`` (the same layout as the host
-    ``sample``), produced by an on-device gather.
+    ``sample``), produced by an on-device gather. With ``batch_sharding`` the
+    arrays are global jax Arrays sharded batch-wise over the mesh.
     """
 
     #: flush scatters are padded to multiples of this many rows so repeated
@@ -60,6 +86,7 @@ class DeviceRingReplay:
         device: Optional[Any] = None,
         seed: Optional[int] = None,
         sequence_overlap: int = 64,
+        batch_sharding: Optional[Any] = None,
     ):
         import jax
 
@@ -74,11 +101,55 @@ class DeviceRingReplay:
         # slower than the same bytes as contiguous block DMA (measured:
         # ~0.5 s/sample at 100k rows vs ~ms for blocks).
         self._overlap = max(0, min(int(sequence_overlap), self._capacity))
-        self._device = device if device is not None else jax.devices()[0]
         self._rng = np.random.default_rng(seed)
-        # device storage, allocated lazily on the first add (dtypes/shapes
-        # are discovered from the data, like the host buffer does)
-        self._buf: Optional[Dict[str, Any]] = None
+        self._sharding = batch_sharding
+
+        if batch_sharding is not None:
+            n_groups = _batch_shard_count(batch_sharding)
+            if self._n_envs < n_groups or self._n_envs % n_groups != 0:
+                # uneven groups would silently oversample the smaller groups'
+                # envs relative to the host path's global-uniform pick_envs
+                raise ValueError(
+                    f"DeviceRingReplay needs the same number of envs on every "
+                    f"batch shard: n_envs={self._n_envs} does not divide over "
+                    f"{n_groups} data-axis shards"
+                )
+            # device that OWNS batch slice g (plus any replicas along other
+            # mesh axes): probe the index map with a [1, 1, n_groups] shape —
+            # slice starts enumerate the shard order along the batch dim
+            probe = batch_sharding.addressable_devices_indices_map((1, 1, n_groups))
+            by_slice: Dict[int, List[Any]] = {}
+            for dev, idx in probe.items():
+                start = idx[2].start or 0
+                by_slice.setdefault(int(start), []).append(dev)
+            if sorted(by_slice) != list(range(n_groups)):
+                raise ValueError(
+                    "DeviceRingReplay: batch sharding is not addressable shard-"
+                    "per-slice from this process (multi-host meshes must pass "
+                    "a process-local batch sharding)"
+                )
+            self._homes = [sorted(by_slice[g], key=lambda d: d.id)[0] for g in range(n_groups)]
+            self._replicas = [
+                [d for d in sorted(by_slice[g], key=lambda d: d.id) if d is not self._homes[g]]
+                for g in range(n_groups)
+            ]
+        else:
+            self._homes = [device if device is not None else jax.devices()[0]]
+            self._replicas = [[]]
+
+        n_groups = len(self._homes)
+        self._groups: List[np.ndarray] = [
+            np.asarray(g, np.int64) for g in np.array_split(np.arange(self._n_envs), n_groups)
+        ]
+        self._env_group = np.empty(self._n_envs, np.int64)
+        self._env_col = np.empty(self._n_envs, np.int64)
+        for g, envs in enumerate(self._groups):
+            self._env_group[envs] = g
+            self._env_col[envs] = np.arange(len(envs))
+
+        # per-group device storage, allocated lazily on the first add
+        # (dtypes/shapes are discovered from the data, like the host buffer)
+        self._shards: Optional[List[Dict[str, Any]]] = None
         # staged (env, target_index) slots; row *values* are read back from
         # the host buffer at flush time (it owns the newest copy of every
         # slot, so no per-step duplicate row copies are held here)
@@ -104,6 +175,19 @@ class DeviceRingReplay:
     def n_envs(self) -> int:
         return self._rb.n_envs
 
+    @property
+    def _device(self):
+        return self._homes[0]
+
+    @property
+    def _buf(self) -> Optional[Dict[str, Any]]:
+        """Single-shard view (tests / single-device introspection)."""
+        if self._shards is None:
+            return None
+        if len(self._shards) != 1:
+            raise AttributeError("_buf is only defined for single-shard rings")
+        return self._shards[0]
+
     def seed(self, seed: Optional[int] = None) -> None:
         self._rb.seed(seed)
         self._rng = np.random.default_rng(seed)
@@ -113,11 +197,11 @@ class DeviceRingReplay:
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         """Restore the host buffer, then re-mirror its filled region to the
-        device as one contiguous block upload per key."""
+        device shards as one contiguous block upload per key per shard."""
         import jax
 
         self._rb.load_state_dict(state)
-        self._buf = None
+        self._shards = None
         self._staged.clear()
         n_rows = np.zeros(self._n_envs, np.int64)
         example: Optional[Dict[str, np.ndarray]] = None
@@ -127,17 +211,9 @@ class DeviceRingReplay:
             n_rows[env] = sub.buffer_size if sub.full else sub._pos
             if example is None:
                 example = {k: _as_np(v)[0, 0] for k, v in sub._buf.items()}
-        max_rows = int(n_rows.max()) if example is not None else 0
-        if max_rows == 0:
+        if example is None or int(n_rows.max()) == 0:
             return
         self._allocate(example)
-        blocks: Dict[str, np.ndarray] = {}
-        for k, v0 in example.items():
-            block = np.zeros((max_rows, self._n_envs) + np.asarray(v0).shape, np.asarray(v0).dtype)
-            for env, sub in enumerate(self._rb.buffer):
-                if sub._buf is not None and n_rows[env] > 0:
-                    block[: n_rows[env], env] = _as_np(sub._buf[k])[: n_rows[env], 0]
-            blocks[k] = block
         cap, ov = self._capacity, self._overlap
 
         def _set(v, b):
@@ -151,7 +227,22 @@ class DeviceRingReplay:
             lambda buf, blk: {k: _set(v, blk[k]) for k, v in buf.items()},
             donate_argnums=(0,),
         )
-        self._buf = set_block(self._buf, blocks)
+        for g, envs in enumerate(self._groups):
+            max_rows = int(n_rows[envs].max()) if len(envs) else 0
+            if max_rows == 0:
+                continue
+            blocks: Dict[str, np.ndarray] = {}
+            for k, v0 in example.items():
+                block = np.zeros(
+                    (max_rows, len(envs)) + np.asarray(v0).shape, np.asarray(v0).dtype
+                )
+                for col, env in enumerate(envs):
+                    sub = self._rb.buffer[env]
+                    if sub._buf is not None and n_rows[env] > 0:
+                        block[: n_rows[env], col] = _as_np(sub._buf[k])[: n_rows[env], 0]
+                blocks[k] = block
+            blocks = jax.device_put(blocks, self._homes[g])
+            self._shards[g] = set_block(self._shards[g], blocks)
 
     # -- write path --------------------------------------------------------
 
@@ -193,19 +284,21 @@ class DeviceRingReplay:
         import jax
         import jax.numpy as jnp
 
-        # the ring is (capacity + overlap) x n_envs of EVERY key in HBM; with
-        # DV3's default buffer.size=1e6 of 64x64x3 uint8 pixels that is ~12 GB
-        # before model/optimizer state. Fail with the computed size (and the
-        # size that fits) instead of an opaque XLA allocation error later.
+        # every shard is (capacity + overlap) x group_envs of EVERY key in
+        # HBM; with DV3's default buffer.size=1e6 of 64x64x3 uint8 pixels the
+        # whole ring is ~12 GB before model/optimizer state. Fail with the
+        # computed size (and the size that fits) instead of an opaque XLA
+        # allocation error later.
         rows = self._capacity + self._overlap
+        max_group = max(len(g) for g in self._groups)
         bytes_per_row = sum(
-            int(np.prod(np.asarray(v).shape)) * np.asarray(v).dtype.itemsize * self._n_envs
+            int(np.prod(np.asarray(v).shape)) * np.asarray(v).dtype.itemsize * max_group
             for v in example_row.values()
         )
-        total = rows * bytes_per_row
+        total = rows * bytes_per_row  # largest single-device shard
         limit = None
         try:
-            stats = self._device.memory_stats()
+            stats = self._homes[0].memory_stats()
             limit = stats.get("bytes_limit") if stats else None
         except Exception:
             pass
@@ -223,19 +316,22 @@ class DeviceRingReplay:
         if (limit and total > 0.6 * limit) or total > 4 * 2**30:
             warnings.warn(
                 f"DeviceRingReplay allocating {total / 2**30:.2f} GiB of HBM "
-                f"({rows} per-env rows x {bytes_per_row} B"
+                f"per device ({rows} per-env rows x {bytes_per_row} B"
                 + (f", device limit {limit / 2**30:.2f} GiB" if limit else "")
                 + "); lower buffer.size if the device OOMs",
                 UserWarning,
             )
-        with jax.default_device(self._device):
-            self._buf = {
-                k: jnp.zeros(
-                    (self._capacity + self._overlap, self._n_envs) + np.asarray(v).shape,
-                    np.asarray(v).dtype,
+        self._shards = []
+        for g, envs in enumerate(self._groups):
+            with jax.default_device(self._homes[g]):
+                self._shards.append(
+                    {
+                        k: jnp.zeros(
+                            (rows, len(envs)) + np.asarray(v).shape, np.asarray(v).dtype
+                        )
+                        for k, v in example_row.items()
+                    }
                 )
-                for k, v in example_row.items()
-            }
 
     def _scatter_fn(self, n_rows: int):
         import jax
@@ -253,6 +349,8 @@ class DeviceRingReplay:
         return fn
 
     def _flush(self) -> None:
+        import jax
+
         if not self._staged:
             return
         # dedupe (env, t) slots: XLA's scatter leaves the winner among
@@ -262,57 +360,61 @@ class DeviceRingReplay:
         # buffer, which always holds the newest write for a slot.
         slots = list(dict.fromkeys(self._staged))
         sub0 = self._rb.buffer[slots[0][0]]
-        if self._buf is None:
+        if self._shards is None:
             self._allocate({k: _as_np(v)[0, 0] for k, v in sub0._buf.items()})
         # head rows are mirrored into the shadow region past the tail so
         # wrapped sequences stay physically contiguous (value read from the
         # same host slot)
         slots.extend([(env, t + self._capacity) for env, t in slots if t < self._overlap])
-        n = len(slots)
-        padded = _round_up(n, self.FLUSH_BUCKET)
-        oob = self._capacity + self._overlap
-        t_idx = np.full(padded, oob, np.int32)  # OOB → dropped
-        e_idx = np.zeros(padded, np.int32)
-        slots_arr = np.asarray(slots, np.int64).reshape(n, 2)
+        slots_arr = np.asarray(slots, np.int64).reshape(len(slots), 2)
         envs, ts = slots_arr[:, 0], slots_arr[:, 1] % self._capacity
-        # group slots by env and gather each env's rows with one fancy-index
-        # read (the per-row Python loop was thousands of small copies per
-        # flush on a 1-core host, inside the env-interaction timer)
-        by_env = {int(env): np.nonzero(envs == env)[0] for env in np.unique(envs)}
-        rows: Dict[str, np.ndarray] = {}
-        for k, v0 in sub0._buf.items():
-            first = _as_np(v0)[0, 0]
-            stack = np.zeros((padded,) + first.shape, first.dtype)
-            for env, pos in by_env.items():
-                stack[pos] = _as_np(self._rb.buffer[env]._buf[k])[ts[pos], 0]
-            rows[k] = stack
-        t_idx[:n] = slots_arr[:, 1]
-        e_idx[:n] = envs
-        self._buf = self._scatter_fn(padded)(self._buf, t_idx, e_idx, rows)
+        oob = self._capacity + self._overlap
+        for g in range(len(self._groups)):
+            sel = np.nonzero(self._env_group[envs] == g)[0]
+            if sel.size == 0:
+                continue
+            n = int(sel.size)
+            padded = _round_up(n, self.FLUSH_BUCKET)
+            t_idx = np.full(padded, oob, np.int32)  # OOB → dropped
+            e_idx = np.zeros(padded, np.int32)
+            t_idx[:n] = slots_arr[sel, 1]
+            e_idx[:n] = self._env_col[envs[sel]]
+            # group slots by env and gather each env's rows with one
+            # fancy-index read (a per-row Python loop was thousands of small
+            # copies per flush on a 1-core host, inside the acting timer);
+            # the (src rows, dst positions) maps depend only on the env split
+            by_env = {}
+            for env in np.unique(envs[sel]):
+                pos = sel[np.nonzero(envs[sel] == env)[0]]
+                by_env[int(env)] = (pos, np.searchsorted(sel, pos))
+            rows: Dict[str, np.ndarray] = {}
+            for k, v0 in sub0._buf.items():
+                first = _as_np(v0)[0, 0]
+                stack = np.zeros((padded,) + first.shape, first.dtype)
+                for env, (pos, dst) in by_env.items():
+                    stack[dst] = _as_np(self._rb.buffer[env]._buf[k])[ts[pos], 0]
+                rows[k] = stack
+            payload = jax.device_put((t_idx, e_idx, rows), self._homes[g])
+            self._shards[g] = self._scatter_fn(padded)(self._shards[g], *payload)
         self._staged.clear()
 
     # -- sample path -------------------------------------------------------
 
-    def _plan_indices(
-        self, batch_size: int, sequence_length: int, n_samples: int
+    def _plan_group(
+        self, envs: np.ndarray, batch: int, sequence_length: int, n_samples: int
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """Host-side index plan reusing the host buffers' own sampling logic
-        (``pick_envs`` + per-env ``plan_starts``).
+        """Host-side index plan for one group, reusing the host buffers' own
+        sampling logic (``pick_envs`` restricted to the group's envs + per-env
+        ``plan_starts``).
 
-        Returns ``(starts [n_samples * batch], e_idx [n_samples * batch])``
+        Returns ``(starts [n_samples * batch], cols [n_samples * batch])``
         ordered sample-major with per-env column groups, matching the host
         ``EnvIndependentReplayBuffer.sample`` concat layout. Starts are
         physical ring rows; a sequence always occupies the ``L`` contiguous
         rows from its start thanks to the shadow region.
         """
-        if batch_size <= 0 or n_samples <= 0:
-            raise ValueError(
-                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
-            )
-        if sequence_length <= 0:
-            raise ValueError(f"'sequence_length' ({sequence_length}) must be greater than 0")
         L = sequence_length
-        with_data, counts = self._rb.pick_envs(batch_size, self._rng)
+        with_data, counts = self._rb.pick_envs(batch, self._rng, envs=[int(e) for e in envs])
         starts_by_env: List[np.ndarray] = []
         envs_order: List[int] = []
         for j, env in enumerate(with_data):
@@ -322,13 +424,15 @@ class DeviceRingReplay:
             starts = self._rb.buffer[env].plan_starts(c * n_samples, L, rng=self._rng)
             starts_by_env.append(np.asarray(starts).reshape(n_samples, c))
             envs_order.append(env)
-        # sample-major: [n_samples, B] starts / envs, flattened
         all_starts = np.concatenate(starts_by_env, axis=1)  # [n_samples, B]
-        all_envs = np.concatenate(
-            [np.full((n_samples, s.shape[1]), e, np.int32) for s, e in zip(starts_by_env, envs_order)],
+        all_cols = np.concatenate(
+            [
+                np.full((n_samples, s.shape[1]), self._env_col[e], np.int32)
+                for s, e in zip(starts_by_env, envs_order)
+            ],
             axis=1,
         )
-        return all_starts.reshape(-1).astype(np.int32), all_envs.reshape(-1).astype(np.int32)
+        return all_starts.reshape(-1).astype(np.int32), all_cols.reshape(-1).astype(np.int32)
 
     def _gather_fn(self, n_rows: int, L: int, n_samples: int):
         import jax
@@ -364,7 +468,17 @@ class DeviceRingReplay:
         self, batch_size: int, sequence_length: int = 1, n_samples: int = 1
     ) -> Dict[str, Any]:
         """Gather ``[n_samples, sequence_length, batch, ...]`` batches on
-        device. The only host→device traffic is the int32 index plan."""
+        device. The only host→device traffic is the int32 index plan. With a
+        ``batch_sharding`` the result is a global sharded Array whose batch
+        slice *g* was gathered (and stays) on the device that consumes it."""
+        import jax
+
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        if sequence_length <= 0:
+            raise ValueError(f"'sequence_length' ({sequence_length}) must be greater than 0")
         if sequence_length > max(self._overlap, 1) and any(
             b.full for b in self._rb.buffer
         ):
@@ -373,9 +487,36 @@ class DeviceRingReplay:
                 f"sequence_overlap {self._overlap}; construct DeviceRingReplay "
                 "with sequence_overlap >= the training sequence length"
             )
+        n_groups = len(self._groups)
+        if batch_size % n_groups != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must divide evenly over the "
+                f"{n_groups} batch shards"
+            )
         self._flush()
-        if self._buf is None:
+        if self._shards is None:
             raise ValueError("No sample has been added to the buffer")
-        starts, e_idx = self._plan_indices(batch_size, sequence_length, n_samples)
-        fn = self._gather_fn(starts.shape[0], sequence_length, n_samples)
-        return fn(self._buf, starts, e_idx)
+        b_local = batch_size // n_groups
+        parts: List[Dict[str, Any]] = []
+        for g, envs in enumerate(self._groups):
+            starts, cols = self._plan_group(envs, b_local, sequence_length, n_samples)
+            fn = self._gather_fn(starts.shape[0], sequence_length, n_samples)
+            starts, cols = jax.device_put((starts, cols), self._homes[g])
+            parts.append(fn(self._shards[g], starts, cols))
+        if self._sharding is None:
+            return parts[0]
+        # assemble the global batch: shard g is already resident on its home
+        # device; replicas along non-data mesh axes (if any) receive a copy
+        out: Dict[str, Any] = {}
+        for k in parts[0]:
+            shape = parts[0][k].shape
+            global_shape = (shape[0], shape[1], batch_size) + shape[3:]
+            arrays = []
+            for g in range(n_groups):
+                arrays.append(parts[g][k])
+                for dev in self._replicas[g]:
+                    arrays.append(jax.device_put(parts[g][k], dev))
+            out[k] = jax.make_array_from_single_device_arrays(
+                global_shape, self._sharding, arrays
+            )
+        return out
