@@ -749,12 +749,15 @@ def main(fabric, cfg: Dict[str, Any]):
     # pixel upload (data/device_ring.py). On a multi-device mesh the ring
     # shards itself env-wise over the data axis: each device keeps a private
     # ring shard and gathers exactly the batch slice it consumes.
+    # (n_envs = num_envs * world_size always divides over the data axis; the
+    # unsupported case is MULTI-PROCESS, where the global batch sharding is
+    # not addressable shard-per-slice from one process)
     use_device_ring = bool(cfg.buffer.get("device_ring", False))
-    if use_device_ring and world_size > 1 and n_envs % world_size != 0:
+    if use_device_ring and jax.process_count() > 1:
         warnings.warn(
-            "buffer.device_ring=True needs env.num_envs divisible by the "
-            f"data-axis device count (got {n_envs} envs over {world_size} "
-            "devices); falling back to host-staged batches."
+            "buffer.device_ring=True is not supported on multi-process "
+            f"(multi-host) runs yet ({jax.process_count()} processes); "
+            "falling back to host-staged batches."
         )
         use_device_ring = False
     if use_device_ring:

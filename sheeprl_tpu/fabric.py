@@ -312,14 +312,21 @@ class Fabric:
     # ------------------------------------------------------------------
 
     def save(self, path: str, state: Dict[str, Any]) -> None:
-        """Checkpoint a state pytree. Only process 0 writes (single-host);
-        multi-host Orbax coordinates all processes."""
+        """Checkpoint a state pytree. EVERY process must call this: Orbax's
+        Checkpointer.save runs its own cross-process sync barriers
+        (multihost.sync_global_processes) even for host-local numpy state —
+        gating the call to one process deadlocks the world at save_start.
+        For replicated (non-sharded) values only the primary host writes
+        bytes; the final barrier below keeps any immediate reader from
+        racing the atomic rename (exercised end-to-end by
+        tests/test_runtime/distributed_worker.py)."""
         import orbax.checkpoint as ocp
 
         path = os.path.abspath(path)
         state = jax.device_get(state)
         with ocp.PyTreeCheckpointer() as ckptr:
             ckptr.save(path, state, force=True)
+        self.barrier("fabric-save")  # no-op single-process
 
     def load(self, path: str, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Restore a checkpoint pytree (reference fabric.load semantics).

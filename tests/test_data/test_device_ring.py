@@ -236,7 +236,6 @@ def test_sharded_sample_rows_match_host_rows():
         ring.add(_step(i, 4))
     out = ring.sample_device(batch_size=4, sequence_length=3, n_samples=2)
     # replay the plan with an identical rng
-    replay = np.random.default_rng(11)
     rng_state_ring = ring._rng.bit_generator.state  # after planning
     ring._rng = np.random.default_rng(11)
     plans = [

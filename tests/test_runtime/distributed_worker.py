@@ -64,7 +64,37 @@ def main() -> None:
     got = fabric.broadcast({"p": payload})
     np.testing.assert_array_equal(got["p"], [42.0, 7.0])
 
-    # 6. barrier completes
+    # 6. checkpoint round trip across the 2-process world: EVERY rank calls
+    # fabric.save (Orbax's save runs its own cross-process sync — gating the
+    # call to rank 0 deadlocks at save_start; only the primary host writes
+    # bytes), both ranks restore, and the restored tree must be
+    # bitwise-identical to the original on BOTH ranks (VERDICT round-3 item
+    # #6: multi-host checkpointing was untested)
+    import tempfile
+
+    state = {
+        "params": {
+            "w": np.arange(12, dtype=np.float32).reshape(3, 4) * (1.0 + 1e-7),
+            "b": np.array([1.5, -2.25], np.float32),
+        },
+        "update": np.int64(7),
+    }
+    ckpt_dir = os.path.join(
+        tempfile.gettempdir(), f"sheeprl_tpu_dist_ckpt_{port}", "ckpt"
+    )
+    fabric.save(ckpt_dir, state)
+    # the non-writer must see a COMPLETE checkpoint immediately post-barrier
+    restored = fabric.load(ckpt_dir)
+    np.testing.assert_array_equal(restored["params"]["w"], state["params"]["w"])
+    np.testing.assert_array_equal(restored["params"]["b"], state["params"]["b"])
+    assert int(restored["update"]) == 7
+    fabric.barrier("post-restore")
+    if process_id == 0:
+        import shutil
+
+        shutil.rmtree(os.path.dirname(ckpt_dir), ignore_errors=True)
+
+    # 7. barrier completes
     fabric.barrier("test-end")
     print(f"WORKER{process_id} PASS", flush=True)
 
