@@ -55,7 +55,6 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     update_moments,
 )
 from sheeprl_tpu.config.instantiate import instantiate
-from sheeprl_tpu.ops.imagination import fused_imagination_supported
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.distributions import MSEDistribution, SymlogDistribution, TwoHotEncodingDistribution
@@ -205,85 +204,21 @@ def build_train_fn(
     # actor loss via imagination (reference train :230-345)
     # ------------------------------------------------------------------
 
-    # EXPERIMENTAL fused pallas rollout (ops/imagination.py): single discrete
-    # action head on TPU. The discrete objective is REINFORCE on re-evaluated
-    # log-probs, so the rollout is gradient-free and a forward-only kernel
-    # applies — every weight stays VMEM-resident across the whole horizon.
-    # Measured on v5e: 1.6x over the lax scan standalone (2.06 vs 3.28 ms).
-    # In-graph (S preset, bf16, rbg): 14.67 vs 14.55 ms — the d-major
-    # consumer-kernel permutation (dmajor_module_params) eliminated the
-    # round-1 trajectory transpose (+0.5 -> +0.12 ms), but the remaining
-    # custom-call scheduling barrier (XLA cannot overlap async weight
-    # prefetches across the pallas region) plus the per-step pack gathers
-    # still edge out the kernel's standalone win. Off by default; flipping it
-    # on is correct and tested, just not faster. The remaining idea that
-    # could make it win: absorb the reward/critic head evaluation into the
-    # kernel so the barrier buys fewer downstream reads.
-    use_fused = (
-        bool(cfg.algo.get("fused_imagination", False))
-        and fused_imagination_supported(is_continuous, dims)
-        and fabric.device.platform == "tpu"
-    )
+    # A fused Pallas rollout kernel lived here through round 3 (VMEM-resident
+    # weights over the whole horizon; 1.6x over the lax scan standalone) but
+    # never beat the lax path in-graph: the custom-call scheduling barrier —
+    # XLA cannot overlap async weight prefetches across a pallas region —
+    # plus per-step pack gathers cost more than the kernel saved (14.67 vs
+    # 14.55 ms at the S preset, bf16). Retired in round 4; the lax scan IS
+    # the fast path. History: ops/imagination.py before commit 5430c2d.
     S, D = int(wm_cfg.stochastic_size), int(wm_cfg.discrete_size)
-    n_actor_layers = int(cfg.algo.actor.mlp_layers)
-    from sheeprl_tpu.fabric import compute_dtype_from_precision
-
-    compute_dtype = compute_dtype_from_precision(cfg.fabric.get("precision", "32-true"))
-
-    def fused_rollout(wm_params, actor_params, posteriors, recurrents, key):
-        from sheeprl_tpu.ops.imagination import dmajor_perm, pack_params, rollout_pallas
-
-        # the discrete rollout is gradient-free (REINFORCE objective); cut
-        # tangents at the kernel inputs — pallas_call has no JVP rule and the
-        # actor params being differentiated would otherwise be traced into it
-        z0 = sg(posteriors.reshape(-1, stoch_flat))
-        h0 = sg(recurrents.reshape(-1, rec_size))
-        n = z0.shape[0]
-        packed = sg(
-            pack_params(
-                actor_params, wm_params["rssm"], n_actor_layers, S, D, rec_size,
-                dtype=compute_dtype or jnp.float32,
-            )
-        )
-        kz, ka = jax.random.split(key)
-        # gz is drawn s-major but the kernel consumes it d-major: i.i.d.
-        # gumbel noise makes the layouts statistically equivalent, and
-        # skipping the transpose avoids a [H+1, n, S*D] relayout. This DOES
-        # break bit-parity with the lax path / the tests' d-major convention;
-        # transpose like tests/test_ops/test_imagination.py when A/B-ing.
-        gz = jax.random.gumbel(kz, (horizon + 1, n, stoch_flat))
-        ga = jax.random.gumbel(ka, (horizon + 1, n, dims[0]))
-        z0_dm = z0[:, dmajor_perm(S, D)]
-        lat_dm, actions = rollout_pallas(
-            packed, z0_dm, h0, gz, ga,
-            H=horizon + 1, S=S, D=D, A=dims[0], rec=rec_size,
-            n_actor_layers=n_actor_layers, unimix=unimix, tile=256,
-        )
-        # keep the kernel's d-major latent layout: instead of physically
-        # transposing the [H, N, S*D] trajectory back to s-major (a 60 MB
-        # copy at the S preset), every downstream consumer's *first-layer
-        # kernel z-rows* are permuted to d-major (a few [S*D, units] weight
-        # gathers — see _dmajor_params)
-        latent0_dm = jnp.concatenate([z0_dm, h0], -1)
-        traj_dm = jnp.concatenate([latent0_dm[None], lat_dm[:horizon]], 0)
-        return sg(traj_dm), sg(actions)
-
-    def _dmajor_params(mparams):
-        from sheeprl_tpu.ops.imagination import dmajor_module_params
-
-        return dmajor_module_params(mparams, S, D)
 
     def imagination_rollout(wm_params, actor_params, posteriors, recurrents, key):
         """15-step prior rollout from every (t, b) posterior. Returns
         ``(trajectories [H+1, BT, L], actions [H+1, BT, A])``.
 
-        Lax path: gradients flow through the actor's straight-through /
-        rsample actions (needed by the continuous dynamics-backprop
-        objective). Fused pallas path (discrete/REINFORCE only): fully
-        stop-gradient'd — valid because that objective re-evaluates
-        log-probs on ``sg(traj)``/``sg(a)`` outside the rollout."""
-        if use_fused:
-            return fused_rollout(wm_params, actor_params, posteriors, recurrents, key)
+        Gradients flow through the actor's straight-through / rsample
+        actions (needed by the continuous dynamics-backprop objective)."""
         prior = posteriors.reshape(-1, stoch_flat)
         recurrent = recurrents.reshape(-1, rec_size)
         latent0 = jnp.concatenate([prior, recurrent], -1)
@@ -331,22 +266,14 @@ def build_train_fn(
         traj, imagined_actions = imagination_rollout(
             wm_params, actor_params, posteriors, recurrents, key
         )
-        # fused path: traj latents are d-major; permute each consumer's
-        # first-layer kernel instead of transposing the trajectory
-        actor_c, critic_c, wm_rm, wm_cm = actor_params, critic_params, wm_params, wm_params
-        if use_fused:
-            actor_c = _dmajor_params(actor_params)
-            critic_c = _dmajor_params(critic_params)
-            wm_rm = {**wm_params, "reward_model": _dmajor_params(wm_params["reward_model"])}
-            wm_cm = {**wm_params, "continue_model": _dmajor_params(wm_params["continue_model"])}
         predicted_values = TwoHotEncodingDistribution(
-            critic.apply({"params": critic_c}, traj), dims=1
+            critic.apply({"params": critic_params}, traj), dims=1
         ).mean
         predicted_rewards = TwoHotEncodingDistribution(
-            wm_apply(wm_rm, WorldModel.reward_logits, traj), dims=1
+            wm_apply(wm_params, WorldModel.reward_logits, traj), dims=1
         ).mean
         continues = continue_distribution(
-            wm_apply(wm_cm, WorldModel.continue_logits, traj)
+            wm_apply(wm_params, WorldModel.continue_logits, traj)
         ).base.mode
         continues = jnp.concatenate([true_continue[None], continues[1:]], 0)
 
@@ -355,7 +282,7 @@ def build_train_fn(
         )
         discount = sg(jnp.cumprod(continues * gamma, axis=0) / gamma)
 
-        pre = actor.apply({"params": actor_c}, sg(traj))
+        pre = actor.apply({"params": actor_params}, sg(traj))
         policies = build_actor_dists(
             pre, is_continuous, distribution, init_std, min_std, unimix
         )
@@ -395,13 +322,11 @@ def build_train_fn(
     # ------------------------------------------------------------------
 
     def critic_loss_fn(critic_params, target_params, traj, lambda_values, discount):
-        critic_c = _dmajor_params(critic_params) if use_fused else critic_params
-        target_c = _dmajor_params(target_params) if use_fused else target_params
         qv = TwoHotEncodingDistribution(
-            critic.apply({"params": critic_c}, traj[:-1]), dims=1
+            critic.apply({"params": critic_params}, traj[:-1]), dims=1
         )
         target_values = TwoHotEncodingDistribution(
-            critic.apply({"params": target_c}, traj[:-1]), dims=1
+            critic.apply({"params": target_params}, traj[:-1]), dims=1
         ).mean
         value_loss = -qv.log_prob(lambda_values) - qv.log_prob(sg(target_values))
         return jnp.mean(value_loss * discount[:-1, ..., 0])
