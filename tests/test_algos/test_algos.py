@@ -179,3 +179,32 @@ def test_droq(tmp_path, devices, monkeypatch):
 def test_unknown_algorithm(tmp_path):
     with pytest.raises(Exception):
         cli.run(standard_args(tmp_path) + ["exp=does_not_exist"])
+
+
+def test_resume_preserves_total_steps_unless_explicit(tmp_path):
+    """A bare resume must keep the checkpointed run's training horizon; only
+    an explicit total_steps= override on the resuming command replaces it
+    (round-4 advisor fix: the exp default silently reset the horizon)."""
+    from sheeprl_tpu.cli import resume_from_checkpoint
+    from sheeprl_tpu.config.engine import compose, to_yaml
+
+    old = compose("config", overrides=["exp=ppo", "env=dummy", "total_steps=12345"])
+    log_dir = tmp_path / "run" / ".hydra"
+    log_dir.mkdir(parents=True)
+    (log_dir / "config.yaml").write_text(to_yaml(old))
+    ckpt = tmp_path / "run" / "checkpoint" / "ckpt_8"
+    ckpt.mkdir(parents=True)
+
+    # bare resume: the exp-default total_steps must NOT replace 12345
+    cfg = compose("config", overrides=["exp=ppo", "env=dummy",
+                                       f"checkpoint.resume_from={ckpt}"])
+    merged = resume_from_checkpoint(cfg, [f"checkpoint.resume_from={ckpt}"])
+    assert int(merged.total_steps) == 12345
+
+    # explicit override: the resuming command's horizon wins
+    cfg = compose("config", overrides=["exp=ppo", "env=dummy", "total_steps=777",
+                                       f"checkpoint.resume_from={ckpt}"])
+    merged = resume_from_checkpoint(
+        cfg, ["total_steps=777", f"checkpoint.resume_from={ckpt}"]
+    )
+    assert int(merged.total_steps) == 777

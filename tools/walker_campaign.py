@@ -67,8 +67,9 @@ def main() -> None:
     args = ap.parse_args()
 
     run_name = "walker_campaign_r4"
+    # layout: logs/runs/<algo>/<env_id>/<run_name>/version_K/checkpoint/ckpt_N_0
     ckpt_glob = os.path.join(
-        REPO, "logs", "runs", "dreamer_v3", "*", f"*{run_name}*", "checkpoint", "ckpt_*"
+        REPO, "logs", "runs", "dreamer_v3", "*", f"*{run_name}*", "*", "checkpoint", "ckpt_*"
     )
     base = [
         f"exp={args.exp}",
@@ -120,7 +121,13 @@ def main() -> None:
                 "episodes_seen": len(rewards),
                 "last_rewards": [round(r, 1) for r in rewards[-8:]],
                 "best_reward": round(max(all_rewards), 1) if all_rewards else None,
-                "stderr_tail": (err or "").strip().splitlines()[-3:],
+                # drop the XLA AOT-cache warning spam (KBs per line) so the
+                # heartbeat stays readable and small
+                "stderr_tail": [
+                    l[:300]
+                    for l in (err or "").strip().splitlines()
+                    if "cpu_aot_loader" not in l
+                ][-3:],
             }
         )
         if rc not in ("timeout", 0) and new_step == step:
