@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.models.norm import FastLayerNorm
+
 from sheeprl_tpu.distributions import (
     Bernoulli,
     Independent,
@@ -341,7 +343,7 @@ class _RepresentationModel(nn.Module):
             (self.h_size + self.embed_size, self.hidden_size),
         )
         if self.layer_norm:
-            self.norm = nn.LayerNorm(epsilon=1e-3, dtype=self.dtype, name="trunk_ln")
+            self.norm = FastLayerNorm(epsilon=1e-3, dtype=self.dtype, name="trunk_ln")
         else:
             self.bias = self.param(
                 "trunk_bias", nn.initializers.zeros_init(), (self.hidden_size,)

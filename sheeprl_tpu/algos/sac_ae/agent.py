@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.models.norm import FastLayerNorm
+
 from sheeprl_tpu.models.models import MLP, resolve_activation
 
 LOG_STD_MAX = 2.0
@@ -69,7 +71,7 @@ class SACAECNNEncoder(nn.Module):
         if detach_conv:
             x = sg(x)
         x = nn.Dense(self.features_dim)(x)
-        x = nn.LayerNorm()(x)
+        x = FastLayerNorm(name="LayerNorm_0")(x)
         x = jnp.tanh(x)
         return jnp.reshape(x, lead + (self.features_dim,))
 

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.models.norm import FastLayerNorm
+
 # ---------------------------------------------------------------------------
 # activation resolution (accepts jax-style names and torch-style class paths,
 # so reference config trees run unchanged)
@@ -118,14 +120,19 @@ class MLP(nn.Module):
         norms = _broadcast(self.layer_norm, n)
         biases = _broadcast(self.bias, n)
         act = resolve_activation(self.activation)
+        ln_idx = 0
         for i, size in enumerate(self.hidden_sizes):
             x = nn.Dense(
                 size, use_bias=biases[i], param_dtype=self.param_dtype, dtype=self.dtype
             )(x)
             if norms[i]:
-                x = nn.LayerNorm(
-                    epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype
+                # FastLayerNorm named like nn.LayerNorm's auto-scheme so
+                # checkpoints are unaffected (models/norm.py)
+                x = FastLayerNorm(
+                    epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype,
+                    name=f"LayerNorm_{ln_idx}",
                 )(x)
+                ln_idx += 1
             x = act(x)
             if self.dropout > 0.0:
                 x = nn.Dropout(self.dropout, deterministic=deterministic)(x)
@@ -181,6 +188,7 @@ class CNN(nn.Module):
         biases = _broadcast(self.bias, n)
         act = resolve_activation(self.activation)
         x, lead = _to_nhwc(x)
+        ln_idx = 0
         for i, ch in enumerate(self.channels):
             pad = pd[i] if isinstance(pd[i], str) else [(pd[i], pd[i])] * 2
             x = nn.Conv(
@@ -194,10 +202,13 @@ class CNN(nn.Module):
             )(x)
             if norms[i]:
                 # LayerNorm over the channel axis — NHWC makes the reference's
-                # LayerNormChannelLast permute dance (utils/model.py:225-235) free
-                x = nn.LayerNorm(
-                    epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype
+                # LayerNormChannelLast permute dance (utils/model.py:225-235)
+                # free; FastLayerNorm = one-pass custom-VJP backward
+                x = FastLayerNorm(
+                    epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype,
+                    name=f"LayerNorm_{ln_idx}",
                 )(x)
+                ln_idx += 1
             x = act(x)
         if self.flatten:
             x = jnp.reshape(x, (x.shape[0], -1))
@@ -230,6 +241,7 @@ class DeCNN(nn.Module):
         biases = _broadcast(self.bias, n)
         act = resolve_activation(self.activation)
         x, lead = _to_nhwc(x)
+        ln_idx = 0
         for i, ch in enumerate(self.channels):
             # configs carry torch-style transposed-conv padding p
             # (out = (in-1)*s - 2p + k); flax's padding is the forward conv's,
@@ -250,9 +262,11 @@ class DeCNN(nn.Module):
                 dtype=self.dtype,
             )(x)
             if norms[i]:
-                x = nn.LayerNorm(
-                    epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype
+                x = FastLayerNorm(
+                    epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype,
+                    name=f"LayerNorm_{ln_idx}",
                 )(x)
+                ln_idx += 1
             if i < n - 1:
                 x = act(x)
             elif self.final_activation is not None:
@@ -311,7 +325,10 @@ class LayerNormGRUCell(nn.Module):
             3 * self.hidden_size, use_bias=self.bias, param_dtype=self.param_dtype, dtype=self.dtype
         )(inp)
         if self.layer_norm:
-            z = nn.LayerNorm(epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype)(z)
+            z = FastLayerNorm(
+                epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype,
+                name="LayerNorm_0",
+            )(z)
         reset, cand, update = jnp.split(z, 3, axis=-1)
         reset = jax.nn.sigmoid(reset)
         cand = jnp.tanh(reset * cand)
