@@ -307,7 +307,9 @@ def main(fabric, cfg: Dict[str, Any]):
                         )
 
         next_values = value_fn(play_params, next_obs)
-        returns, advantages = gae_fn(rb["rewards"], rb["values"], rb["dones"], next_values)
+        returns, advantages = gae_fn(
+            np.asarray(rb["rewards"]), np.asarray(rb["values"]), np.asarray(rb["dones"]), next_values
+        )
 
         def flat(x):
             x = jnp.asarray(x)

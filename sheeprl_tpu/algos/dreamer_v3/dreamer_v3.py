@@ -517,9 +517,8 @@ def main(fabric, cfg: Dict[str, Any]):
     n_envs = int(cfg.env.num_envs) * world_size
     from functools import partial
 
-    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
-
     from sheeprl_tpu.envs.wrappers import RestartOnException
+    from sheeprl_tpu.utils.env import vectorize_envs
 
     thunks = [
         partial(
@@ -540,8 +539,7 @@ def main(fabric, cfg: Dict[str, Any]):
     # simulator CPU burn out of this process, which matters doubly on a
     # remote-attached device — the accelerator client's IO threads live here
     # and starve behind a CPU-bound env loop
-    vectorized_env = SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv
-    envs = vectorized_env(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    envs = vectorize_envs(thunks, cfg)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 

@@ -58,9 +58,8 @@ from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_ten
 def make_vector_env(cfg, fabric, log_dir: str, n_envs: int):
     """SAME_STEP autoreset restores the reference's gym-0.29 vector semantics
     (final_obs / final_info emitted on the terminal step)."""
-    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+    from sheeprl_tpu.utils.env import vectorize_envs
 
-    vectorized_env = SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv
     thunks = [
         make_env(
             cfg,
@@ -72,7 +71,7 @@ def make_vector_env(cfg, fabric, log_dir: str, n_envs: int):
         )
         for i in range(n_envs)
     ]
-    return vectorized_env(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    return vectorize_envs(thunks, cfg)
 
 
 def build_update_fn(
@@ -425,7 +424,7 @@ def main(fabric, cfg: Dict[str, Any]):
         # GAE over the whole rollout (ppo.py:350-368), one fused scan on device
         next_values = value_fn(play_params, next_obs)
         returns, advantages = gae_fn(
-            rb["rewards"], rb["values"], rb["dones"], next_values
+            np.asarray(rb["rewards"]), np.asarray(rb["values"]), np.asarray(rb["dones"]), next_values
         )
 
         # Assemble the flat update batch: [T, n_envs, ...] → [n_envs*T, ...]

@@ -405,7 +405,9 @@ def main(fabric, cfg: Dict[str, Any]):
         next_values = value_fn(
             params, next_obs, jnp.asarray(prev_actions), jnp.asarray(is_first), hc
         )
-        returns, advantages = gae_fn(rb["rewards"], rb["values"], rb["dones"], next_values)
+        returns, advantages = gae_fn(
+            np.asarray(rb["rewards"]), np.asarray(rb["values"]), np.asarray(rb["dones"]), next_values
+        )
 
         # Chunk the rollout into [L, N_seq, ...] sequences: [T, E] → env-major
         # [(T/L)*E sequences] so device shards own whole envs.

@@ -67,15 +67,14 @@ def main(fabric, cfg: Dict[str, Any]):
         save_configs(cfg, log_dir)
 
     n_envs = int(cfg.env.num_envs) * world_size
-    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+    from sheeprl_tpu.utils.env import vectorize_envs
 
-    vectorized_env = SyncVectorEnv if cfg.env.sync_env else AsyncVectorEnv
-    envs = vectorized_env(
+    envs = vectorize_envs(
         [
             make_env(cfg, cfg.seed + i, 0, log_dir if fabric.is_global_zero else None, "train", vector_env_idx=i)
             for i in range(n_envs)
         ],
-        autoreset_mode=AutoresetMode.SAME_STEP,
+        cfg,
     )
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space

@@ -54,8 +54,24 @@ def _round_up(n: int, multiple: int) -> int:
 
 
 def _batch_shard_count(batch_sharding) -> int:
-    """Distinct shards along the batch axis (dim 2) of the burst sharding."""
+    """Distinct shards along the batch axis (dim 2) of the burst sharding.
+
+    The ring expects the burst layout ``[n_samples, seq, batch, ...]`` with
+    only dim 2 sharded (e.g. ``P(None, None, 'data')``). A spec that shards
+    some other dim — say a caller passed ``P('data')`` meant for a different
+    layout — would quietly build one shard here and then blow up deep inside
+    ``make_array_from_single_device_arrays`` at sample time, far from the
+    mistake, so validate eagerly.
+    """
     spec = tuple(batch_sharding.spec)
+    for dim, entry in enumerate(spec):
+        if dim != 2 and entry is not None:
+            raise ValueError(
+                "DeviceRingReplay batch_sharding must shard only the batch "
+                f"axis (dim 2) of the [n_samples, seq, batch, ...] burst; got "
+                f"PartitionSpec{spec} which shards dim {dim}. Pass e.g. "
+                "NamedSharding(mesh, P(None, None, 'data'))."
+            )
     entry = spec[2] if len(spec) > 2 else None
     if entry is None:
         return 1
@@ -414,7 +430,23 @@ class DeviceRingReplay:
         rows from its start thanks to the shadow region.
         """
         L = sequence_length
-        with_data, counts = self._rb.pick_envs(batch, self._rng, envs=[int(e) for e in envs])
+        try:
+            with_data, counts = self._rb.pick_envs(batch, self._rng, envs=[int(e) for e in envs])
+        except ValueError as exc:
+            # Intended behavior, made diagnosable: each device can only gather
+            # from its LOCAL ring shard, so an empty group cannot borrow
+            # another group's envs (the host path would sample globally; here
+            # that would require a cross-device read that defeats the ring's
+            # no-collective design). Groups fill in lockstep during normal
+            # collection — this only triggers when e.g. a checkpoint taken
+            # before every env had collected is restored under sharding.
+            raise ValueError(
+                f"Device-ring group {sorted(int(e) for e in envs)} has no "
+                "samples while sampling was requested. Sharded rings sample "
+                "per-group by design (device-local gathers); collect at least "
+                "one sequence on every env group before sampling, or restore "
+                "a checkpoint whose buffer covers all env groups."
+            ) from exc
         starts_by_env: List[np.ndarray] = []
         envs_order: List[int] = []
         for j, env in enumerate(with_data):

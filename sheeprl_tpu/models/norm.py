@@ -18,6 +18,15 @@ domain.
 
 ``FastLayerNorm`` is parameter-compatible with ``nn.LayerNorm`` (same
 ``scale``/``bias`` names and shapes): swapping it in changes no checkpoint.
+
+Memory trade (deliberate, account for it in HBM capacity planning): the
+forward saves ``x̂`` as a **float32** residual per LN instance, so under
+bf16 training each LayerNorm retains ~4 bytes/element of activation memory
+that flax's autodiff backward could rematerialize instead. At the S preset
+this is noise; at L/XL presets alongside the device replay ring it is part
+of the activation footprint the ``DeviceRingReplay`` HBM guard must leave
+headroom for (wrap training in ``jax.checkpoint`` over the scan if it ever
+binds — the residual then lives only inside one scan segment).
 """
 
 from __future__ import annotations

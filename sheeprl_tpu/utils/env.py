@@ -185,6 +185,30 @@ def make_env(
     return thunk
 
 
+def vectorize_envs(thunks, cfg):
+    """Build the train-time vector env with SAME_STEP autoreset (the
+    reference's gym-0.29 semantics: final_obs/final_info on the terminal
+    step).
+
+    Async workers use a NON-fork multiprocessing context (default
+    ``forkserver``, override via ``env.mp_context``): this process is
+    multithreaded the moment jax initializes its backends, and a plain
+    ``os.fork()`` of a multithreaded parent can deadlock in the child — every
+    round-4 walker segment logged that exact RuntimeWarning from
+    ``AsyncVectorEnv``'s fork-based workers. gymnasium cloudpickles the env
+    thunks, so closures survive the spawn-style start; workers pay a
+    one-time module re-import instead of inheriting COW pages.
+    """
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+
+    if cfg.env.sync_env:
+        return SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    context = str(cfg.env.get("mp_context", "forkserver") or "forkserver")
+    return AsyncVectorEnv(
+        thunks, autoreset_mode=AutoresetMode.SAME_STEP, context=context
+    )
+
+
 def get_dummy_env(id: str) -> gym.Env:  # noqa: A002 — kwarg name fixed by env/dummy.yaml
     """Deterministic dummy envs used by the test suite (reference env.py:206-221)."""
     env_id = id

@@ -63,10 +63,11 @@ def main() -> None:
     ap.add_argument("--segment-seconds", type=int, default=1500)
     ap.add_argument("--total-steps", type=int, default=100000)
     ap.add_argument("--exp", default="dreamer_v3_dmc_walker_walk_proprio")
+    ap.add_argument("--run-name", default="walker_campaign_r4")
     ap.add_argument("overrides", nargs="*")
     args = ap.parse_args()
 
-    run_name = "walker_campaign_r4"
+    run_name = args.run_name
     # layout: logs/runs/<algo>/<env_id>/<run_name>/version_K/checkpoint/ckpt_N_0
     ckpt_glob = os.path.join(
         REPO, "logs", "runs", "dreamer_v3", "*", f"*{run_name}*", "*", "checkpoint", "ckpt_*"
@@ -82,6 +83,11 @@ def main() -> None:
     ]
 
     all_rewards: list[float] = []
+    # previous segment's outcome, tracked in locals: the heartbeat file is the
+    # wrong place to re-read it from (its last lines are the current segment's
+    # own segment_start/segment_end beats)
+    prev_rc: object = None
+    prev_step_after: int | None = None
     for seg in range(args.segments):
         ckpt, step = _latest_checkpoint(ckpt_glob)
         if step >= args.total_steps:
@@ -130,13 +136,16 @@ def main() -> None:
                 ][-3:],
             }
         )
-        if rc not in ("timeout", 0) and new_step == step:
+        if (
+            rc not in ("timeout", 0)
+            and new_step == step
+            and prev_rc not in (None, "timeout", 0)
+            and prev_step_after == step
+        ):
             # crashed without progress twice in a row -> give up loudly
-            if seg > 0:
-                prev = json.loads(open(HEARTBEAT).read().strip().splitlines()[-2])
-                if prev.get("rc") not in ("timeout", 0) and prev.get("step_after") == step:
-                    _beat({"event": "abort_no_progress", "segment": seg, "step": step})
-                    break
+            _beat({"event": "abort_no_progress", "segment": seg, "step": step})
+            break
+        prev_rc, prev_step_after = rc, new_step
 
 
 if __name__ == "__main__":
