@@ -1,38 +1,51 @@
 """Benchmark entry: the full framework-speed matrix vs BASELINE.md.
 
-Prints one JSON line per workload; the LAST is the headline PPO number (the
-driver's parser takes the last line; the tail captures the whole matrix):
+Prints one JSON line per workload. Round-5 contract (VERDICT r04 item #1 —
+round 4's matrix overran the driver's timeout and lost every line the driver
+parses): the bench must be **un-timeout-able**.
 
-1. DreamerV3 S-preset (Atari-100K MsPacman config, bf16) gradient-steps/s
-   with the profiled device-ms per step — the north-star workload
-   (`BASELINE.md`: 100K policy steps in 14 h on a 3080 ≈ 2 grad-steps/s).
-   Run in a subprocess (`bench_dreamer.py`) so a failure there cannot take
-   down the headline bench. `device_ms_per_step` (in-run xplane profile) is
-   the trustworthy DV3 number; wall-clock through a shared relay is noisy.
-2. SAC: the reference's own protocol (`/root/reference/benchmarks/
-   benchmark_sb3.py:21-29`): LunarLanderContinuous, 4 envs, 1024*64 total
-   steps, test/logging/checkpoints disabled. Baseline 318.06 s (v0.5.2,
-   4 CPUs, 5 seeds). Gym retired the -v2 env; -v3 is physics-identical.
-3. DreamerV1 / DreamerV2 end-to-end micro-runs. The reference's
-   `dreamer_v{1,2}_benchmarks` exp configs are NOT in the snapshot, so the
-   rows 2921.38 s / 1148.1 s cannot be step-matched; each line carries the
-   exact workload we ran and `vs_baseline` is the raw wall-clock ratio with
-   that caveat recorded in `protocol`. Workload: default S recipes on the
-   64x64-pixel dummy env, total_steps past learning_starts so the measured
-   window covers prefill + real training bursts.
-4. PPO CartPole, the reference's own benchmark protocol (`README.md:92-104`
+- The headline PPO line runs FIRST (it is the cheapest line: ~5 s steady
+  per run) and is printed immediately; the full matrix is re-printed at the
+  end with the headline LAST, because the driver records a truncated *tail*
+  and parses the LAST line.
+- A **global wall budget** (env ``BENCH_WALL_BUDGET_S``, default 1080 s)
+  gates every stage: each subprocess gets ``timeout=remaining`` and a stage
+  whose minimum cost exceeds the remaining budget is SKIPPED with a
+  disclosed ``{"skipped": "budget"}`` line instead of blowing the deadline.
+- Stages run fastest-first after the headline: PPO → DV3 device-step →
+  SAC → DV2 → DV1 (the minutes-long micro-runs go last where only they can
+  be sacrificed).
+
+Workloads (protocols unchanged from round 4):
+
+1. PPO CartPole, the reference's own benchmark protocol (`README.md:92-104`
    / `benchmarks/benchmark.py:10-41`): 64 envs x 1024 rollout-collection
    steps (65536 policy steps), test/logging/checkpoints disabled,
    wall-clock around `cli.run`. Reference baseline: 80.81 s.
+2. DreamerV3 S-preset (Atari-100K MsPacman config, bf16) gradient-steps/s
+   with the profiled device-ms per step — the north-star workload
+   (`BASELINE.md`: 100K policy steps in 14 h on a 3080 ≈ 2 grad-steps/s).
+   Run in a subprocess (`bench_dreamer.py`) so a failure there cannot take
+   down the headline. `device_ms_per_step` (in-run xplane profile) is the
+   trustworthy DV3 number; wall-clock through a shared relay is noisy.
+3. SAC: the reference's own protocol (`/root/reference/benchmarks/
+   benchmark_sb3.py:21-29`): LunarLanderContinuous, 4 envs, 1024*64 total
+   steps, test/logging/checkpoints disabled. Baseline 318.06 s (v0.5.2,
+   4 CPUs, 5 seeds). Gym retired the -v2 env; -v3 is physics-identical.
+4. DreamerV2 / DreamerV1 end-to-end micro-runs. The reference's
+   `dreamer_v{1,2}_benchmarks` exp configs are NOT in the snapshot, so the
+   rows 2921.38 s / 1148.1 s cannot be step-matched; each line carries the
+   exact workload we ran and `vs_baseline` is the raw wall-clock ratio with
+   that caveat recorded in `protocol`.
 
-Wall-clock protocol (round-4 de-noising): the SAC and PPO lines run one
-warm-up (compile/cache fill, disclosed) plus 3 measured repeats and report
-the MEDIAN with the full `runs` array and `spread` = (max-min)/median over
-the measured repeats. The shared axon relay adds run-to-run spikes of up to
-2x that have nothing to do with the framework; the median over 3 steady
-repeats bounds that noise. The minutes-long DV1/DV2 lines are a single
-measured run after one warm-up (disclosed in their `protocol`); read them
-as order-of-magnitude evidence, not de-noised measurements.
+Wall-clock protocol (round-4 de-noising): repeated lines run one warm-up
+(compile/cache fill, disclosed) plus up to 3 measured repeats — trimmed to
+what the budget allows — and report the MEDIAN with the full `runs` array
+and `spread` = (max-min)/median. The shared axon relay adds run-to-run
+spikes of up to 2x that have nothing to do with the framework; the median
+over steady repeats bounds that noise. The minutes-long DV1/DV2 lines are a
+single measured run after one warm-up (disclosed in their `protocol`); read
+them as order-of-magnitude evidence, not de-noised measurements.
 """
 
 from __future__ import annotations
@@ -57,9 +70,36 @@ DV2_BASELINE_SECONDS = 1148.1  # reference README.md:130-136 (protocol lost)
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
+_START = time.monotonic()
+WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", "1080"))
+#: seconds held back from every stage for the final re-print + process exit
+_RESERVE_S = 15.0
+
+
+def _remaining() -> float:
+    return WALL_BUDGET_S - (time.monotonic() - _START) - _RESERVE_S
+
+
+def _skip_line(metric: str, need_s: float) -> str:
+    return json.dumps(
+        {
+            "metric": metric,
+            "value": None,
+            "skipped": "budget",
+            "need_s": round(need_s, 1),
+            "remaining_s": round(max(_remaining(), 0.0), 1),
+            "wall_budget_s": WALL_BUDGET_S,
+        }
+    )
+
 
 def _dreamer_line() -> str:
     """Run the DV3 micro-bench in a subprocess and return its JSON line."""
+    metric = "dreamer_v3_grad_steps_per_sec"
+    # needs one TPU compile (~20-40 s; ~minutes cold through the tunnel)
+    # plus the measured burst — below ~3 min of budget it cannot finish
+    if _remaining() < 180:
+        return _skip_line(metric, 180)
     try:
         proc = subprocess.run(
             [
@@ -71,7 +111,7 @@ def _dreamer_line() -> str:
             cwd=REPO,
             capture_output=True,
             text=True,
-            timeout=1200,
+            timeout=max(60.0, _remaining()),
         )
         line = next(
             (l for l in reversed(proc.stdout.splitlines()) if l.startswith("{")), None
@@ -80,20 +120,10 @@ def _dreamer_line() -> str:
             return line
         tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
         return json.dumps(
-            {
-                "metric": "dreamer_v3_grad_steps_per_sec",
-                "value": None,
-                "error": " | ".join(tail)[-400:],
-            }
+            {"metric": metric, "value": None, "error": " | ".join(tail)[-400:]}
         )
     except Exception as exc:
-        return json.dumps(
-            {
-                "metric": "dreamer_v3_grad_steps_per_sec",
-                "value": None,
-                "error": repr(exc)[:400],
-            }
-        )
+        return json.dumps({"metric": metric, "value": None, "error": repr(exc)[:400]})
 
 
 def _timed_subprocess_run(args, timeout, env=None):
@@ -107,7 +137,7 @@ def _timed_subprocess_run(args, timeout, env=None):
         cwd=REPO,
         capture_output=True,
         text=True,
-        timeout=timeout,
+        timeout=min(timeout, max(60.0, _remaining())),
         env=full_env,
     )
     elapsed = time.perf_counter() - start
@@ -117,26 +147,52 @@ def _timed_subprocess_run(args, timeout, env=None):
     return round(elapsed, 2)
 
 
-def _repeat_line(metric, run_once, baseline, protocol, repeats=3):
-    """Warm-up + `repeats` measured runs -> JSON line with median + spread."""
+def _repeat_line(metric, run_once, baseline, protocol, repeats=3, min_stage_s=60.0):
+    """Warm-up + up to `repeats` measured runs -> JSON line (median + spread).
+
+    Budget-aware: skips the whole stage when `min_stage_s` exceeds the
+    remaining wall budget, and stops repeating when the next run (estimated
+    from the slowest run so far) would not fit. At least one measured run
+    happens if the stage starts at all.
+    """
+    if _remaining() < min_stage_s:
+        return _skip_line(metric, min_stage_s)
     try:
         warmup = run_once()
-        runs = [run_once() for _ in range(repeats)]
-        med = statistics.median(runs)
-        return json.dumps(
-            {
-                "metric": metric,
-                "value": round(med, 2),
-                "unit": "s",
-                "runs": runs,
-                "warmup_run": warmup,
-                "spread": round((max(runs) - min(runs)) / med, 3) if len(runs) > 1 else None,
-                "vs_baseline": round(baseline / med, 3),
-                "protocol": protocol,
-            }
-        )
     except Exception as exc:
         return json.dumps({"metric": metric, "value": None, "error": repr(exc)[:400]})
+    runs = []
+    est = warmup
+    truncated = None
+    for _ in range(repeats):
+        if runs and _remaining() < est * 1.2:
+            break
+        try:
+            runs.append(run_once())
+        except Exception as exc:
+            # a budget-clamped timeout (or relay hiccup) on a LATER repeat
+            # must not throw away the measured runs already in hand
+            truncated = repr(exc)[:200]
+            break
+        est = max(runs)
+    if not runs:
+        return json.dumps(
+            {"metric": metric, "value": None, "warmup_run": warmup, "error": truncated}
+        )
+    med = statistics.median(runs)
+    line = {
+        "metric": metric,
+        "value": round(med, 2),
+        "unit": "s",
+        "runs": runs,
+        "warmup_run": warmup,
+        "spread": round((max(runs) - min(runs)) / med, 3) if len(runs) > 1 else None,
+        "vs_baseline": round(baseline / med, 3),
+        "protocol": protocol,
+    }
+    if truncated:
+        line["truncated_by"] = truncated
+    return json.dumps(line)
 
 
 _QUIET = [
@@ -147,6 +203,38 @@ _QUIET = [
     "buffer.memmap=False",
     "algo.run_test=False",
 ]
+
+
+def _ppo_line() -> str:
+    from sheeprl_tpu import cli
+
+    ppo_args = [
+        "exp=ppo",
+        "env=gym",
+        "env.id=CartPole-v1",
+        "env.num_envs=64",
+        "env.sync_env=True",
+        "total_steps=65536",
+        "algo.rollout_steps=128",
+        "per_rank_batch_size=64",
+        "exp_name=bench_ppo",
+        *_QUIET,
+    ]
+
+    def ppo_once():
+        start = time.perf_counter()
+        cli.run(ppo_args)
+        return round(time.perf_counter() - start, 2)
+
+    return _repeat_line(
+        "ppo_cartpole_65536_steps",
+        ppo_once,
+        PPO_BASELINE_SECONDS,
+        "reference benchmark.py:10-41 (CartPole-v1, 64 envs, 1024*64 steps, "
+        "test/log/ckpt off), in-process like the reference",
+        repeats=3,
+        min_stage_s=45.0,
+    )
 
 
 def _sac_line() -> str:
@@ -169,10 +257,11 @@ def _sac_line() -> str:
         "reference benchmark_sb3.py:21-29 (LunarLanderContinuous, 4 envs, "
         "1024*64 steps, test/log/ckpt off); -v3 replaces the retired -v2",
         repeats=3,
+        min_stage_s=120.0,
     )
 
 
-def _dreamer_e2e_line(family, baseline, total_steps, extra=()) -> str:
+def _dreamer_e2e_line(family, baseline, total_steps, min_stage_s, extra=()) -> str:
     args = [
         f"exp={family}",  # defaults to the 64x64-pixel dummy env
         "env.num_envs=1",
@@ -192,6 +281,7 @@ def _dreamer_e2e_line(family, baseline, total_steps, extra=()) -> str:
         "from snapshot: vs_baseline is the raw wall-clock ratio, NOT "
         "step-matched",
         repeats=1,
+        min_stage_s=min_stage_s,
     )
 
 
@@ -206,43 +296,17 @@ def main() -> None:
         lines.append(line)
         print(line, flush=True)
 
+    ppo_line = _ppo_line()  # headline: first in, printed again last
+    print(ppo_line, flush=True)
     emit(_dreamer_line())
     emit(_sac_line())
+    # DV2: learning_starts=1000, train_every=5 -> 2500 steps = 1000 prefill
+    # + 300 single-grad-step bursts. Warm-up + 1 run ≈ 2x a single run.
+    emit(_dreamer_e2e_line("dreamer_v2", DV2_BASELINE_SECONDS, 2500, min_stage_s=240.0))
     # DV1: learning_starts=5000, train_every=1000, 100 grad-steps per burst
     # -> 6000 steps covers prefill + 2 bursts (200 grad steps)
-    emit(_dreamer_e2e_line("dreamer_v1", DV1_BASELINE_SECONDS, 6000))
-    # DV2: learning_starts=1000, train_every=5 -> 2500 steps = 1000 prefill
-    # + 300 single-grad-step bursts
-    emit(_dreamer_e2e_line("dreamer_v2", DV2_BASELINE_SECONDS, 2500))
+    emit(_dreamer_e2e_line("dreamer_v1", DV1_BASELINE_SECONDS, 6000, min_stage_s=300.0))
 
-    from sheeprl_tpu import cli
-
-    ppo_args = [
-        "exp=ppo",
-        "env=gym",
-        "env.id=CartPole-v1",
-        "env.num_envs=64",
-        "env.sync_env=True",
-        "total_steps=65536",
-        "algo.rollout_steps=128",
-        "per_rank_batch_size=64",
-        "exp_name=bench_ppo",
-        *_QUIET,
-    ]
-
-    def ppo_once():
-        start = time.perf_counter()
-        cli.run(ppo_args)
-        return round(time.perf_counter() - start, 2)
-
-    ppo_line = _repeat_line(
-        "ppo_cartpole_65536_steps",
-        ppo_once,
-        PPO_BASELINE_SECONDS,
-        "reference benchmark.py:10-41 (CartPole-v1, 64 envs, 1024*64 steps, "
-        "test/log/ckpt off), in-process like the reference",
-        repeats=3,
-    )
     for line in lines:
         print(line, flush=True)
     print(ppo_line, flush=True)

@@ -13,6 +13,7 @@ from sheeprl_tpu.algos.dreamer_v1.utils import normalize_obs_jnp, test
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.registry import register_evaluation
+from sheeprl_tpu.utils.utils import params_on_device
 
 
 @register_evaluation(algorithms=["dreamer_v1"])
@@ -48,6 +49,6 @@ def evaluate_dreamer_v1(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     world_model, actor, critic, _ = build_agent(
         cfg, actions_dim, is_continuous, observation_space, jax.random.PRNGKey(cfg.seed)
     )
-    params = jax.tree_util.tree_map(np.asarray, state["agent"]["params"])
+    params = params_on_device(state["agent"]["params"])
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
     test(player_fns, params, fabric, cfg, log_dir, normalize_fn=normalize_obs_jnp)

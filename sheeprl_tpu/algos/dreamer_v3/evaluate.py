@@ -48,10 +48,10 @@ def evaluate_dreamer_v3(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     world_model, actor, critic, _ = build_agent(
         cfg, actions_dim, is_continuous, observation_space, jax.random.PRNGKey(cfg.seed)
     )
-    from sheeprl_tpu.utils.utils import migrate_dv3_checkpoint
+    from sheeprl_tpu.utils.utils import migrate_dv3_checkpoint, params_on_device
 
-    params = jax.tree_util.tree_map(
-        np.asarray, migrate_dv3_checkpoint(state["agent"]["params"])
-    )
+    # device_put once: numpy param leaves would re-upload the whole tree on
+    # every jitted player step (seconds per step through a tunneled link)
+    params = params_on_device(migrate_dv3_checkpoint(state["agent"]["params"]))
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
     test(player_fns, params, fabric, cfg, log_dir, sample_actions=True)

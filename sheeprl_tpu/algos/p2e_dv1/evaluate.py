@@ -14,6 +14,7 @@ from sheeprl_tpu.algos.p2e_dv1.agent import build_agent, build_player_fns
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.registry import register_evaluation
+from sheeprl_tpu.utils.utils import params_on_device
 
 
 @register_evaluation(algorithms=["p2e_dv1_exploration", "p2e_dv1_finetuning"])
@@ -40,7 +41,7 @@ def evaluate_p2e_dv1(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     world_model, actor, critic, _, _ = build_agent(
         cfg, actions_dim, is_continuous, observation_space, jax.random.PRNGKey(cfg.seed)
     )
-    params = jax.tree_util.tree_map(np.asarray, state["agent"]["params"])
+    params = params_on_device(state["agent"]["params"])
     actor_params = params.get("actor_task", params.get("actor"))
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
     test(

@@ -40,11 +40,9 @@ def evaluate_p2e_dv3(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     world_model, actor, critic, _, _ = build_agent(
         cfg, actions_dim, is_continuous, observation_space, jax.random.PRNGKey(cfg.seed)
     )
-    from sheeprl_tpu.utils.utils import migrate_dv3_checkpoint
+    from sheeprl_tpu.utils.utils import migrate_dv3_checkpoint, params_on_device
 
-    params = jax.tree_util.tree_map(
-        np.asarray, migrate_dv3_checkpoint(state["agent"]["params"])
-    )
+    params = params_on_device(migrate_dv3_checkpoint(state["agent"]["params"]))
     # exploration checkpoints carry actor_task; finetuning checkpoints carry actor
     actor_params = params.get("actor_task", params.get("actor"))
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)

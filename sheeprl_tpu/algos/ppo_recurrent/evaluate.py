@@ -14,6 +14,7 @@ from sheeprl_tpu.algos.ppo_recurrent.utils import test
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.registry import register_evaluation
+from sheeprl_tpu.utils.utils import params_on_device
 
 
 @register_evaluation(algorithms=["ppo_recurrent"])
@@ -40,5 +41,5 @@ def evaluate_ppo_recurrent(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     agent = build_agent(
         cfg, actions_dim, is_continuous, list(cfg.cnn_keys.encoder), list(cfg.mlp_keys.encoder)
     )
-    params = jax.tree_util.tree_map(np.asarray, state["params"])
+    params = params_on_device(state["params"])
     test(agent, params, fabric, cfg, log_dir)

@@ -5,6 +5,7 @@ from __future__ import annotations
 from typing import Any, Dict
 
 import gymnasium as gym
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -13,6 +14,7 @@ from sheeprl_tpu.algos.sac.utils import test
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.registry import register_evaluation
+from sheeprl_tpu.utils.utils import params_on_device
 
 
 @register_evaluation(algorithms=["sac"])
@@ -36,7 +38,7 @@ def evaluate_sac(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     act_dim = int(np.prod(action_space.shape))
     action_scale, action_bias = action_bounds(action_space)
     actor = SACActor(action_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size)
-    actor_params = state["agent"]["actor"]
+    actor_params = params_on_device(state["agent"]["actor"])
     test(actor, actor_params, jnp.asarray(action_scale), jnp.asarray(action_bias), fabric, cfg, log_dir)
 
 

@@ -15,6 +15,7 @@ from sheeprl_tpu.algos.sac_ae.utils import test
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.registry import register_evaluation
+from sheeprl_tpu.utils.utils import params_on_device
 
 
 @register_evaluation(algorithms=["sac_ae"])
@@ -38,7 +39,7 @@ def evaluate_sac_ae(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     encoder, decoder, qf, actor_trunk, _ = build_agent(
         cfg, act_dim, observation_space, jax.random.PRNGKey(cfg.seed)
     )
-    params = jax.tree_util.tree_map(np.asarray, state["agent"])
+    params = params_on_device(state["agent"])
     test(
         encoder, actor_trunk, params,
         jnp.asarray(action_scale), jnp.asarray(action_bias),

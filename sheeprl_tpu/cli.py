@@ -256,7 +256,27 @@ def _compose_from_argv(args: Optional[Sequence[str]], **kwargs) -> Any:
 
 
 def run(args: Optional[Sequence[str]] = None) -> None:
-    """Train entrypoint (reference cli.py:265-273)."""
+    """Train entrypoint (reference cli.py:265-273).
+
+    ``-m``/``--multirun`` enables the Hydra-basic-sweeper subset (reference
+    CLI inherits it from ``@hydra.main``, hydra 1.3): comma-separated
+    override values expand to the cartesian product and the jobs run
+    sequentially in-process, like Hydra's default launcher. Distinct output
+    dirs come from the logger's ``version_k`` auto-increment.
+    """
+    overrides = list(args) if args is not None else sys.argv[1:]
+    if "-m" in overrides or "--multirun" in overrides:
+        from sheeprl_tpu.config.engine import expand_multirun
+
+        overrides = [o for o in overrides if o not in ("-m", "--multirun")]
+        jobs = expand_multirun(overrides)
+        if len(jobs) > 1:
+            for i, job in enumerate(jobs):
+                print(f"[multirun] job {i + 1}/{len(jobs)}: {' '.join(job)}", flush=True)
+                run(job)
+            return
+        # single job: fall through to the normal path with the cleaned argv
+        args = overrides
     enable_persistent_compilation_cache()
     cfg = _compose_from_argv(args)
     if int(cfg.fabric.get("num_nodes", 1)) > 1:

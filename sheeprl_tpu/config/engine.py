@@ -556,3 +556,57 @@ def compose(
 def to_yaml(cfg) -> str:
     data = cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg)
     return yaml.safe_dump(data, sort_keys=False)
+
+
+def _split_sweep_values(value: str) -> List[str]:
+    """Split a sweep value on TOP-LEVEL commas only.
+
+    Commas inside brackets/braces/parens or quotes are list/dict/str
+    literals, not sweep separators — Hydra's grammar makes the same
+    distinction (``a=1,2`` sweeps; ``a=[1,2]`` is one list value).
+    """
+    parts: List[str] = []
+    cur: List[str] = []
+    depth = 0
+    quote: Optional[str] = None
+    for ch in value:
+        if quote is not None:
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+        elif ch in "[{(":
+            depth += 1
+        elif ch in "]})":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+            continue
+        cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
+def expand_multirun(overrides: Sequence[str]) -> List[List[str]]:
+    """Hydra basic-sweeper subset (reference CLI surface: ``sheeprl -m
+    exp=... algo.lr=1e-3,1e-4`` via ``@hydra.main`` — hydra 1.3's default
+    sweeper): expand comma-separated override values into the cartesian
+    product of single-run override lists, preserving override order within
+    each job.
+
+    ``exp=a2c,ppo optim.lr=1e-3,1e-4`` -> 4 jobs. Values whose commas sit
+    inside brackets or quotes are not swept. Overrides without ``=`` (and
+    ``~key`` deletions) pass through unchanged.
+    """
+    axes: List[List[str]] = []
+    for ov in overrides:
+        if "=" in ov and not ov.startswith("~"):
+            key, value = ov.split("=", 1)
+            axes.append([f"{key}={v}" for v in _split_sweep_values(value)])
+        else:
+            axes.append([ov])
+    jobs: List[List[str]] = [[]]
+    for axis in axes:
+        jobs = [job + [choice] for job in jobs for choice in axis]
+    return jobs

@@ -196,6 +196,18 @@ def fetch_losses_if_observed(losses, aggregator=None):
     return losses
 
 
+def params_on_device(tree):
+    """Materialize a checkpoint param tree as numpy and park it on the
+    default accelerator ONCE. Evaluation players are jitted fns called once
+    per env step; numpy leaves would re-upload the whole tree on every call
+    (seconds per step through a tunneled host link)."""
+    import jax
+
+    return jax.device_put(
+        jax.tree_util.tree_map(np.asarray, tree), jax.devices()[0]
+    )
+
+
 def enable_persistent_compilation_cache(path: str = None) -> None:
     """Point jax's persistent XLA compilation cache at a durable directory so
     repeated runs skip recompiles (~7 s of a short PPO benchmark; the

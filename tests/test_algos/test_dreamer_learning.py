@@ -17,6 +17,11 @@ from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
 )
 from sheeprl_tpu.config.engine import compose
 from sheeprl_tpu.fabric import Fabric
+import pytest
+
+# learning-to-reward smokes are the slow lane: minutes each under the
+# 8-virtual-device conftest. Fast lane = `pytest -m "not slow"` (<10 min).
+pytestmark = pytest.mark.slow
 
 
 def test_dreamer_v3_world_model_fits_fixed_batch():

@@ -7,6 +7,10 @@ import pytest
 
 from sheeprl_tpu import cli
 
+# learning-to-reward smokes are the slow lane: minutes each under the
+# 8-virtual-device conftest. Fast lane = `pytest -m "not slow"` (<10 min).
+pytestmark = pytest.mark.slow
+
 
 def test_sac_dmc_walker_walk(tmp_path, monkeypatch):
     pytest.importorskip("dm_control")

@@ -10,6 +10,11 @@ import io
 import numpy as np
 
 from sheeprl_tpu import cli
+import pytest
+
+# learning-to-reward smokes are the slow lane: minutes each under the
+# 8-virtual-device conftest. Fast lane = `pytest -m "not slow"` (<10 min).
+pytestmark = pytest.mark.slow
 
 
 def test_ppo_learns_cartpole(tmp_path, monkeypatch):

@@ -23,6 +23,11 @@ import numpy as np
 
 from sheeprl_tpu.config.engine import compose
 from sheeprl_tpu.fabric import Fabric
+import pytest
+
+# learning-to-reward smokes are the slow lane: minutes each under the
+# 8-virtual-device conftest. Fast lane = `pytest -m "not slow"` (<10 min).
+pytestmark = pytest.mark.slow
 
 _SIZES = [
     "per_rank_batch_size=4",

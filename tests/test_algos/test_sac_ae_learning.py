@@ -15,6 +15,11 @@ from sheeprl_tpu.algos.sac.agent import action_bounds
 from sheeprl_tpu.config.engine import compose
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.fabric import Fabric
+import pytest
+
+# learning-to-reward smokes are the slow lane: minutes each under the
+# 8-virtual-device conftest. Fast lane = `pytest -m "not slow"` (<10 min).
+pytestmark = pytest.mark.slow
 
 
 def test_sac_ae_autoencoder_fits_fixed_batch():

@@ -80,3 +80,63 @@ def test_dotdict_round_trip():
     d = cfg.as_dict()
     assert isinstance(d, dict)
     assert d["algo"]["name"] == "ppo"
+
+
+# ---------------------------------------------------------------------------
+# multirun / sweep grammar (reference CLI surface: hydra 1.3 basic sweeper
+# via @hydra.main, /root/reference/sheeprl/cli.py:265-273)
+# ---------------------------------------------------------------------------
+
+
+def test_expand_multirun_cartesian_product():
+    from sheeprl_tpu.config.engine import expand_multirun
+
+    jobs = expand_multirun(["exp=ppo,a2c", "optim.lr=1e-3,1e-4", "seed=5"])
+    assert len(jobs) == 4
+    assert jobs[0] == ["exp=ppo", "optim.lr=1e-3", "seed=5"]
+    assert jobs[-1] == ["exp=a2c", "optim.lr=1e-4", "seed=5"]
+    # order: later overrides are the fast axis, like hydra's sweeper
+    assert jobs[1] == ["exp=ppo", "optim.lr=1e-4", "seed=5"]
+
+
+def test_expand_multirun_brackets_and_quotes_not_swept():
+    from sheeprl_tpu.config.engine import expand_multirun
+
+    jobs = expand_multirun(
+        ["cnn_keys.encoder=[rgb,depth]", 'exp_name="a,b"', "algo.mlp_layers=2,3"]
+    )
+    assert len(jobs) == 2
+    assert jobs[0][0] == "cnn_keys.encoder=[rgb,depth]"
+    assert jobs[0][1] == 'exp_name="a,b"'
+    assert jobs[0][2] == "algo.mlp_layers=2"
+
+
+def test_multirun_cli_runs_each_job(tmp_path, monkeypatch):
+    """-m sweeps one axis end-to-end through the real CLI (dry runs)."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    cli.run(
+        [
+            "-m",
+            "exp=ppo",
+            "seed=5,6",
+            "dry_run=True",
+            "fabric.accelerator=cpu",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "env.sync_env=True",
+            "env.num_envs=1",
+            "env.capture_video=False",
+            "cnn_keys.encoder=[rgb]",
+            "mlp_keys.encoder=[]",
+            "algo.mlp_layers=1",
+            "algo.dense_units=8",
+            "per_rank_batch_size=2",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            "algo.run_test=False",
+        ]
+    )
+    runs = sorted((tmp_path / "logs" / "runs" / "ppo" / "discrete_dummy").glob("*/version_*"))
+    assert len(runs) == 2, runs

@@ -58,6 +58,7 @@ def test_2d_mesh_batch_and_sequence_sharded(data_seq_mesh, impl):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+@pytest.mark.slow  # fwd+bwd through the ring permutation chain: ~2 min on CI CPU
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 def test_gradients_match(seq_mesh, impl):
     q, k, v = _qkv(jax.random.PRNGKey(2), t=16, h=8)
@@ -82,6 +83,7 @@ def test_jit_under_mesh(seq_mesh):
     )
 
 
+@pytest.mark.slow
 def test_long_sequence_beyond_local_block(seq_mesh):
     # T=256 over 8 devices: 32 per device; exercises multi-step ring masking.
     q, k, v = _qkv(jax.random.PRNGKey(4), b=1, t=256, h=8, d=4)

@@ -58,7 +58,7 @@ def main() -> None:
     import sheeprl_tpu
     from sheeprl_tpu.cli import _load_run_config
     from sheeprl_tpu.config.instantiate import instantiate
-    from sheeprl_tpu.utils.utils import dotdict, migrate_dv3_checkpoint
+    from sheeprl_tpu.utils.utils import dotdict, migrate_dv3_checkpoint, params_on_device
 
     sheeprl_tpu.register_algorithms()
     ckpt_path = os.path.abspath(args.ckpt)
@@ -102,9 +102,10 @@ def main() -> None:
     world_model, actor, critic, _ = build_agent(
         cfg, actions_dim, is_continuous, observation_space, jax.random.PRNGKey(cfg.seed)
     )
-    params = jax.tree_util.tree_map(
-        np.asarray, migrate_dv3_checkpoint(state["agent"]["params"])
-    )
+    # park the params on the accelerator ONCE: numpy leaves would re-upload
+    # the full ~40 MB param tree through the (2-8 MB/s tunneled) host link on
+    # EVERY jitted player call — seconds per env step
+    params = params_on_device(migrate_dv3_checkpoint(state["agent"]["params"]))
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
     cnn_keys = list(cfg.cnn_keys.encoder)
     mlp_keys = list(cfg.mlp_keys.encoder)
