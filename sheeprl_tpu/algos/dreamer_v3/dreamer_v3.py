@@ -746,6 +746,13 @@ def main(fabric, cfg: Dict[str, Any]):
     # First observation (reference main :574-590)
     o = envs.reset(seed=cfg.seed)[0]
     obs = prepare_obs(o, cnn_keys, mlp_keys, n_envs)
+    if os.environ.get("SHEEPRL_ACT_DUMP"):
+        import pickle
+
+        with open(os.environ["SHEEPRL_ACT_DUMP"], "ab") as _f:
+            pickle.dump(
+                {"step": -1, **{k: np.asarray(obs[k]) for k in obs_keys}}, _f
+            )
     step_data = {k: obs[k][None] for k in obs_keys}
     step_data["dones"] = np.zeros((1, n_envs, 1), np.float32)
     step_data["rewards"] = np.zeros((1, n_envs, 1), np.float32)
@@ -805,9 +812,22 @@ def main(fabric, cfg: Dict[str, Any]):
                     else None
                 )
                 root_key, act_key = jax.random.split(root_key)
+                # SHEEPRL_ACT_GREEDY=1 (diagnostic): act with the policy MODE
+                # instead of sampling — with a seeded env this makes the whole
+                # collection loop deterministic and comparable bit-for-bit
+                # against external eval tooling
+                if os.environ.get("SHEEPRL_ACT_GREEDY"):
+                    if use_packed_player:
+                        actions_j, player_state = player_fns["greedy_action_packed"](
+                            play_packed, player_state, obs, act_key, masks=masks
+                        )
+                    else:
+                        actions_j, player_state = player_fns["greedy_action_raw"](
+                            play_wm, play_actor, player_state, obs, act_key, masks=masks
+                        )
                 # raw-obs variants: uint8 pixels cross the host→device link
                 # and are normalized inside the jit (one dispatch per step)
-                if use_packed_player:
+                elif use_packed_player:
                     if expl_scalar is None or expl_scalar_val != expl_amount:
                         # device scalar cached: creating it eagerly per step
                         # would be one extra program dispatch per env step
@@ -896,6 +916,36 @@ def main(fabric, cfg: Dict[str, Any]):
         rewards = np.asarray(rewards, np.float32).reshape(n_envs, 1)
         step_data["dones"] = dones.reshape(1, n_envs, 1)
         step_data["rewards"] = clip_rewards_fn(rewards)[None]
+
+        # SHEEPRL_ACT_DUMP=<path>: append (obs_t, action_t, reward_t, done_t)
+        # rows for the first 1000 POLICY-acting steps — ground truth for
+        # comparing the in-loop acting stream against external eval tooling
+        # (random-prefill steps bind no act_key and are not dumped)
+        dump_path = os.environ.get("SHEEPRL_ACT_DUMP")
+        acted_with_policy = update > learning_starts or cfg.checkpoint.resume_from is not None
+        if dump_path and acted_with_policy and update - start_step < 1000:
+            import pickle
+
+            with open(dump_path, "ab") as _f:
+                pickle.dump(
+                    {
+                        "step": update,
+                        "actions": np.asarray(actions),
+                        "act_key": np.asarray(jax.random.key_data(act_key)),
+                        "rewards": rewards.copy(),
+                        "dones": dones.copy(),
+                        "rec_norm": float(
+                            np.linalg.norm(np.asarray(player_state["recurrent"]))
+                        ),
+                        "packed_digest": (
+                            float(np.abs(np.asarray(play_packed)).sum())
+                            if play_packed is not None
+                            else None
+                        ),
+                        **{k: np.asarray(obs[k]) for k in obs_keys},
+                    },
+                    _f,
+                )
 
         if len(dones_idxes) > 0:
             reset_obs = prepare_obs(

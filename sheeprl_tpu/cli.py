@@ -83,16 +83,70 @@ def resume_from_checkpoint(cfg, overrides: Optional[Sequence[str]] = None) -> An
     old_cfg.root_dir = cfg.root_dir
     old_cfg.run_name = cfg.run_name
     old_cfg.fabric = cfg.fabric
-    # the resuming command controls the training horizon only when it says so
-    # explicitly ("train for another N steps"); a bare resume keeps the
-    # checkpointed run's horizon — the counters inside the checkpoint keep the
-    # already-done progress either way
-    explicit_total = any(
-        o.split("=", 1)[0].lstrip("+~") == "total_steps" for o in (overrides or [])
-    )
-    if explicit_total:
-        old_cfg.total_steps = cfg.total_steps
+    # Re-apply every EXPLICIT value override from the resuming command on top
+    # of the restored config. The restored config defines the experiment
+    # (reference cli.py:22-45 swaps the config wholesale), but silently
+    # dropping overrides the user typed is a trap: a round-5 diagnostic run
+    # passed `algo.train_every=1e9 metric.log_level=0` on resume, both were
+    # discarded, and the "no-training" probe trained at full cadence while
+    # its config print (then emitted pre-merge) showed the overridden values.
+    # Group SELECTIONS (exp=..., env=dmc) cannot be re-applied onto an
+    # already-composed tree and keep their swap-time semantics; bare resumes
+    # keep the checkpointed horizon (the counters carry progress either way).
+    from sheeprl_tpu.config.engine import yaml_load
+
+    reapplied = []
+    dropped = []
+    for o in overrides or []:
+        if "=" not in o or o.startswith("~"):
+            continue
+        key, value = o.split("=", 1)
+        added = key.startswith("+")
+        key = key.lstrip("+")
+        if key in ("checkpoint.resume_from", "root_dir", "run_name") or key.startswith("fabric"):
+            continue  # already carried over above
+        if key == "exp":
+            continue  # defaults-list selection, consumed at compose time
+        if "." not in key and isinstance(old_cfg.get(key, None), dict):
+            continue  # group selection (env=..., algo=...): swap-time semantics
+        if not _set_existing_path(old_cfg, key, yaml_load(value), allow_new=added):
+            # unknown key (typo, or a +new key the stored tree lacks):
+            # inventing it would hide the misconfiguration this merge exists
+            # to prevent — surface it instead
+            dropped.append(o)
+            continue
+        reapplied.append(o)
+    if reapplied:
+        warnings.warn(
+            "resume_from_checkpoint: re-applied explicit overrides on top of "
+            f"the checkpointed config: {reapplied}. All other values come "
+            "from the checkpoint's stored config."
+        )
+    if dropped:
+        raise ValueError(
+            "resume_from_checkpoint: these overrides name keys absent from "
+            f"the checkpointed config: {dropped}. Fix the key, or prefix "
+            "with '+' to add a new key explicitly."
+        )
     return old_cfg
+
+
+def _set_existing_path(cfg, key: str, value, allow_new: bool = False) -> bool:
+    """Set ``key`` (dotted) in ``cfg`` only if the full path already exists
+    (or ``allow_new`` and the PARENT exists). Returns False otherwise —
+    never invents intermediate nodes, so typos don't silently no-op."""
+    node = cfg
+    parts = key.split(".")
+    for p in parts[:-1]:
+        if not isinstance(node, dict) or p not in node or not isinstance(node[p], dict):
+            return False
+        node = node[p]
+    if not isinstance(node, dict):
+        return False
+    if parts[-1] not in node and not allow_new:
+        return False
+    node[parts[-1]] = value
+    return True
 
 
 def check_configs(cfg) -> None:
@@ -286,10 +340,12 @@ def run(args: Optional[Sequence[str]] = None) -> None:
 
         init_distributed()
     sheeprl_tpu.register_algorithms()
+    if cfg.checkpoint.resume_from:
+        cfg = resume_from_checkpoint(cfg, overrides)
+    # print AFTER the resume merge so the tree shown is the effective config
+    # (printing pre-merge showed override values the merge then discarded)
     if cfg.metric.log_level > 0:
         print_config(cfg)
-    if cfg.checkpoint.resume_from:
-        cfg = resume_from_checkpoint(cfg, list(args) if args is not None else sys.argv[1:])
     check_configs(cfg)
     run_algorithm(cfg)
 

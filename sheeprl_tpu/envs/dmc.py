@@ -89,6 +89,14 @@ class DMCWrapper(gym.Env):
         self._camera_id = camera_id
         self._channels_first = channels_first
 
+        # Seed the SIMULATION, not just the spaces (reference dmc.py:75-78
+        # builds task_kwargs={"random": seed}): without this, dm_control
+        # falls back to an OS-entropy RandomState and episode initial states
+        # are irreproducible regardless of every other seed in the run.
+        task_kwargs = dict(task_kwargs or {})
+        if seed is not None:
+            task_kwargs.setdefault("random", seed)
+
         env = suite.load(
             domain_name=domain_name,
             task_name=task_name,

@@ -140,3 +140,42 @@ def test_multirun_cli_runs_each_job(tmp_path, monkeypatch):
     )
     runs = sorted((tmp_path / "logs" / "runs" / "ppo" / "discrete_dummy").glob("*/version_*"))
     assert len(runs) == 2, runs
+
+
+def test_resume_reapplies_explicit_overrides(tmp_path):
+    """Explicit value overrides on a resume command survive the config swap
+    (round-5: `algo.train_every=1e9 metric.log_level=0` were silently dropped
+    by the wholesale checkpoint-config restore)."""
+    import yaml
+
+    from sheeprl_tpu.cli import resume_from_checkpoint
+
+    stored = compose(overrides=["exp=ppo", "exp_name=orig", "total_steps=5000"])
+    log_dir = tmp_path / "run"
+    (log_dir / ".hydra").mkdir(parents=True)
+    (log_dir / "checkpoint").mkdir()
+    (log_dir / ".hydra" / "config.yaml").write_text(yaml.safe_dump(stored.as_dict()))
+    ckpt = log_dir / "checkpoint" / "ckpt_100_0"
+    ckpt.mkdir()
+
+    overrides = [
+        "exp=ppo",
+        f"checkpoint.resume_from={ckpt}",
+        "algo.update_epochs=99",
+        "metric.log_level=0",
+    ]
+    cfg = compose(overrides=overrides)
+    merged = resume_from_checkpoint(cfg, overrides)
+    # explicit value overrides win over the checkpointed config
+    assert merged.algo.update_epochs == 99
+    assert merged.metric.log_level == 0
+    # everything else comes from the checkpoint's stored config
+    assert merged.total_steps == 5000
+    assert merged.algo.name == "ppo"
+    # bare-resume keys keep checkpoint values when not overridden
+    merged2 = resume_from_checkpoint(
+        compose(overrides=["exp=ppo", f"checkpoint.resume_from={ckpt}"]),
+        ["exp=ppo", f"checkpoint.resume_from={ckpt}"],
+    )
+    assert merged2.total_steps == 5000
+    assert merged2.algo.update_epochs == stored.algo.update_epochs

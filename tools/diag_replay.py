@@ -1,0 +1,104 @@
+"""Teacher-forced replay of a training act-stream dump through the eval player.
+
+Reads the (obs_t, action_t) rows dumped by the training loop
+(``SHEEPRL_ACT_DUMP``), replays the obs through the eval-style player while
+FORCING the recurrent state to follow the training run's own action history,
+and at every step compares the eval player's greedy action against the
+training run's sampled action. If params + numerics agree, the two should
+differ only by sampling noise (symmetric, bounded by the actor's std); a
+systematic or growing divergence pinpoints the step where the eval path
+departs from the training path.
+
+Usage: python tools/diag_replay.py <ckpt> <dump.npz>
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def load_rows(path):
+    import pickle
+
+    rows = []
+    with open(path, "rb") as f:
+        while True:
+            try:
+                rows.append(pickle.load(f))
+            except EOFError:
+                break
+    return rows
+
+
+def main() -> None:
+    ckpt_path, dump_path = os.path.abspath(sys.argv[1]), sys.argv[2]
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+    import jax
+    import jax.numpy as jnp
+
+    import sheeprl_tpu
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent, build_player_fns
+    from sheeprl_tpu.cli import _load_run_config
+    from sheeprl_tpu.config.instantiate import instantiate
+    from sheeprl_tpu.utils.env import make_env
+    from sheeprl_tpu.utils.utils import dotdict, migrate_dv3_checkpoint, params_on_device
+
+    sheeprl_tpu.register_algorithms()
+    cfg, log_dir = _load_run_config(ckpt_path)
+    cfg.env.capture_video = False
+    run_fabric = cfg.get("fabric", {}) or {}
+    cfg.fabric = dotdict(
+        {
+            "_target_": "sheeprl_tpu.fabric.Fabric",
+            "devices": 1, "num_nodes": 1, "strategy": "auto",
+            "accelerator": "auto", "precision": "32-true",
+            "prng_impl": run_fabric.get("prng_impl", "rbg"), "callbacks": [],
+        }
+    )
+    fabric = instantiate(cfg.fabric)
+    state = fabric.load(ckpt_path)
+
+    probe = make_env(cfg, cfg.seed, 0, log_dir, "replay_probe")()
+    observation_space, action_space = probe.observation_space, probe.action_space
+    probe.close()
+    actions_dim = tuple(action_space.shape)
+    world_model, actor, critic, _ = build_agent(
+        cfg, actions_dim, True, observation_space, jax.random.PRNGKey(cfg.seed)
+    )
+    params = params_on_device(migrate_dv3_checkpoint(state["agent"]["params"]))
+    player_fns = build_player_fns(world_model, actor, cfg, actions_dim, True)
+
+    rows = load_rows(dump_path)
+    print(f"{len(rows)} dumped steps", flush=True)
+    n_envs = rows[0]["actions"].shape[0]
+    mlp_keys = list(cfg.mlp_keys.encoder)
+
+    ep_state = player_fns["init_states"](params["world_model"], n_envs)
+    key = jax.random.PRNGKey(0)
+    for t, row in enumerate(rows[:100]):
+        obs = {k: jnp.asarray(row[k]) for k in mlp_keys}
+        key, k = jax.random.split(key)
+        my_actions, new_state = player_fns["greedy_action"](
+            params["world_model"], params["actor"], ep_state, obs, k
+        )
+        mine = np.concatenate([np.asarray(a) for a in my_actions], -1)
+        theirs = row["actions"]
+        diff = np.abs(mine - theirs).max()
+        if t < 10 or t % 10 == 0:
+            print(
+                f"t={t:3d} max|mode_eval - sampled_train|={diff:.4f} "
+                f"mean={np.abs(mine - theirs).mean():.4f}", flush=True
+            )
+        # teacher-force: follow the TRAINING action history
+        ep_state = dict(new_state, actions=jnp.asarray(theirs, jnp.float32))
+
+
+if __name__ == "__main__":
+    main()
