@@ -93,20 +93,22 @@ def _skip_line(metric: str, need_s: float) -> str:
     )
 
 
-def _dreamer_line() -> str:
-    """Run the DV3 micro-bench in a subprocess and return its JSON line."""
-    metric = "dreamer_v3_grad_steps_per_sec"
+def _dreamer_line(family: str = "dv3", min_stage_s: float = 180.0, extra=()) -> str:
+    """Run one Dreamer-family micro-bench (grad-steps/s + device profile +
+    scan-corrected MFU, `bench_dreamer.py`) in a subprocess."""
+    metric = {"dv1": "dreamer_v1", "dv2": "dreamer_v2", "dv3": "dreamer_v3"}[family] + "_grad_steps_per_sec"
     # needs one TPU compile (~20-40 s; ~minutes cold through the tunnel)
-    # plus the measured burst — below ~3 min of budget it cannot finish
-    if _remaining() < 180:
-        return _skip_line(metric, 180)
+    # plus the measured burst — below the floor it cannot finish
+    if _remaining() < min_stage_s:
+        return _skip_line(metric, min_stage_s)
     try:
         proc = subprocess.run(
             [
                 sys.executable,
                 os.path.join(REPO, "bench_dreamer.py"),
+                f"bench.family={family}",
                 "fabric.precision=bf16-mixed",
-                "bench.profile=1",
+                *extra,
             ],
             cwd=REPO,
             capture_output=True,
@@ -239,25 +241,42 @@ def _ppo_line() -> str:
 
 def _sac_line() -> str:
     # reference protocol (benchmark_sb3.py:21-29): LunarLanderContinuous,
-    # 4 envs, 65536 steps. SAC is one policy+one train dispatch per env step;
-    # a subprocess keeps its 16k dispatches from polluting the PPO headline
-    # process and discloses the full process lifetime like the reference.
-    args = [
-        "exp=sac",  # env defaults to LunarLanderContinuous-v3 (exp/sac.yaml)
-        "env.num_envs=4",
-        "env.sync_env=True",
-        "total_steps=65536",
-        "exp_name=bench_sac",
-        *_QUIET,
-    ]
+    # 4 envs, 65536 steps. SAC is one policy+one train dispatch per env step,
+    # which through the tunneled-relay host link costs >15 min per full-
+    # protocol run — it cannot fit the wall budget next to the rest of the
+    # matrix on THIS host (on a real TPU-VM host it runs in minutes). Full
+    # protocol when the budget allows; otherwise a disclosed 1/8-protocol
+    # run (8192 steps) whose vs_baseline uses the time-scaled baseline.
+    def build_args(steps):
+        return [
+            "exp=sac",  # env defaults to LunarLanderContinuous-v3 (exp/sac.yaml)
+            "env.num_envs=4",
+            "env.sync_env=True",
+            f"total_steps={steps}",
+            "exp_name=bench_sac",
+            *_QUIET,
+        ]
+
+    if _remaining() > 2400:
+        return _repeat_line(
+            "sac_lunarlander_65536_steps",
+            lambda: _timed_subprocess_run(build_args(65536), timeout=1800),
+            SAC_BASELINE_SECONDS,
+            "reference benchmark_sb3.py:21-29 (LunarLanderContinuous, 4 envs, "
+            "1024*64 steps, test/log/ckpt off); -v3 replaces the retired -v2",
+            repeats=3,
+            min_stage_s=120.0,
+        )
     return _repeat_line(
-        "sac_lunarlander_65536_steps",
-        lambda: _timed_subprocess_run(args, timeout=1800),
-        SAC_BASELINE_SECONDS,
-        "reference benchmark_sb3.py:21-29 (LunarLanderContinuous, 4 envs, "
-        "1024*64 steps, test/log/ckpt off); -v3 replaces the retired -v2",
-        repeats=3,
-        min_stage_s=120.0,
+        "sac_lunarlander_8192_steps",
+        lambda: _timed_subprocess_run(build_args(8192), timeout=1800),
+        SAC_BASELINE_SECONDS / 8.0,
+        "1/8 of reference benchmark_sb3.py:21-29 (8192 of 65536 steps, same "
+        "4-env LunarLanderContinuous, test/log/ckpt off); vs_baseline uses "
+        "the baseline time-scaled by 1/8 — the full protocol exceeds this "
+        "host's wall budget (per-step dispatch through a tunneled relay)",
+        repeats=1,
+        min_stage_s=220.0,
     )
 
 
@@ -298,14 +317,20 @@ def main() -> None:
 
     ppo_line = _ppo_line()  # headline: first in, printed again last
     print(ppo_line, flush=True)
-    emit(_dreamer_line())
+    emit(_dreamer_line("dv3", min_stage_s=180.0, extra=("bench.profile=1",)))
+    # DV2/DV1 device-step lines (grad-steps/s + scan-corrected MFU vs wall
+    # rate; no xplane pass — keeps each under ~3 min warm). Their e2e
+    # micro-runs upload a ~12 MB host batch per burst and take >15 min each
+    # through the tunneled link (no device ring outside DV3), so the
+    # wall-clock e2e rows only run when a big budget is configured.
+    emit(_dreamer_line("dv2", min_stage_s=170.0, extra=("bench.steps=10",)))
+    emit(_dreamer_line("dv1", min_stage_s=170.0, extra=("bench.steps=10",)))
+    # SAC last: the only stage that can overrun its estimate by minutes
+    # (per-step dispatch); anything it loses is only its own line
     emit(_sac_line())
-    # DV2: learning_starts=1000, train_every=5 -> 2500 steps = 1000 prefill
-    # + 300 single-grad-step bursts. Warm-up + 1 run ≈ 2x a single run.
-    emit(_dreamer_e2e_line("dreamer_v2", DV2_BASELINE_SECONDS, 2500, min_stage_s=240.0))
-    # DV1: learning_starts=5000, train_every=1000, 100 grad-steps per burst
-    # -> 6000 steps covers prefill + 2 bursts (200 grad steps)
-    emit(_dreamer_e2e_line("dreamer_v1", DV1_BASELINE_SECONDS, 6000, min_stage_s=300.0))
+    if _remaining() > 2400:  # e2e rows for a generous budget only
+        emit(_dreamer_e2e_line("dreamer_v2", DV2_BASELINE_SECONDS, 2500, min_stage_s=1100.0))
+        emit(_dreamer_e2e_line("dreamer_v1", DV1_BASELINE_SECONDS, 6000, min_stage_s=1200.0))
 
     for line in lines:
         print(line, flush=True)

@@ -212,12 +212,16 @@ def main() -> None:
     overrides = list(sys.argv[1:])
     family = "dv3"
     profile = False
+    n = 20
     for ov in list(overrides):
         if ov.startswith("bench.family="):
             family = ov.split("=", 1)[1]
             overrides.remove(ov)
         elif ov.startswith("bench.profile="):
             profile = ov.split("=", 1)[1].lower() in ("1", "true", "yes")
+            overrides.remove(ov)
+        elif ov.startswith("bench.steps="):
+            n = int(ov.split("=", 1)[1])
             overrides.remove(ov)
     if family not in _FAMILIES:
         sys.exit(f"Unknown bench.family={family!r}; choose from {sorted(_FAMILIES)}")
@@ -284,7 +288,6 @@ def main() -> None:
         return train_fn(state, batch, key)
 
     # compile + warmup; keys prepared outside the timed loop
-    n = 20
     keys = [jax.random.PRNGKey(i) for i in range(n + 1)]
     agent_state, metrics = step(agent_state, keys[n], 1.0)
     float(np.asarray(metrics["Loss/world_model_loss"]))

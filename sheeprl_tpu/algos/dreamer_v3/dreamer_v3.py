@@ -788,6 +788,8 @@ def main(fabric, cfg: Dict[str, Any]):
     per_rank_gradient_steps = 0
     expl_scalar = None
     expl_scalar_val = None
+    dumped_rows = 0
+    _dump_digest = None
     for update in range(start_step, num_updates + 1):
         policy_step += n_envs
         _t = _time.perf_counter()
@@ -917,15 +919,23 @@ def main(fabric, cfg: Dict[str, Any]):
         step_data["dones"] = dones.reshape(1, n_envs, 1)
         step_data["rewards"] = clip_rewards_fn(rewards)[None]
 
-        # SHEEPRL_ACT_DUMP=<path>: append (obs_t, action_t, reward_t, done_t)
-        # rows for the first 1000 POLICY-acting steps — ground truth for
-        # comparing the in-loop acting stream against external eval tooling
-        # (random-prefill steps bind no act_key and are not dumped)
+        # SHEEPRL_ACT_DUMP=<path>: append (o_{t+1}, action_t, reward_t,
+        # done_t) rows for the first 1000 POLICY-acting steps — ground truth
+        # for comparing the in-loop acting stream against external eval
+        # tooling (random-prefill steps bind no act_key and are not dumped;
+        # the window counts dumped rows, not loop iterations, so fresh runs
+        # with a long prefill still capture their first 1000 policy steps)
         dump_path = os.environ.get("SHEEPRL_ACT_DUMP")
         acted_with_policy = update > learning_starts or cfg.checkpoint.resume_from is not None
-        if dump_path and acted_with_policy and update - start_step < 1000:
+        if dump_path and acted_with_policy and dumped_rows < 1000:
             import pickle
 
+            dumped_rows += 1
+            if _dump_digest is None and play_packed is not None:
+                # device->host pull of the full packed param vector: once per
+                # params version, NOT per step (play_packed changes only on
+                # train bursts, which reset the cache)
+                _dump_digest = float(np.abs(np.asarray(play_packed)).sum())
             with open(dump_path, "ab") as _f:
                 pickle.dump(
                     {
@@ -937,11 +947,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         "rec_norm": float(
                             np.linalg.norm(np.asarray(player_state["recurrent"]))
                         ),
-                        "packed_digest": (
-                            float(np.abs(np.asarray(play_packed)).sum())
-                            if play_packed is not None
-                            else None
-                        ),
+                        "packed_digest": _dump_digest,
                         **{k: np.asarray(obs[k]) for k in obs_keys},
                     },
                     _f,
@@ -1076,6 +1082,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     _t = _tr("metric_fetch", _t)
                     if use_packed_player:
                         play_packed = play_packed_new
+                        _dump_digest = None
                     else:
                         play_wm = wm_mirror(agent_state["params"]["world_model"])
                         play_actor = actor_mirror(agent_state["params"]["actor"])

@@ -125,8 +125,9 @@ def resume_from_checkpoint(cfg, overrides: Optional[Sequence[str]] = None) -> An
     if dropped:
         raise ValueError(
             "resume_from_checkpoint: these overrides name keys absent from "
-            f"the checkpointed config: {dropped}. Fix the key, or prefix "
-            "with '+' to add a new key explicitly."
+            f"the checkpointed config: {dropped}. For a typo'd key, fix the "
+            "key; to add a new LEAF under an existing section, prefix with "
+            "'+'; new nested sections cannot be added on a resume command."
         )
     return old_cfg
 

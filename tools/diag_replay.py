@@ -75,29 +75,31 @@ def main() -> None:
     params = params_on_device(migrate_dv3_checkpoint(state["agent"]["params"]))
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, True)
 
-    rows = load_rows(dump_path)
+    rows = [r for r in load_rows(dump_path) if "actions" in r]  # drop step=-1 header
     print(f"{len(rows)} dumped steps", flush=True)
     n_envs = rows[0]["actions"].shape[0]
     mlp_keys = list(cfg.mlp_keys.encoder)
 
+    # dump row t stores (o_{t+1}, a_t): the action for row t's obs is row
+    # t+1's action. Teacher-force the state with row t's own action first.
     ep_state = player_fns["init_states"](params["world_model"], n_envs)
     key = jax.random.PRNGKey(0)
-    for t, row in enumerate(rows[:100]):
-        obs = {k: jnp.asarray(row[k]) for k in mlp_keys}
+    for t in range(min(len(rows) - 1, 100)):
+        obs = {k: jnp.asarray(rows[t][k]) for k in mlp_keys}
+        ep_state = dict(ep_state, actions=jnp.asarray(rows[t]["actions"], jnp.float32))
         key, k = jax.random.split(key)
         my_actions, new_state = player_fns["greedy_action"](
             params["world_model"], params["actor"], ep_state, obs, k
         )
         mine = np.concatenate([np.asarray(a) for a in my_actions], -1)
-        theirs = row["actions"]
+        theirs = np.asarray(rows[t + 1]["actions"])
         diff = np.abs(mine - theirs).max()
         if t < 10 or t % 10 == 0:
             print(
                 f"t={t:3d} max|mode_eval - sampled_train|={diff:.4f} "
                 f"mean={np.abs(mine - theirs).mean():.4f}", flush=True
             )
-        # teacher-force: follow the TRAINING action history
-        ep_state = dict(new_state, actions=jnp.asarray(theirs, jnp.float32))
+        ep_state = new_state
 
 
 if __name__ == "__main__":

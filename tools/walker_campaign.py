@@ -96,7 +96,7 @@ def main() -> None:
         cmd = [sys.executable, "-m", "sheeprl_tpu", *base]
         if ckpt:
             cmd.append(f"checkpoint.resume_from={ckpt}")
-        _beat({"event": "segment_start", "segment": seg, "resume_from": ckpt, "step": step})
+        _beat({"event": "segment_start", "run": run_name, "segment": seg, "resume_from": ckpt, "step": step})
         t0 = time.time()
         try:
             proc = subprocess.run(
@@ -119,6 +119,7 @@ def main() -> None:
         _beat(
             {
                 "event": "segment_end",
+                "run": run_name,
                 "segment": seg,
                 "rc": rc,
                 "seconds": round(time.time() - t0, 1),
