@@ -308,7 +308,7 @@ def main() -> None:
 
         os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
         trace_dir = tempfile.mkdtemp(prefix=f"bench_{family}_trace_")
-        n_prof = 5
+        n_prof = min(5, n)  # keys has n+1 entries; bench.steps can be small
         jax.profiler.start_trace(trace_dir)
         for i in range(n_prof):
             agent_state, metrics = step(
