@@ -64,3 +64,25 @@ def test_minecraft_shared_knobs():
     assert cfg.env.max_pitch == 60 and cfg.env.min_pitch == -60
     assert cfg.env.sticky_attack == 30 and cfg.env.sticky_jump == 10
     assert cfg.env.wrapper.pitch_limits == [-60, 60]
+
+
+def test_dmc_seed_makes_episodes_reproducible():
+    """Round-5 fix: the DMC adapter must seed the SIMULATION
+    (task_kwargs.random), not just the gym spaces — without it dm_control
+    fell back to an OS-entropy RandomState and no seed in the run made
+    episodes reproducible."""
+    import numpy as np
+
+    pytest.importorskip("dm_control")
+
+    from sheeprl_tpu.envs.dmc import DMCWrapper
+
+    def first_obs(seed):
+        env = DMCWrapper("walker_walk", from_vectors=True, from_pixels=False, seed=seed)
+        obs = env.reset()[0]["state"]
+        env.close()
+        return obs
+
+    a, b, c = first_obs(7), first_obs(7), first_obs(8)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
