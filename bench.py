@@ -22,8 +22,12 @@ Workloads:
 
 1. PPO CartPole, the reference's own benchmark protocol (`README.md:92-104`
    / `benchmarks/benchmark.py:10-41`): 64 envs x 1024 rollout-collection
-   steps (65536 policy steps), test/logging/checkpoints disabled,
-   wall-clock around `cli.run`. Reference baseline: 80.81 s.
+   steps (65536 policy steps), test/logging/checkpoints disabled, wall-clock
+   around one `python -m sheeprl_tpu` subprocess per run (round-5 ADVICE:
+   every stage now isolates in its own process; the headline keeps its
+   first-measured position). Runs with `metric.telemetry` on so the line
+   carries `bytes_staged_h2d`/`recompiles` next to the wall-clock.
+   Reference baseline: 80.81 s.
 2. DreamerV3 S-preset (Atari-100K MsPacman config, bf16) gradient-steps/s
    with the profiled device-ms per step — the north-star workload
    (`BASELINE.md`: 100K policy steps in 14 h on a 3080 ≈ 2 grad-steps/s).
@@ -213,8 +217,15 @@ _QUIET = [
 
 
 def _ppo_line() -> str:
-    from sheeprl_tpu import cli
+    # Subprocess like every other stage (round-5 ADVICE: the old in-process
+    # run baked a multi-client relay assumption into the headline — a prior
+    # in-process stage could leave backend state that skews it). Still the
+    # FIRST stage measured, so its position in the matrix is unchanged.
+    # metric.telemetry rides along so the headline line carries the new
+    # counters (bytes staged h2d, recompiles) next to the wall-clock.
+    import tempfile
 
+    tel_path = os.path.join(tempfile.mkdtemp(prefix="bench_ppo_tel_"), "telemetry.json")
     ppo_args = [
         "exp=ppo",
         "env=gym",
@@ -225,23 +236,40 @@ def _ppo_line() -> str:
         "algo.rollout_steps=128",
         "per_rank_batch_size=64",
         "exp_name=bench_ppo",
+        "metric.telemetry.enabled=true",
+        "metric.telemetry.trace=false",
+        f"metric.telemetry.summary_path={tel_path}",
         *_QUIET,
     ]
 
-    def ppo_once():
-        start = time.perf_counter()
-        cli.run(ppo_args)
-        return round(time.perf_counter() - start, 2)
-
-    return _repeat_line(
+    line = _repeat_line(
         "ppo_cartpole_65536_steps",
-        ppo_once,
+        lambda: _timed_subprocess_run(ppo_args, timeout=600),
         PPO_BASELINE_SECONDS,
         "reference benchmark.py:10-41 (CartPole-v1, 64 envs, 1024*64 steps, "
-        "test/log/ckpt off), in-process like the reference",
+        "test/log/ckpt off), one subprocess per run like the other stages",
         repeats=3,
         min_stage_s=45.0,
     )
+    try:  # fold the last run's telemetry counters into the evidence line
+        with open(tel_path) as f:
+            tel = json.load(f)
+        data = json.loads(line)
+        data["telemetry"] = {
+            k: tel.get(k)
+            for k in (
+                "bytes_staged_h2d",
+                "h2d_transfers",
+                "recompiles",
+                "compile_secs",
+                "compile_cache_hits",
+                "peak_hbm_bytes",
+            )
+        }
+        line = json.dumps(data)
+    except Exception:
+        pass  # a skipped/failed stage has no summary; keep the line as-is
+    return line
 
 
 def _sac_line() -> str:

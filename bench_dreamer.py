@@ -20,15 +20,11 @@ from __future__ import annotations
 import json
 import time
 
+# FLOPs/MFU helpers live in the metric layer (sheeprl_tpu/obs/perf.py) so the
+# bench and run telemetry (Perf/mfu, telemetry.json) share one formula
+from sheeprl_tpu.obs.perf import PEAK_TFLOPS_BF16, cost_flops as _cost_flops, mfu_pct
+
 BASELINE_STEPS_PER_SEC = 100000 / (14 * 3600)  # reference DV3 100K wall-clock
-PEAK_TFLOPS_BF16 = 197.0  # TPU v5e single-chip bf16 peak
-
-
-def _cost_flops(compiled) -> float:
-    ca = compiled.cost_analysis()
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0]
-    return float(ca.get("flops", 0.0))
 
 
 def _family_flops_per_step(family, cfg, world_model, actor, params, T, B, actions_dim):
@@ -329,7 +325,7 @@ def main() -> None:
     # available, wall rate otherwise. Peak: v5e bf16 ≈ 197 TFLOP/s; 32-true
     # programs are measured against the same bf16 peak (disclosed in the
     # line) so numbers stay comparable across precisions.
-    flops_per_step = mfu_pct = xla_module_flops = None
+    flops_per_step = mfu = xla_module_flops = None
     try:
         if has_tau:
             lowered = train_fn.lower(agent_state, batch, keys[0], jnp.float32(0.02))
@@ -344,9 +340,7 @@ def main() -> None:
         step_seconds = (
             device_us * 1e-6 if device_us is not None else 1.0 / steps_per_sec
         )
-        mfu_pct = round(
-            flops_per_step / step_seconds / (PEAK_TFLOPS_BF16 * 1e12) * 100, 2
-        )
+        mfu = mfu_pct(flops_per_step, 1.0, step_seconds, PEAK_TFLOPS_BF16)
     except Exception as exc:  # keep the bench alive
         print(f"# flops analysis failed: {exc}", file=sys.stderr)
 
@@ -374,8 +368,8 @@ def main() -> None:
                 "xla_module_flops": xla_module_flops,
                 # mfu basis: v5e bf16 peak; for 32-true programs this is the
                 # bf16-relative utilization, not an fp32-peak number
-                "mfu_pct": mfu_pct,
-                "mfu_peak_tflops_bf16": PEAK_TFLOPS_BF16 if mfu_pct is not None else None,
+                "mfu_pct": mfu,
+                "mfu_peak_tflops_bf16": PEAK_TFLOPS_BF16 if mfu is not None else None,
                 "vs_baseline": vs_baseline,
             }
         )

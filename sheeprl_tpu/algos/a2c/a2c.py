@@ -39,8 +39,9 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.obs import count_h2d, log_sps_metrics, span
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_tensor, save_configs
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 
 def build_update_fn(
@@ -100,7 +101,7 @@ def build_update_fn(
         metrics = jax.lax.pmean(jnp.mean(metrics, axis=0), axis)
         return params, opt_state, metrics
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_update,
         mesh=fabric.mesh,
         in_specs=(P(), P(), P(axis), P()),
@@ -256,7 +257,7 @@ def main(fabric, cfg: Dict[str, Any]):
         for _ in range(rollout_steps):
             policy_step += n_envs
 
-            with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+            with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
                 actions_j, real_actions_j, logprob_j, values_j, play_key = policy_step_fn(
                     play_params, next_obs, play_key
                 )
@@ -315,15 +316,19 @@ def main(fabric, cfg: Dict[str, Any]):
             x = jnp.asarray(x)
             return jnp.swapaxes(x, 0, 1).reshape((n_envs * x.shape[0],) + x.shape[2:])
 
-        local_data = {
-            **{k: flat(rb[k]) for k in obs_keys},
-            "actions": flat(rb["actions"]),
-            "returns": flat(returns),
-            "advantages": flat(advantages),
+        local_np = {
+            **{k: rb[k] for k in obs_keys},
+            "actions": rb["actions"],
+            "returns": returns,
+            "advantages": advantages,
         }
-        local_data = jax.device_put(local_data, fabric.data_sharding)
+        with span("Time/stage_h2d_time", phase="stage_h2d"):
+            local_data = jax.device_put(
+                {k: flat(v) for k, v in local_np.items()}, fabric.data_sharding
+            )
+        count_h2d(local_np)
 
-        with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+        with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
             root_key, update_key = jax.random.split(root_key)
             params, opt_state, losses = update_fn(params, opt_state, local_data, update_key)
             losses = fetch_losses_if_observed(losses, aggregator)
@@ -342,30 +347,15 @@ def main(fabric, cfg: Dict[str, Any]):
                 if logger is not None:
                     logger.log_metrics(metrics_dict, policy_step)
                 aggregator.reset()
-            if not timer.disabled:
-                timer_metrics = timer.compute()
-                if logger is not None:
-                    if timer_metrics.get("Time/train_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_train": (train_step - last_train)
-                                / timer_metrics["Time/train_time"]
-                            },
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log)
-                                    / world_size
-                                    * cfg.env.action_repeat
-                                )
-                                / timer_metrics["Time/env_interaction_time"]
-                            },
-                            policy_step,
-                        )
-                timer.reset()
+            log_sps_metrics(
+                logger,
+                policy_step=policy_step,
+                last_log=last_log,
+                train_step=train_step,
+                last_train=last_train,
+                world_size=world_size,
+                action_repeat=cfg.env.action_repeat,
+            )
             last_log = policy_step
             last_train = train_step
 

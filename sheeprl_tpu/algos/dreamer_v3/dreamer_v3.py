@@ -62,8 +62,16 @@ from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.obs import (
+    count_h2d,
+    cost_flops_of,
+    get_telemetry,
+    log_sps_metrics,
+    shape_specs,
+    span,
+)
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 sg = jax.lax.stop_gradient
 
@@ -414,7 +422,7 @@ def build_train_fn(
         }
         return new_state, metrics
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_step,
         mesh=fabric.mesh,
         in_specs=(P(), P(None, axis), P(), P()),
@@ -444,7 +452,7 @@ def build_train_fn(
         # the aggregator consumed only the burst's last metrics already
         return state, jax.tree_util.tree_map(lambda m: m[-1], metrics), packed
 
-    burst_shmapped = jax.shard_map(
+    burst_shmapped = shard_map(
         local_burst,
         mesh=fabric.mesh,
         in_specs=(P(), P(None, None, axis), P(), P()),
@@ -749,7 +757,20 @@ def main(fabric, cfg: Dict[str, Any]):
     if os.environ.get("SHEEPRL_ACT_DUMP"):
         import pickle
 
-        with open(os.environ["SHEEPRL_ACT_DUMP"], "ab") as _f:
+        _dump_file = os.environ["SHEEPRL_ACT_DUMP"]
+        if os.path.exists(_dump_file):
+            # appending a second stream onto a previous run's dump would
+            # silently interleave two incompatible acting traces; start fresh
+            # and say so (the dump exists to be diffed against external
+            # tooling — a mixed file is worse than a missing one)
+            print(
+                f"SHEEPRL_ACT_DUMP: {_dump_file} already exists from a "
+                "previous run — truncating it; this run's acting stream "
+                "starts at row 0",
+                flush=True,
+            )
+            open(_dump_file, "wb").close()
+        with open(_dump_file, "ab") as _f:
             pickle.dump(
                 {"step": -1, **{k: np.asarray(obs[k]) for k in obs_keys}}, _f
             )
@@ -794,7 +815,7 @@ def main(fabric, cfg: Dict[str, Any]):
         policy_step += n_envs
         _t = _time.perf_counter()
 
-        with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+        with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
             if update <= learning_starts and cfg.checkpoint.resume_from is None:
                 real_actions = actions = np.array(envs.action_space.sample())
                 if not is_continuous:
@@ -1043,30 +1064,40 @@ def main(fabric, cfg: Dict[str, Any]):
                 # next acting phase (that overlap is the point on a remote-
                 # attached chip). Time/sps_train is only device-accurate on
                 # bursts that fetch.
-                with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+                telemetry = get_telemetry()
+                burst_specs = None
+                taus = np.zeros(n_samples, np.float32)
+                for i in range(n_samples):
+                    g = per_rank_gradient_steps + i
+                    if g % cfg.algo.critic.target_network_update_freq == 0:
+                        taus[i] = 1.0 if g == 0 else cfg.algo.critic.tau
+                if use_device_ring:
+                    batches = local_data  # already stacked on device
+                else:
+                    # ship native dtypes (uint8 pixels = 4x less than f32
+                    # over the host->HBM link) straight to the sharding; the
+                    # train step normalizes on device. Staged OUTSIDE the
+                    # train span so Time/train_time means the same thing in
+                    # every algo (dispatch + metric fetch, no staging).
+                    with span("Time/stage_h2d_time", phase="stage_h2d"):
+                        batches = jax.device_put(local_data, burst_sharding)
+                    count_h2d(local_data)
+                with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                     # the whole burst (n_samples gradient steps) is ONE dispatch:
                     # per-call overhead on a remote-attached device scales with
                     # the state pytree's leaf count and would otherwise repeat
                     # per gradient step (build_train_fn burst notes)
-                    taus = np.zeros(n_samples, np.float32)
-                    for i in range(n_samples):
-                        g = per_rank_gradient_steps + i
-                        if g % cfg.algo.critic.target_network_update_freq == 0:
-                            taus[i] = 1.0 if g == 0 else cfg.algo.critic.tau
-                    if use_device_ring:
-                        batches = local_data  # already stacked on device
-                    else:
-                        # ship native dtypes (uint8 pixels = 4x less than f32
-                        # over the host->HBM link) straight to the sharding;
-                        # the train step normalizes on device
-                        batches = jax.device_put(local_data, burst_sharding)
                     root_key, train_key = jax.random.split(root_key)
-                    agent_state, metrics, play_packed_new = train_fn.burst(
+                    burst_args = (
                         agent_state,
                         batches,
                         jax.random.split(train_key, n_samples),
                         jnp.asarray(taus),
                     )
+                    if telemetry is not None and telemetry.needs_train_flops():
+                        # specs captured pre-call: the burst donates agent_state
+                        burst_specs = shape_specs(burst_args)
+                    agent_state, metrics, play_packed_new = train_fn.burst(*burst_args)
                     per_rank_gradient_steps += n_samples
                     _t = _tr("train_dispatch", _t)
                     if metrics is not None and fetch_metrics:
@@ -1087,6 +1118,12 @@ def main(fabric, cfg: Dict[str, Any]):
                         play_wm = wm_mirror(agent_state["params"]["world_model"])
                         play_actor = actor_mirror(agent_state["params"]["actor"])
                     train_step += world_size
+                if burst_specs is not None:
+                    # one AOT cost analysis of the whole burst, registered per
+                    # train-step UNIT (the counter advances by world_size per
+                    # dispatched burst)
+                    flops = cost_flops_of(train_fn.burst, *burst_specs)
+                    telemetry.set_train_flops(flops / world_size if flops else None)
             updates_before_training = cfg.algo.train_every // policy_steps_per_update
             if cfg.algo.actor.expl_decay:
                 expl_decay_steps += 1
@@ -1113,30 +1150,15 @@ def main(fabric, cfg: Dict[str, Any]):
                 if logger is not None:
                     logger.log_metrics(metrics_dict, policy_step)
                 aggregator.reset()
-            if not timer.disabled:
-                timer_metrics = timer.compute()
-                if logger is not None:
-                    if timer_metrics.get("Time/train_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_train": (train_step - last_train)
-                                / max(timer_metrics["Time/train_time"], 1e-9)
-                            },
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log)
-                                    / world_size
-                                    * cfg.env.action_repeat
-                                )
-                                / max(timer_metrics["Time/env_interaction_time"], 1e-9)
-                            },
-                            policy_step,
-                        )
-                timer.reset()
+            log_sps_metrics(
+                logger,
+                policy_step=policy_step,
+                last_log=last_log,
+                train_step=train_step,
+                last_train=last_train,
+                world_size=world_size,
+                action_repeat=cfg.env.action_repeat,
+            )
             last_log = policy_step
             last_train = train_step
 
@@ -1163,12 +1185,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_checkpoint": last_checkpoint,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{fabric.global_rank}")
-            fabric.call(
-                "on_checkpoint_coupled",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
-            )
+            with span("Time/checkpoint_time", phase="checkpoint"):
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
+                )
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True):

@@ -36,7 +36,7 @@ from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.obs import count_h2d, log_sps_metrics, span
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
 
@@ -271,7 +271,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         if update >= learning_starts and player_actor_type == "exploration":
             player_actor_type = "task"
 
-        with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+        with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
             norm_obs = normalize_obs_jnp(obs, cnn_keys)
             root_key, act_key = jax.random.split(root_key)
             actions_j, player_state = player_fns["exploration_action"](
@@ -365,7 +365,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 sequence_length=cfg.per_rank_sequence_length,
                 n_samples=n_samples,
             )
-            with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+            with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 metrics = None
                 for i in range(n_samples):
                     if per_rank_gradient_steps % cfg.algo.critic.target_network_update_freq == 0:
@@ -375,9 +375,12 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                     # ship native dtypes (uint8 pixels = 4x less than f32
                     # over the host->HBM link) straight to the sharding; the
                     # train step normalizes on device
-                    batch = jax.device_put(
-                        {k: v[i] for k, v in local_data.items()}, data_sharding
-                    )
+                    sliced = {k: v[i] for k, v in local_data.items()}
+                    batch = jax.device_put(sliced, data_sharding)
+                    # bytes counted here; the staging time is interleaved
+                    # with the gradient-step dispatches and stays inside the
+                    # train phase for this per-sample loop
+                    count_h2d(sliced)
                     root_key, train_key = jax.random.split(root_key)
                     agent_state, metrics = train_fn(
                         agent_state, batch, train_key, jnp.float32(tau)
@@ -413,28 +416,15 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 if logger is not None:
                     logger.log_metrics(metrics_dict, policy_step)
                 aggregator.reset()
-            if not timer.disabled:
-                timer_metrics = timer.compute()
-                if logger is not None:
-                    if timer_metrics.get("Time/train_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_train": (train_step - last_train)
-                                / max(timer_metrics["Time/train_time"], 1e-9)
-                            },
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
-                                )
-                                / max(timer_metrics["Time/env_interaction_time"], 1e-9)
-                            },
-                            policy_step,
-                        )
-                timer.reset()
+            log_sps_metrics(
+                logger,
+                policy_step=policy_step,
+                last_log=last_log,
+                train_step=train_step,
+                last_train=last_train,
+                world_size=world_size,
+                action_repeat=cfg.env.action_repeat,
+            )
             last_log = policy_step
             last_train = train_step
 

@@ -46,13 +46,21 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import (
+    count_h2d,
+    cost_flops_of,
+    get_telemetry,
+    log_sps_metrics,
+    shape_specs,
+    span,
+)
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import set_lr
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_tensor, polynomial_decay, save_configs
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 
 def make_vector_env(cfg, fabric, log_dir: str, n_envs: int):
@@ -158,7 +166,7 @@ def build_update_fn(
         return params, opt_state, metrics
 
     data_spec = P() if share else P(axis)
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_update,
         mesh=fabric.mesh,
         in_specs=(P(), P(), data_spec, P(), P(), P()),
@@ -369,7 +377,7 @@ def main(fabric, cfg: Dict[str, Any]):
         for _ in range(cfg.algo.rollout_steps):
             policy_step += n_envs
 
-            with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+            with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
                 actions_j, real_actions_j, logprob_j, values_j, play_key = policy_step_fn(
                     play_params, next_obs, play_key
                 )
@@ -433,19 +441,25 @@ def main(fabric, cfg: Dict[str, Any]):
             x = jnp.asarray(x)
             return jnp.swapaxes(x, 0, 1).reshape((n_envs * x.shape[0],) + x.shape[2:])
 
-        local_data = {
-            **{k: flat(rb[k]) for k in obs_keys},
-            "actions": flat(rb["actions"]),
-            "logprobs": flat(rb["logprobs"]),
-            "values": flat(rb["values"]),
-            "returns": flat(returns),
-            "advantages": flat(advantages),
+        local_np = {
+            **{k: rb[k] for k in obs_keys},
+            "actions": rb["actions"],
+            "logprobs": rb["logprobs"],
+            "values": rb["values"],
+            "returns": returns,
+            "advantages": advantages,
         }
-        local_data = jax.device_put(local_data, data_sharding)
+        with span("Time/stage_h2d_time", phase="stage_h2d"):
+            local_data = jax.device_put(
+                {k: flat(v) for k, v in local_np.items()}, data_sharding
+            )
+        count_h2d(local_np)
 
-        with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+        telemetry = get_telemetry()
+        update_specs = None
+        with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
             root_key, update_key = jax.random.split(root_key)
-            params, opt_state, losses = update_fn(
+            update_args = (
                 params,
                 opt_state,
                 local_data,
@@ -453,7 +467,17 @@ def main(fabric, cfg: Dict[str, Any]):
                 jnp.float32(cfg.algo.clip_coef),
                 jnp.float32(cfg.algo.ent_coef),
             )
+            if telemetry is not None and telemetry.needs_train_flops():
+                # abstract specs captured pre-call: the update donates its
+                # params/opt_state buffers, so the live arrays are gone after
+                update_specs = shape_specs(update_args)
+            params, opt_state, losses = update_fn(*update_args)
             losses = fetch_losses_if_observed(losses, aggregator)
+        if update_specs is not None:
+            # per train-step UNIT: the counter advances by world_size per
+            # dispatched update program
+            flops = cost_flops_of(update_fn, *update_specs)
+            telemetry.set_train_flops(flops / world_size if flops else None)
         play_params = to_host(params)
         train_step += world_size
 
@@ -475,30 +499,15 @@ def main(fabric, cfg: Dict[str, Any]):
                 if logger is not None:
                     logger.log_metrics(metrics_dict, policy_step)
                 aggregator.reset()
-            if not timer.disabled:
-                timer_metrics = timer.compute()
-                if logger is not None:
-                    if timer_metrics.get("Time/train_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_train": (train_step - last_train)
-                                / timer_metrics["Time/train_time"]
-                            },
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log)
-                                    / world_size
-                                    * cfg.env.action_repeat
-                                )
-                                / timer_metrics["Time/env_interaction_time"]
-                            },
-                            policy_step,
-                        )
-                timer.reset()
+            log_sps_metrics(
+                logger,
+                policy_step=policy_step,
+                last_log=last_log,
+                train_step=train_step,
+                last_train=last_train,
+                world_size=world_size,
+                action_repeat=cfg.env.action_repeat,
+            )
             last_log = policy_step
             last_train = train_step
 
@@ -526,12 +535,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_checkpoint": last_checkpoint,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{fabric.global_rank}")
-            fabric.call(
-                "on_checkpoint_coupled",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
-            )
+            with span("Time/checkpoint_time", phase="checkpoint"):
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
+                )
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True):

@@ -42,11 +42,18 @@ from sheeprl_tpu.algos.ppo.agent import build_agent, sample_actions
 from sheeprl_tpu.algos.ppo.ppo import build_update_fn, make_vector_env
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.obs import (
+    count_h2d,
+    cost_flops_of,
+    get_telemetry,
+    log_sps_metrics,
+    shape_specs,
+    span,
+)
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.optim import set_lr
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, polynomial_decay, save_configs
 
@@ -187,6 +194,16 @@ def main(fabric, cfg: Dict[str, Any]):
     stop = threading.Event()
     player_error: Dict[str, BaseException] = {}
 
+    # run-health: both sides of the decoupled pair heartbeat once per unit of
+    # progress; the watchdog flags whichever wedges (hung env worker, dead
+    # device link, deadlocked queue) instead of the run going silent
+    telemetry = get_telemetry()
+    watchdog = telemetry.watchdog() if telemetry is not None else None
+    if watchdog is not None:
+        watchdog.register("ppo-player")
+        watchdog.register("ppo-trainer")
+        watchdog.start()
+
     def player(player_key):
         try:
             obs = envs.reset(seed=cfg.seed)[0]
@@ -196,8 +213,10 @@ def main(fabric, cfg: Dict[str, Any]):
                 extras = {"dones": [], "values": [], "actions": [], "logprobs": [], "rewards": []}
                 ep_stats = []
                 snapshot = param_cell["params"]
-                with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+                with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
                     for _ in range(rollout_steps):
+                        if watchdog is not None:
+                            watchdog.beat("ppo-player")
                         nonlocal_key = jax.random.fold_in(player_key, len(extras["dones"]) + update * rollout_steps)
                         actions_j, real_actions_j, logprob_j, values_j = policy_step_fn(
                             snapshot, next_obs, nonlocal_key
@@ -250,12 +269,21 @@ def main(fabric, cfg: Dict[str, Any]):
                     "next_values": next_values,
                     "ep_stats": ep_stats,
                 }
+                if watchdog is not None:
+                    # blocking on a full queue = waiting for the trainer, not
+                    # a stall of the player
+                    watchdog.pause("ppo-player")
                 rollout_q.put(payload)
+                if watchdog is not None:
+                    watchdog.resume("ppo-player")
                 if stop.is_set():
-                    return
+                    break
         except BaseException as e:  # surface crashes in the trainer loop
             player_error["error"] = e
             rollout_q.put(None)
+        finally:
+            if watchdog is not None:  # a finished player is not a stalled one
+                watchdog.unregister("ppo-player")
 
     root_key, player_key = jax.random.split(root_key)
     player_thread = threading.Thread(target=player, args=(player_key,), daemon=True, name="ppo-player")
@@ -282,9 +310,15 @@ def main(fabric, cfg: Dict[str, Any]):
             else:
                 lr = cfg.algo.optimizer.lr
 
+            if watchdog is not None:
+                # blocking on an empty queue = waiting for the player, not a
+                # stall of the trainer
+                watchdog.pause("ppo-trainer")
             payload = rollout_q.get()
             if payload is None:
                 raise RuntimeError("PPO player thread crashed") from player_error.get("error")
+            if watchdog is not None:
+                watchdog.beat("ppo-trainer")
             policy_step += policy_steps_per_update
 
             returns, advantages = gae_fn(
@@ -298,19 +332,21 @@ def main(fabric, cfg: Dict[str, Any]):
                 x = jnp.asarray(x)
                 return jnp.swapaxes(x, 0, 1).reshape((n_envs * x.shape[0],) + x.shape[2:])
 
-            local_data = {
-                **{k: flat(payload["data"][k]) for k in obs_keys},
-                "actions": flat(payload["data"]["actions"]),
-                "logprobs": flat(payload["data"]["logprobs"]),
-                "values": flat(payload["data"]["values"]),
-                "returns": flat(returns),
-                "advantages": flat(advantages),
-            }
-            local_data = jax.device_put(local_data, data_sharding)
+            with span("Time/stage_h2d_time", phase="stage_h2d"):
+                local_data = {
+                    **{k: flat(payload["data"][k]) for k in obs_keys},
+                    "actions": flat(payload["data"]["actions"]),
+                    "logprobs": flat(payload["data"]["logprobs"]),
+                    "values": flat(payload["data"]["values"]),
+                    "returns": flat(returns),
+                    "advantages": flat(advantages),
+                }
+                local_data = jax.device_put(local_data, data_sharding)
+            count_h2d(payload["data"])
 
-            with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+            with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 root_key, update_key = jax.random.split(root_key)
-                params, opt_state, losses = update_fn(
+                update_args = (
                     params,
                     opt_state,
                     local_data,
@@ -318,7 +354,15 @@ def main(fabric, cfg: Dict[str, Any]):
                     jnp.float32(cfg.algo.clip_coef),
                     jnp.float32(cfg.algo.ent_coef),
                 )
+                params, opt_state, losses = update_fn(*update_args)
                 losses = fetch_losses_if_observed(losses, aggregator)
+            if telemetry is not None and telemetry.needs_train_flops():
+                # donation is off in decoupled mode, so the live args are
+                # still valid for the one-off AOT cost analysis; per
+                # train-step UNIT (the counter advances by world_size per
+                # dispatched update program)
+                flops = cost_flops_of(update_fn, *shape_specs(update_args))
+                telemetry.set_train_flops(flops / world_size if flops else None)
             train_step += world_size
 
             # the new parameters become visible to the player (the reference's
@@ -348,30 +392,15 @@ def main(fabric, cfg: Dict[str, Any]):
                     if logger is not None:
                         logger.log_metrics(metrics_dict, policy_step)
                     aggregator.reset()
-                if not timer.disabled:
-                    timer_metrics = timer.compute()
-                    if logger is not None:
-                        if timer_metrics.get("Time/train_time"):
-                            logger.log_metrics(
-                                {
-                                    "Time/sps_train": (train_step - last_train)
-                                    / max(timer_metrics["Time/train_time"], 1e-9)
-                                },
-                                policy_step,
-                            )
-                        if timer_metrics.get("Time/env_interaction_time"):
-                            logger.log_metrics(
-                                {
-                                    "Time/sps_env_interaction": (
-                                        (policy_step - last_log)
-                                        / world_size
-                                        * cfg.env.action_repeat
-                                    )
-                                    / max(timer_metrics["Time/env_interaction_time"], 1e-9)
-                                },
-                                policy_step,
-                            )
-                    timer.reset()
+                log_sps_metrics(
+                    logger,
+                    policy_step=policy_step,
+                    last_log=last_log,
+                    train_step=train_step,
+                    last_train=last_train,
+                    world_size=world_size,
+                    action_repeat=cfg.env.action_repeat,
+                )
                 last_log = policy_step
                 last_train = train_step
 
@@ -399,7 +428,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 ckpt_path = os.path.join(
                     log_dir, "checkpoint", f"ckpt_{policy_step}_{fabric.global_rank}"
                 )
-                fabric.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state)
+                with span("Time/checkpoint_time", phase="checkpoint"):
+                    fabric.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state)
     finally:
         stop.set()
         try:  # unblock a player waiting on the full queue
@@ -407,6 +437,8 @@ def main(fabric, cfg: Dict[str, Any]):
         except queue.Empty:
             pass
         player_thread.join(timeout=30)
+        if watchdog is not None:
+            watchdog.stop()
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True):

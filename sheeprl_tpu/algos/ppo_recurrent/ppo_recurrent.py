@@ -44,9 +44,10 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.obs import count_h2d, log_sps_metrics, span
 from sheeprl_tpu.utils.optim import set_lr
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_tensor, polynomial_decay, save_configs
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 
 def build_update_fn(
@@ -125,7 +126,7 @@ def build_update_fn(
         metrics = jax.lax.pmean(jnp.mean(metrics, axis=(0, 1)), axis)
         return params, opt_state, metrics
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_update,
         mesh=fabric.mesh,
         in_specs=(P(), P(), P(None, axis), P(axis), P(), P(), P()),
@@ -317,7 +318,7 @@ def main(fabric, cfg: Dict[str, Any]):
         for t in range(rollout_steps):
             policy_step += n_envs
 
-            with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+            with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
                 cx_steps[t] = np.asarray(hc[0])
                 hx_steps[t] = np.asarray(hc[1])
                 root_key, step_key = jax.random.split(root_key)
@@ -437,10 +438,13 @@ def main(fabric, cfg: Dict[str, Any]):
 
         init_hc = {"c": to_hc(cx_steps), "h": to_hc(hx_steps)}
 
-        seq_data = jax.device_put(seq_data, seq_sharding)
-        init_hc = jax.device_put(init_hc, hc_sharding)
+        count_h2d(seq_data)
+        count_h2d(init_hc)
+        with span("Time/stage_h2d_time", phase="stage_h2d"):
+            seq_data = jax.device_put(seq_data, seq_sharding)
+            init_hc = jax.device_put(init_hc, hc_sharding)
 
-        with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+        with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
             root_key, update_key = jax.random.split(root_key)
             params, opt_state, losses = update_fn(
                 params,
@@ -472,30 +476,15 @@ def main(fabric, cfg: Dict[str, Any]):
                 if logger is not None:
                     logger.log_metrics(metrics_dict, policy_step)
                 aggregator.reset()
-            if not timer.disabled:
-                timer_metrics = timer.compute()
-                if logger is not None:
-                    if timer_metrics.get("Time/train_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_train": (train_step - last_train)
-                                / timer_metrics["Time/train_time"]
-                            },
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log)
-                                    / world_size
-                                    * cfg.env.action_repeat
-                                )
-                                / timer_metrics["Time/env_interaction_time"]
-                            },
-                            policy_step,
-                        )
-                timer.reset()
+            log_sps_metrics(
+                logger,
+                policy_step=policy_step,
+                last_log=last_log,
+                train_step=train_step,
+                last_train=last_train,
+                world_size=world_size,
+                action_repeat=cfg.env.action_repeat,
+            )
             last_log = policy_step
             last_train = train_step
 

@@ -53,8 +53,16 @@ from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.obs import (
+    count_h2d,
+    cost_flops_of,
+    get_telemetry,
+    log_sps_metrics,
+    shape_specs,
+    span,
+)
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 
 def build_train_fn(
@@ -155,7 +163,7 @@ def build_train_fn(
         metrics = jax.lax.pmean(jnp.mean(metrics, axis=0), axis)
         return state, opt_states, metrics
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_train,
         mesh=fabric.mesh,
         in_specs=(P(), P(), P(None, axis), P(), P()),
@@ -326,7 +334,7 @@ def main(fabric, cfg: Dict[str, Any]):
     for update in range(start_step, num_updates + 1):
         policy_step += n_envs
 
-        with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+        with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
             if update <= learning_starts:
                 actions = envs.action_space.sample()
             else:
@@ -381,15 +389,26 @@ def main(fabric, cfg: Dict[str, Any]):
                 k: np.reshape(v, (g_total, world_size * cfg.per_rank_batch_size) + v.shape[2:])
                 for k, v in sample.items()
             }
-            batch = jax.device_put(batch, batch_sharding)
+            with span("Time/stage_h2d_time", phase="stage_h2d"):
+                batch = jax.device_put(batch, batch_sharding)
+            count_h2d(sample)
 
-            with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+            telemetry = get_telemetry()
+            train_specs = None
+            with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 root_key, train_key = jax.random.split(root_key)
                 do_ema = jnp.bool_(update % ema_every == 0)
-                agent_state, opt_states, losses = train_fn(
-                    agent_state, opt_states, batch, train_key, do_ema
-                )
+                train_args = (agent_state, opt_states, batch, train_key, do_ema)
+                if telemetry is not None and telemetry.needs_train_flops():
+                    # specs captured pre-call: the train step donates its state
+                    train_specs = shape_specs(train_args)
+                agent_state, opt_states, losses = train_fn(*train_args)
                 losses = fetch_losses_if_observed(losses, aggregator)
+            if train_specs is not None:
+                # per train-step UNIT: the counter advances by world_size per
+                # dispatched program (which runs g_total gradient steps)
+                flops = cost_flops_of(train_fn, *train_specs)
+                telemetry.set_train_flops(flops / world_size if flops else None)
             play_actor = actor_mirror(agent_state["actor"])
             train_step += world_size
 
@@ -406,25 +425,15 @@ def main(fabric, cfg: Dict[str, Any]):
                 if logger is not None:
                     logger.log_metrics(metrics_dict, policy_step)
                 aggregator.reset()
-            if not timer.disabled:
-                timer_metrics = timer.compute()
-                if logger is not None:
-                    if timer_metrics.get("Time/train_time"):
-                        logger.log_metrics(
-                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
-                                )
-                                / timer_metrics["Time/env_interaction_time"]
-                            },
-                            policy_step,
-                        )
-                timer.reset()
+            log_sps_metrics(
+                logger,
+                policy_step=policy_step,
+                last_log=last_log,
+                train_step=train_step,
+                last_train=last_train,
+                world_size=world_size,
+                action_repeat=cfg.env.action_repeat,
+            )
             last_log = policy_step
             last_train = train_step
 
@@ -441,12 +450,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 "last_checkpoint": last_checkpoint,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{fabric.global_rank}")
-            fabric.call(
-                "on_checkpoint_coupled",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
-            )
+            with span("Time/checkpoint_time", phase="checkpoint"):
+                fabric.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
+                )
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True):

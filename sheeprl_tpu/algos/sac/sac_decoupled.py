@@ -42,7 +42,14 @@ from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.obs import (
+    count_h2d,
+    cost_flops_of,
+    get_telemetry,
+    log_sps_metrics,
+    shape_specs,
+    span,
+)
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 
 
@@ -188,6 +195,16 @@ def main(fabric, cfg: Dict[str, Any]):
     player_error: Dict[str, BaseException] = {}
     stop = threading.Event()
 
+    # run-health: both sides of the decoupled pair heartbeat once per unit of
+    # progress; the watchdog flags whichever wedges instead of the run going
+    # silent on a hung env worker / device link / exchange wait
+    telemetry = get_telemetry()
+    watchdog = telemetry.watchdog() if telemetry is not None else None
+    if watchdog is not None:
+        watchdog.register("sac-player")
+        watchdog.register("sac-trainer")
+        watchdog.start()
+
     def player(player_key):
         try:
             o = envs.reset(seed=cfg.seed)[0]
@@ -195,13 +212,19 @@ def main(fabric, cfg: Dict[str, Any]):
             for update in range(start_step, num_updates + 1):
                 # collect step `update` while the trainer works on `update-1`
                 # (one-step lead = the PPO sibling's depth-1 queue)
+                if watchdog is not None:
+                    # waiting for the trainer to release the next step is
+                    # idleness, not a stall of the player
+                    watchdog.pause("sac-player")
                 with step_cv:
                     step_cv.wait_for(
                         lambda: progress["trained"] >= update - 2 or stop.is_set()
                     )
                 if stop.is_set():
                     return
-                with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+                if watchdog is not None:
+                    watchdog.beat("sac-player")
+                with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
                     if update <= learning_starts:
                         actions = envs.action_space.sample()
                     else:
@@ -250,6 +273,9 @@ def main(fabric, cfg: Dict[str, Any]):
             with step_cv:
                 progress["collected"] = num_updates
                 step_cv.notify_all()
+        finally:
+            if watchdog is not None:  # a finished player is not a stalled one
+                watchdog.unregister("sac-player")
 
     root_key, player_key = jax.random.split(root_key)
     player_thread = threading.Thread(target=player, args=(player_key,), daemon=True, name="sac-player")
@@ -262,11 +288,17 @@ def main(fabric, cfg: Dict[str, Any]):
 
     try:
         for update in range(start_step, num_updates + 1):
+            if watchdog is not None:
+                # waiting for the player's next collected step is idleness,
+                # not a stall of the trainer
+                watchdog.pause("sac-trainer")
             with step_cv:
                 step_cv.wait_for(lambda: progress["collected"] >= update)
                 ep_stats = progress.pop("ep_stats", [])
             if "error" in player_error:
                 raise RuntimeError("SAC player thread crashed") from player_error["error"]
+            if watchdog is not None:
+                watchdog.beat("sac-trainer")
             policy_step += n_envs
 
             if aggregator and not aggregator.disabled:
@@ -287,15 +319,22 @@ def main(fabric, cfg: Dict[str, Any]):
                     k: np.reshape(v, (g_total, world_size * cfg.per_rank_batch_size) + v.shape[2:])
                     for k, v in sample.items()
                 }
-                batch = jax.device_put(batch, batch_sharding)
+                with span("Time/stage_h2d_time", phase="stage_h2d"):
+                    batch = jax.device_put(batch, batch_sharding)
+                count_h2d(sample)
 
-                with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+                with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                     root_key, train_key = jax.random.split(root_key)
                     do_ema = jnp.bool_(update % ema_every == 0)
-                    agent_state, opt_states, losses = train_fn(
-                        agent_state, opt_states, batch, train_key, do_ema
-                    )
+                    train_args = (agent_state, opt_states, batch, train_key, do_ema)
+                    agent_state, opt_states, losses = train_fn(*train_args)
                     losses = fetch_losses_if_observed(losses, aggregator)
+                if telemetry is not None and telemetry.needs_train_flops():
+                    # donation is off in decoupled mode; one AOT cost
+                    # analysis, registered per train-step UNIT (the counter
+                    # advances by world_size per dispatched program)
+                    flops = cost_flops_of(train_fn, *shape_specs(train_args))
+                    telemetry.set_train_flops(flops / world_size if flops else None)
                 train_step += world_size
                 # parameter broadcast to the player (reference :525-529)
                 param_cell["actor"] = actor_mirror(agent_state["actor"])
@@ -313,25 +352,15 @@ def main(fabric, cfg: Dict[str, Any]):
                     if logger is not None:
                         logger.log_metrics(metrics_dict, policy_step)
                     aggregator.reset()
-                if not timer.disabled:
-                    timer_metrics = timer.compute()
-                    if logger is not None:
-                        if timer_metrics.get("Time/train_time"):
-                            logger.log_metrics(
-                                {"Time/sps_train": (train_step - last_train) / max(timer_metrics["Time/train_time"], 1e-9)},
-                                policy_step,
-                            )
-                        if timer_metrics.get("Time/env_interaction_time"):
-                            logger.log_metrics(
-                                {
-                                    "Time/sps_env_interaction": (
-                                        (policy_step - last_log) / world_size * cfg.env.action_repeat
-                                    )
-                                    / max(timer_metrics["Time/env_interaction_time"], 1e-9)
-                                },
-                                policy_step,
-                            )
-                    timer.reset()
+                log_sps_metrics(
+                    logger,
+                    policy_step=policy_step,
+                    last_log=last_log,
+                    train_step=train_step,
+                    last_train=last_train,
+                    world_size=world_size,
+                    action_repeat=cfg.env.action_repeat,
+                )
                 last_log = policy_step
                 last_train = train_step
 
@@ -348,7 +377,8 @@ def main(fabric, cfg: Dict[str, Any]):
                     "last_checkpoint": last_checkpoint,
                 }
                 ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{fabric.global_rank}")
-                with rb_lock:  # the player must not write mid-snapshot
+                with rb_lock, span("Time/checkpoint_time", phase="checkpoint"):
+                    # the player must not write mid-snapshot
                     fabric.call(
                         "on_checkpoint_player",
                         ckpt_path=ckpt_path,
@@ -365,6 +395,8 @@ def main(fabric, cfg: Dict[str, Any]):
         with step_cv:
             step_cv.notify_all()
         player_thread.join(timeout=30)
+        if watchdog is not None:
+            watchdog.stop()
         envs.close()
 
     if fabric.is_global_zero and cfg.algo.get("run_test", True):

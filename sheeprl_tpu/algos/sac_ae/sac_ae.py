@@ -39,8 +39,9 @@ from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.obs import count_h2d, log_sps_metrics, span
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 sg = jax.lax.stop_gradient
 
@@ -231,7 +232,7 @@ def build_train_fn(
         metrics = jax.lax.pmean(jnp.mean(metrics, axis=0), axis)
         return state, opts, metrics
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_train,
         mesh=fabric.mesh,
         in_specs=(P(), P(), P(None, axis), P(), P()),
@@ -414,7 +415,7 @@ def main(fabric, cfg: Dict[str, Any]):
     for update in range(start_step, num_updates + 1):
         policy_step += n_envs
 
-        with timer("Time/env_interaction_time", SumMetric(sync_on_compute=False)):
+        with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
             if update <= learning_starts:
                 actions = envs.action_space.sample()
             else:
@@ -477,9 +478,11 @@ def main(fabric, cfg: Dict[str, Any]):
                 )
                 for k, v in sample.items()
             }
-            batch = jax.device_put(batch, batch_sharding)
+            with span("Time/stage_h2d_time", phase="stage_h2d"):
+                batch = jax.device_put(batch, batch_sharding)
+            count_h2d(sample)
 
-            with timer("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute)):
+            with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 root_key, train_key = jax.random.split(root_key)
                 gates = {
                     "do_ema": jnp.bool_(update % ema_every == 0),
@@ -507,25 +510,15 @@ def main(fabric, cfg: Dict[str, Any]):
                 if logger is not None:
                     logger.log_metrics(metrics_dict, policy_step)
                 aggregator.reset()
-            if not timer.disabled:
-                timer_metrics = timer.compute()
-                if logger is not None:
-                    if timer_metrics.get("Time/train_time"):
-                        logger.log_metrics(
-                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time"):
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
-                                )
-                                / timer_metrics["Time/env_interaction_time"]
-                            },
-                            policy_step,
-                        )
-                timer.reset()
+            log_sps_metrics(
+                logger,
+                policy_step=policy_step,
+                last_log=last_log,
+                train_step=train_step,
+                last_train=last_train,
+                world_size=world_size,
+                action_repeat=cfg.env.action_repeat,
+            )
             last_log = policy_step
             last_train = train_step
 

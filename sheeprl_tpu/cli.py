@@ -97,18 +97,35 @@ def resume_from_checkpoint(cfg, overrides: Optional[Sequence[str]] = None) -> An
 
     reapplied = []
     dropped = []
+    ignored = []  # (override, reason) — every typed token is accounted for
     for o in overrides or []:
-        if "=" not in o or o.startswith("~"):
+        if o.startswith("~"):
+            ignored.append(
+                (o, "deletions cannot be re-applied onto the restored config")
+            )
+            continue
+        if "=" not in o:
+            ignored.append((o, "not a key=value override"))
             continue
         key, value = o.split("=", 1)
         added = key.startswith("+")
         key = key.lstrip("+")
         if key in ("checkpoint.resume_from", "root_dir", "run_name") or key.startswith("fabric"):
-            continue  # already carried over above
+            continue  # already carried over above (not silent: cfg wins)
         if key == "exp":
-            continue  # defaults-list selection, consumed at compose time
+            ignored.append(
+                (o, "defaults-list selection, consumed at compose time; the "
+                    "checkpointed experiment defines the recipe")
+            )
+            continue
         if "." not in key and isinstance(old_cfg.get(key, None), dict):
-            continue  # group selection (env=..., algo=...): swap-time semantics
+            ignored.append(
+                (o, "group selection / dict-valued key with swap-time "
+                    "semantics; it cannot be re-applied onto the composed "
+                    f"tree — pass leaf overrides ({key}.<field>=...) to "
+                    "change the restored section")
+            )
+            continue
         if not _set_existing_path(old_cfg, key, yaml_load(value), allow_new=added):
             # unknown key (typo, or a +new key the stored tree lacks):
             # inventing it would hide the misconfiguration this merge exists
@@ -116,12 +133,16 @@ def resume_from_checkpoint(cfg, overrides: Optional[Sequence[str]] = None) -> An
             dropped.append(o)
             continue
         reapplied.append(o)
-    if reapplied:
-        warnings.warn(
-            "resume_from_checkpoint: re-applied explicit overrides on top of "
-            f"the checkpointed config: {reapplied}. All other values come "
-            "from the checkpoint's stored config."
-        )
+    if reapplied or ignored:
+        lines = [
+            "resume_from_checkpoint: the restored config defines the "
+            "experiment; typed overrides were accounted for as follows."
+        ]
+        if reapplied:
+            lines.append(f"re-applied: {reapplied}.")
+        for o, reason in ignored:
+            lines.append(f"ignored {o!r}: {reason}.")
+        warnings.warn(" ".join(lines))
     if dropped:
         raise ValueError(
             "resume_from_checkpoint: these overrides name keys absent from "
@@ -271,22 +292,34 @@ def run_algorithm(cfg) -> None:
     ) == 0
     timer.disabled = cfg.metric.log_level == 0 or cfg.metric.get("disable_timer", False)
 
-    # jax.profiler trace capture around the whole run (SURVEY §5.1 — the TPU
-    # superset of the reference's named-scope timers)
-    profiler = cfg.metric.get("profiler", False)
-    if profiler:
-        import jax
+    # Run telemetry (metric.telemetry config group, obs/): spans, counters,
+    # health guards. Owned here so the end-of-run summary/telemetry.json is
+    # written even when the entrypoint raises; the run dir is attached later
+    # by create_tensorboard_logger once the versioned path exists.
+    from sheeprl_tpu.obs.telemetry import finalize_telemetry, setup_telemetry
 
-        # traces land inside the run tree next to checkpoints/metrics
-        trace_dir = (
-            profiler
-            if isinstance(profiler, str)
-            else os.path.join("logs", "runs", str(cfg.root_dir), str(cfg.run_name), "jax_traces")
-        )
-        with jax.profiler.trace(os.path.abspath(trace_dir)):
-            return fabric.launch(entrypoint, cfg, **kwargs)
+    setup_telemetry(cfg)
+    try:
+        # jax.profiler trace capture around the whole run (SURVEY §5.1 — the
+        # TPU superset of the reference's named-scope timers)
+        profiler = cfg.metric.get("profiler", False)
+        if profiler:
+            import jax
 
-    fabric.launch(entrypoint, cfg, **kwargs)
+            # traces land inside the run tree next to checkpoints/metrics
+            trace_dir = (
+                profiler
+                if isinstance(profiler, str)
+                else os.path.join(
+                    "logs", "runs", str(cfg.root_dir), str(cfg.run_name), "jax_traces"
+                )
+            )
+            with jax.profiler.trace(os.path.abspath(trace_dir)):
+                return fabric.launch(entrypoint, cfg, **kwargs)
+
+        fabric.launch(entrypoint, cfg, **kwargs)
+    finally:
+        finalize_telemetry()
 
 
 def eval_algorithm(cfg) -> None:

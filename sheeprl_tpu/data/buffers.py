@@ -870,14 +870,24 @@ class EnvIndependentReplayBuffer:
 
 
 def to_device(batch: Dict[str, np.ndarray], dtype: Optional[Any] = None, device: Optional[Any] = None):
-    """Stage a numpy batch onto a jax device (or sharding) as one transfer."""
+    """Stage a numpy batch onto a jax device (or sharding) as one transfer.
+
+    This is a host→HBM staging chokepoint: the run telemetry counts the bytes
+    shipped and times the dispatch under the ``stage_h2d`` phase span (both
+    no-ops when ``metric.telemetry`` is disabled).
+    """
     import jax
     import jax.numpy as jnp
 
-    out = {}
-    for k, v in batch.items():
-        arr = jnp.asarray(v, dtype=dtype) if device is None else jax.device_put(
-            v.astype(dtype) if dtype is not None else v, device
-        )
-        out[k] = arr
+    from sheeprl_tpu.obs.counters import count_h2d
+    from sheeprl_tpu.obs.spans import span
+
+    with span("Time/stage_h2d_time", phase="stage_h2d"):
+        out = {}
+        for k, v in batch.items():
+            arr = jnp.asarray(v, dtype=dtype) if device is None else jax.device_put(
+                v.astype(dtype) if dtype is not None else v, device
+            )
+            out[k] = arr
+    count_h2d(batch)
     return out

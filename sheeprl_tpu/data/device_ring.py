@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, _as_np
+from sheeprl_tpu.obs.counters import staged_device_put
 
 __all__ = ["DeviceRingReplay"]
 
@@ -257,7 +258,7 @@ class DeviceRingReplay:
                     if sub._buf is not None and n_rows[env] > 0:
                         block[: n_rows[env], col] = _as_np(sub._buf[k])[: n_rows[env], 0]
                 blocks[k] = block
-            blocks = jax.device_put(blocks, self._homes[g])
+            blocks = staged_device_put(blocks, self._homes[g])
             self._shards[g] = set_block(self._shards[g], blocks)
 
     # -- write path --------------------------------------------------------
@@ -312,12 +313,10 @@ class DeviceRingReplay:
             for v in example_row.values()
         )
         total = rows * bytes_per_row  # largest single-device shard
-        limit = None
-        try:
-            stats = self._homes[0].memory_stats()
-            limit = stats.get("bytes_limit") if stats else None
-        except Exception:
-            pass
+        from sheeprl_tpu.obs.counters import device_memory_stats
+
+        stats = device_memory_stats(self._homes[0])
+        limit = stats.get("bytes_limit") if stats else None
         if limit and total > 0.95 * limit:
             # certain OOM: the ring alone leaves no room for params/optimizer
             fit_rows = max(int(0.5 * limit / max(bytes_per_row, 1)) - self._overlap, 0)
@@ -410,7 +409,7 @@ class DeviceRingReplay:
                 for env, (pos, dst) in by_env.items():
                     stack[dst] = _as_np(self._rb.buffer[env]._buf[k])[ts[pos], 0]
                 rows[k] = stack
-            payload = jax.device_put((t_idx, e_idx, rows), self._homes[g])
+            payload = staged_device_put((t_idx, e_idx, rows), self._homes[g])
             self._shards[g] = self._scatter_fn(padded)(self._shards[g], *payload)
         self._staged.clear()
 
@@ -533,7 +532,10 @@ class DeviceRingReplay:
         for g, envs in enumerate(self._groups):
             starts, cols = self._plan_group(envs, b_local, sequence_length, n_samples)
             fn = self._gather_fn(starts.shape[0], sequence_length, n_samples)
-            starts, cols = jax.device_put((starts, cols), self._homes[g])
+            # the index plan is the ONLY host→device traffic of a ring sample;
+            # counting it keeps the telemetry's bytes_staged_h2d an honest
+            # total (and shows how little the ring ships vs host staging)
+            starts, cols = staged_device_put((starts, cols), self._homes[g])
             parts.append(fn(self._shards[g], starts, cols))
         if self._sharding is None:
             return parts[0]

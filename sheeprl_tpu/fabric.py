@@ -150,6 +150,22 @@ class Fabric:
                     f"Unknown fabric.prng_impl {prng_impl!r}; expected one of "
                     "'rbg', 'threefry' (threefry2x32), 'unsafe_rbg'"
                 )
+            if prng_impl != "threefry2x32" and not hasattr(jax, "shard_map"):
+                # pre-graduation jax ships an XLA whose SPMD partitioner hard
+                # CHECK-fails (`!IsManual()`) on the RngBitGenerator op that
+                # rbg keys lower to inside shard_map's manual regions; on such
+                # versions every multi-device train step would abort the
+                # process. Counter-based threefry partitions fine everywhere.
+                import warnings
+
+                warnings.warn(
+                    f"fabric.prng_impl={prng_impl!r} is not usable inside "
+                    "shard_map on this jax version (XLA SPMD partitioner "
+                    "crashes on manual RngBitGenerator); falling back to "
+                    "'threefry2x32'",
+                    UserWarning,
+                )
+                prng_impl = "threefry2x32"
             jax.config.update("jax_default_prng_impl", prng_impl)
         self.strategy = strategy or "auto"
         self.accelerator = accelerator or "auto"

@@ -1,0 +1,73 @@
+"""Runtime telemetry: phase spans, device/transfer/recompile counters, and a
+run-health monitor.
+
+The observability layer the ROADMAP's data-path rounds are judged against —
+it *measures* the host→HBM staging path, XLA recompiles, HBM occupancy, and
+per-phase wall time instead of inferring them from wall-clock deltas. Four
+pieces (see ``howto/telemetry.md``):
+
+- :mod:`~sheeprl_tpu.obs.spans` — Chrome trace-event spans layered on the
+  global ``timer`` registry, mirrored into XLA profiles;
+- :mod:`~sheeprl_tpu.obs.counters` — host→HBM byte accounting, a
+  ``jax.monitoring`` recompile listener, and a device-memory poller;
+- :mod:`~sheeprl_tpu.obs.health` — NaN/inf guards on logged losses and a
+  stall watchdog for decoupled player↔trainer threads;
+- :mod:`~sheeprl_tpu.obs.perf` — the shared ``Time/sps_*`` / ``Perf/mfu``
+  gauge plumbing every entrypoint logs through.
+
+Everything is configured by the ``metric.telemetry`` config group and
+defaults to off; disabled, the instrumented code paths reduce to the plain
+``timer`` registry with no extra file handles, threads, or device syncs.
+"""
+
+from sheeprl_tpu.obs.counters import (
+    Counters,
+    DevicePoller,
+    add_h2d_bytes,
+    count_h2d,
+    device_memory_stats,
+    staged_device_put,
+    tree_nbytes,
+)
+from sheeprl_tpu.obs.health import NonFiniteGuard, StallWatchdog
+from sheeprl_tpu.obs.perf import (
+    PEAK_TFLOPS_BF16,
+    cost_flops,
+    cost_flops_of,
+    log_sps_metrics,
+    mfu_pct,
+    shape_specs,
+)
+from sheeprl_tpu.obs.spans import TraceWriter, get_tracer, set_tracer, span
+from sheeprl_tpu.obs.telemetry import (
+    Telemetry,
+    finalize_telemetry,
+    get_telemetry,
+    setup_telemetry,
+)
+
+__all__ = [
+    "Counters",
+    "DevicePoller",
+    "NonFiniteGuard",
+    "PEAK_TFLOPS_BF16",
+    "StallWatchdog",
+    "Telemetry",
+    "TraceWriter",
+    "add_h2d_bytes",
+    "count_h2d",
+    "cost_flops",
+    "cost_flops_of",
+    "device_memory_stats",
+    "finalize_telemetry",
+    "get_telemetry",
+    "get_tracer",
+    "log_sps_metrics",
+    "mfu_pct",
+    "set_tracer",
+    "setup_telemetry",
+    "shape_specs",
+    "span",
+    "staged_device_put",
+    "tree_nbytes",
+]

@@ -1,0 +1,275 @@
+"""Device/transfer/recompile counters.
+
+Three measurement families, all host-side and sync-free:
+
+- **host→HBM transfer accounting**: the staging paths
+  (:func:`sheeprl_tpu.data.buffers.to_device`, the
+  :class:`~sheeprl_tpu.data.device_ring.DeviceRingReplay` flush/upload, and
+  the train loops' batch ``device_put``) report the numpy bytes they ship via
+  :func:`add_h2d_bytes`. This measures exactly the path the round-5 verdict
+  names as the architectural bottleneck (the 2–8 MB/s staging tunnel).
+- **recompile accounting**: a process-wide ``jax.monitoring`` listener counts
+  backend compiles (``/jax/core/compile/backend_compile_duration``) and
+  persistent-cache hits, so a silent retrace storm — a shape or dtype leaking
+  into a jitted signature — becomes a visible, logged number instead of a
+  mystery slowdown.
+- **device memory**: :func:`device_memory_stats` is the one
+  ``Device.memory_stats()`` probe (generalizing the one-off check the device
+  ring used for its allocation guard); :class:`DevicePoller` samples it on a
+  background thread and tracks peak HBM use per run.
+
+All counters are no-ops until :func:`install` is called (by
+``setup_telemetry``) — the module-global pointer is ``None`` and every hot
+path is a single attribute check.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "Counters",
+    "add_h2d_bytes",
+    "device_memory_stats",
+    "DevicePoller",
+    "install",
+    "installed",
+    "staged_device_put",
+    "tree_nbytes",
+]
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+_COUNTERS: Optional["Counters"] = None
+_LISTENERS_REGISTERED = False
+
+
+class Counters:
+    """Thread-safe run counters (players/trainers/pollers all write here)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.h2d_bytes = 0
+        self.h2d_transfers = 0
+        self.recompiles = 0
+        self.compile_secs = 0.0
+        self.compile_cache_hits = 0
+        self.nonfinite_metrics = 0
+        self.stalls = 0
+
+    def add(self, field: str, amount) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + amount)
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "bytes_staged_h2d": self.h2d_bytes,
+                "h2d_transfers": self.h2d_transfers,
+                "recompiles": self.recompiles,
+                "compile_secs": round(self.compile_secs, 3),
+                "compile_cache_hits": self.compile_cache_hits,
+                "nonfinite_metrics": self.nonfinite_metrics,
+                "stalls": self.stalls,
+            }
+
+
+def install(counters: Optional["Counters"]) -> None:
+    """Activate (or with ``None`` deactivate) the run counters."""
+    global _COUNTERS
+    _COUNTERS = counters
+    if counters is not None:
+        _ensure_jax_listeners()
+
+
+def installed() -> Optional["Counters"]:
+    return _COUNTERS
+
+
+# -- transfer accounting ----------------------------------------------------
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total bytes of the *host* (numpy) leaves of a pytree.
+
+    Device-resident jax Arrays are skipped — reading their size is free, but
+    they are not about to cross the host→HBM link again, and forcing them
+    through numpy would add the device sync this module exists to avoid.
+    """
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, np.ndarray):
+            total += leaf.nbytes
+        elif isinstance(leaf, (np.generic, bytes)):
+            total += np.asarray(leaf).nbytes if isinstance(leaf, np.generic) else len(leaf)
+    return total
+
+
+def add_h2d_bytes(nbytes: int, transfers: int = 1) -> None:
+    """Record ``nbytes`` staged host→device (no-op when telemetry is off)."""
+    c = _COUNTERS
+    if c is not None and nbytes:
+        with c._lock:
+            c.h2d_bytes += int(nbytes)
+            c.h2d_transfers += transfers
+
+
+def count_h2d(tree: Any) -> None:
+    """Record the host bytes of ``tree`` as one staged transfer.
+
+    The size walk itself is skipped when telemetry is off, so hot loops can
+    call this unconditionally.
+    """
+    if _COUNTERS is not None:
+        add_h2d_bytes(tree_nbytes(tree))
+
+
+def staged_device_put(data: Any, device: Any):
+    """``jax.device_put`` wrapped in the host→HBM staging span + byte count.
+
+    The span measures the *dispatch* of the (async) transfer — on a local
+    device that is approximately the copy itself; on a remote-attached link
+    the tail of the transfer overlaps the caller's next work, which is the
+    point. Byte accounting is exact either way.
+    """
+    import jax
+
+    from sheeprl_tpu.obs.spans import span
+
+    nbytes = tree_nbytes(data) if _COUNTERS is not None else 0
+    with span("Time/stage_h2d_time", phase="stage_h2d"):
+        out = jax.device_put(data, device)
+    add_h2d_bytes(nbytes)
+    return out
+
+
+# -- recompile accounting ---------------------------------------------------
+
+
+def _on_event_duration(event: str, duration: float, **_kw) -> None:
+    c = _COUNTERS
+    if c is not None and event == _BACKEND_COMPILE_EVENT:
+        with c._lock:
+            c.recompiles += 1
+            c.compile_secs += float(duration)
+
+
+def _on_event(event: str, **_kw) -> None:
+    c = _COUNTERS
+    if c is not None and event == _CACHE_HIT_EVENT:
+        with c._lock:
+            c.compile_cache_hits += 1
+
+
+def _ensure_jax_listeners() -> None:
+    """Register the jax.monitoring listeners once per process.
+
+    jax offers no targeted unregister, so the listeners live for the process
+    and forward to whichever counters are currently installed (no-op when
+    telemetry is off).
+    """
+    global _LISTENERS_REGISTERED
+    if _LISTENERS_REGISTERED:
+        return
+    import jax
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+    jax.monitoring.register_event_listener(_on_event)
+    _LISTENERS_REGISTERED = True
+
+
+# -- device memory ----------------------------------------------------------
+
+
+def device_memory_stats(device: Any) -> Optional[Dict[str, Any]]:
+    """``device.memory_stats()`` or None (CPU backends / unsupported runtimes)."""
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    return stats or None
+
+
+class DevicePoller:
+    """Background sampler of per-device memory stats.
+
+    Tracks the run's peak HBM use (``peak_bytes_in_use`` where the runtime
+    reports it, ``bytes_in_use`` otherwise) and, when a tracer is active,
+    emits one counter event per sample so HBM occupancy is plottable on the
+    same timeline as the phase spans. Zero interaction with the dispatch
+    path: ``memory_stats`` is a local runtime query, not a device program.
+    """
+
+    def __init__(self, interval_s: float = 5.0, devices: Optional[list] = None):
+        self.interval_s = float(interval_s)
+        self._devices = devices
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.peak_hbm_bytes = 0
+        self.hbm_bytes_limit = 0
+        self.samples = 0
+
+    def _resolve_devices(self) -> list:
+        if self._devices is None:
+            import jax
+
+            self._devices = list(jax.local_devices())
+        return self._devices
+
+    def sample_once(self) -> None:
+        from sheeprl_tpu.obs.spans import get_tracer
+
+        in_use: Dict[str, float] = {}
+        peak = 0
+        limit = 0
+        for dev in self._resolve_devices():
+            stats = device_memory_stats(dev)
+            if not stats:
+                continue
+            used = int(stats.get("bytes_in_use", 0))
+            peak = max(peak, int(stats.get("peak_bytes_in_use", used)))
+            limit = max(limit, int(stats.get("bytes_limit", 0)))
+            in_use[str(dev.id)] = used
+        with self._lock:
+            self.samples += 1
+            self.peak_hbm_bytes = max(self.peak_hbm_bytes, peak)
+            self.hbm_bytes_limit = max(self.hbm_bytes_limit, limit)
+        tracer = get_tracer()
+        if tracer is not None and in_use:
+            tracer.counter("hbm_bytes_in_use", in_use)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_once()
+
+    def start(self) -> None:
+        if self.interval_s <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="obs-device-poller", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # even a run shorter than one interval gets a final sample
+        self.sample_once()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "peak_hbm_bytes": self.peak_hbm_bytes,
+                "hbm_bytes_limit": self.hbm_bytes_limit,
+                "hbm_samples": self.samples,
+            }

@@ -1,0 +1,155 @@
+"""SPS / MFU gauges — the one implementation every entrypoint logs through.
+
+Before this module the ``Time/sps_*`` block was copy-pasted across all 17
+algorithm entrypoints and MFU lived only in ``bench_dreamer.py``; the copies
+had already drifted (bare division vs ``max(..., 1e-9)`` guards).
+:func:`log_sps_metrics` is now the single computation — entrypoints call it
+at their log boundary and ``tools/lint_telemetry.py`` fails CI if one grows
+its own ``Time/sps_`` literal again. The benches import the same FLOPs/MFU
+helpers, so benchmark numbers and run telemetry cannot disagree on the
+formula.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "PEAK_TFLOPS_BF16",
+    "cost_flops",
+    "cost_flops_of",
+    "log_sps_metrics",
+    "mfu_pct",
+    "shape_specs",
+]
+
+#: TPU v5e single-chip bf16 peak — the default MFU denominator
+#: (``metric.telemetry.peak_tflops`` overrides; 32-true programs are measured
+#: against the same bf16 peak so numbers stay comparable across precisions).
+PEAK_TFLOPS_BF16 = 197.0
+
+
+def cost_flops(compiled) -> float:
+    """FLOPs of a compiled XLA module per ``Compiled.cost_analysis()``.
+
+    Caveat inherited by every consumer: XLA counts a while-loop *body once*
+    regardless of trip count, so scan-heavy programs under-report (the
+    Dreamer benches add per-family scan-body corrections on top of this).
+    """
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return float(ca.get("flops", 0.0))
+
+
+def shape_specs(tree: Any) -> Any:
+    """Abstract (shape, dtype) specs of a pytree of arrays — safe to keep
+    around after the concrete (possibly donated) buffers are gone."""
+    import jax
+    import numpy as np
+
+    def spec(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        return jax.ShapeDtypeStruct((), np.asarray(x).dtype)
+
+    return jax.tree_util.tree_map(spec, tree)
+
+
+def cost_flops_of(jit_fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs of ``jit_fn(*args)`` via AOT lower+compile, or None.
+
+    Pass :func:`shape_specs` of the arguments rather than live arrays when
+    the call donates buffers. The compile hits the in-memory executable cache
+    when the same program already ran, so this is cheap enough to call once
+    per run; it is still a retrace, so callers gate it on telemetry being
+    enabled.
+    """
+    try:
+        return cost_flops(jit_fn.lower(*args, **kwargs).compile())
+    except Exception:
+        return None
+
+
+def mfu_pct(
+    flops_per_step: Optional[float],
+    steps: float,
+    seconds: Optional[float],
+    peak_tflops: float = PEAK_TFLOPS_BF16,
+) -> Optional[float]:
+    """Model FLOPs utilization in percent, or None when unmeasurable."""
+    if not flops_per_step or not seconds or seconds <= 0 or steps <= 0 or peak_tflops <= 0:
+        return None
+    return round(flops_per_step * steps / seconds / (peak_tflops * 1e12) * 100.0, 3)
+
+
+def log_sps_metrics(
+    logger,
+    *,
+    policy_step: int,
+    last_log: int,
+    train_step: int = 0,
+    last_train: int = 0,
+    world_size: int = 1,
+    action_repeat: int = 1,
+) -> Dict[str, float]:
+    """Compute the standard rate gauges from the global timer registry, log
+    them, and feed the run telemetry.
+
+    Reads-and-resets the registry (the ``timer.compute()`` contract), so call
+    exactly once per log boundary. Returns the gauges that were logged:
+    ``Time/sps_train`` (train steps per second of timed train wall),
+    ``Time/sps_env_interaction`` (per-process env steps × action_repeat per
+    second of timed interaction wall), and — when the algorithm registered
+    its per-train-step FLOPs with the telemetry — ``Perf/mfu``.
+    """
+    from sheeprl_tpu.obs.telemetry import get_telemetry
+    from sheeprl_tpu.utils.timer import timer
+
+    telemetry = get_telemetry()
+    if timer.disabled:
+        # reachable only under metric.disable_timer=true (every call site is
+        # log_level-gated, and log_level=0 implies disabled timers): keep the
+        # telemetry step totals accurate even without rate gauges. Fully
+        # quiet runs (log_level=0) never reach a log boundary at all — their
+        # telemetry.json reports the step/rate fields as null by design.
+        if telemetry is not None:
+            telemetry.record_window(
+                policy_steps=policy_step - last_log,
+                train_steps=train_step - last_train,
+            )
+        return {}
+    timer_metrics = timer.compute()
+    train_s = timer_metrics.get("Time/train_time")
+    env_s = timer_metrics.get("Time/env_interaction_time")
+    train_steps = train_step - last_train
+    policy_steps = policy_step - last_log
+
+    gauges: Dict[str, float] = {}
+    if train_s:
+        gauges["Time/sps_train"] = train_steps / max(train_s, 1e-9)
+    if env_s:
+        gauges["Time/sps_env_interaction"] = (
+            policy_steps / world_size * action_repeat
+        ) / max(env_s, 1e-9)
+
+    if telemetry is not None:
+        telemetry.record_window(
+            policy_steps=policy_steps,
+            train_steps=train_steps,
+            env_seconds=env_s or 0.0,
+            train_seconds=train_s or 0.0,
+            stage_seconds=timer_metrics.get("Time/stage_h2d_time", 0.0),
+        )
+        mfu = mfu_pct(
+            telemetry.flops_per_train_step,
+            train_steps,
+            train_s,
+            telemetry.peak_tflops,
+        )
+        if mfu is not None:
+            gauges["Perf/mfu"] = mfu
+
+    if logger is not None and gauges:
+        logger.log_metrics(gauges, policy_step)
+    return gauges

@@ -1,0 +1,220 @@
+"""Phase spans: structured tracing layered on the wall-clock timer registry.
+
+:class:`span` is a drop-in superset of :class:`sheeprl_tpu.utils.timer.timer`:
+it accumulates wall seconds into the same global registry (so the
+``Time/sps_*`` rate gauges keep working unchanged), and — when a run tracer is
+active — additionally
+
+- emits one Chrome trace-event per scope into a per-run JSONL file
+  (``<log_dir>/telemetry/trace.jsonl``), and
+- mirrors the scope into :class:`jax.profiler.TraceAnnotation`, so the same
+  phase names show up inside XLA/TensorBoard device profiles captured with
+  ``metric.profiler``.
+
+The tracer is installed by :func:`sheeprl_tpu.obs.telemetry.setup_telemetry`;
+with no tracer installed a ``span`` is exactly a ``timer`` (no file handles,
+no jax calls, no device syncs), so instrumented code paths cost nothing in
+un-instrumented runs.
+
+Trace-event schema (one JSON object per line; the "complete event" subset of
+the Chrome trace-event format):
+
+``{"name": str, "cat": phase, "ph": "X", "ts": µs, "dur": µs,
+  "pid": jax process index, "tid": host thread id}``
+
+plus ``{"ph": "M", ...}`` thread-name metadata and ``{"ph": "C", ...}``
+counter samples from the device poller. Load in Perfetto / chrome://tracing
+after wrapping the lines in a JSON array (``jq -s . trace.jsonl``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import ContextDecorator
+from typing import Any, Dict, Optional
+
+from sheeprl_tpu.utils.timer import timer
+
+__all__ = ["span", "TraceWriter", "get_tracer", "set_tracer"]
+
+#: events buffered before a file flush (bounds write syscalls in hot loops)
+_FLUSH_EVERY = 128
+
+_TRACER: Optional["TraceWriter"] = None
+
+
+def get_tracer() -> Optional["TraceWriter"]:
+    """The run's active tracer, or None (telemetry disabled)."""
+    return _TRACER
+
+
+def set_tracer(tracer: Optional["TraceWriter"]) -> None:
+    global _TRACER
+    _TRACER = tracer
+
+
+class TraceWriter:
+    """Thread-safe buffered Chrome trace-event JSONL writer."""
+
+    def __init__(self, path: str, xla_annotations: bool = True):
+        self.path = path
+        self.xla_annotations = bool(xla_annotations)
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+        self._file = open(path, "w")
+        self._lock = threading.Lock()
+        self._buffer: list[str] = []
+        self._origin = time.perf_counter()
+        self._named_threads: set[int] = set()
+        try:
+            import jax
+
+            self._pid = int(jax.process_index())
+        except Exception:
+            self._pid = 0
+
+    # -- time ---------------------------------------------------------------
+
+    def now(self) -> float:
+        """Monotonic seconds; pass to :meth:`complete` as the span start."""
+        return time.perf_counter()
+
+    def _us(self, t: float) -> float:
+        return (t - self._origin) * 1e6
+
+    # -- events -------------------------------------------------------------
+
+    def _emit(self, event: Dict[str, Any]) -> None:
+        line = json.dumps(event)
+        with self._lock:
+            self._buffer.append(line)
+            if len(self._buffer) >= _FLUSH_EVERY:
+                self._flush_locked()
+
+    def _thread_meta(self, tid: int) -> None:
+        if tid in self._named_threads:
+            return
+        self._named_threads.add(tid)
+        self._emit(
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": self._pid,
+                "tid": tid,
+                "args": {"name": threading.current_thread().name},
+            }
+        )
+
+    def complete(self, name: str, cat: Optional[str], t0: float, t1: Optional[float] = None) -> None:
+        """One completed span ``[t0, t1]`` (``ph: X``)."""
+        t1 = time.perf_counter() if t1 is None else t1
+        tid = threading.get_ident()
+        self._thread_meta(tid)
+        self._emit(
+            {
+                "name": name,
+                "cat": cat or "run",
+                "ph": "X",
+                "ts": round(self._us(t0), 1),
+                "dur": round((t1 - t0) * 1e6, 1),
+                "pid": self._pid,
+                "tid": tid,
+            }
+        )
+
+    def counter(self, name: str, values: Dict[str, float]) -> None:
+        """A sampled counter series (``ph: C``) — e.g. per-device HBM use."""
+        self._emit(
+            {
+                "name": name,
+                "ph": "C",
+                "ts": round(self._us(time.perf_counter()), 1),
+                "pid": self._pid,
+                "args": values,
+            }
+        )
+
+    def instant(self, name: str, cat: Optional[str] = None, args: Optional[Dict[str, Any]] = None) -> None:
+        """A zero-duration marker (``ph: i``) — e.g. a health-guard firing."""
+        self._emit(
+            {
+                "name": name,
+                "cat": cat or "health",
+                "ph": "i",
+                "s": "g",
+                "ts": round(self._us(time.perf_counter()), 1),
+                "pid": self._pid,
+                "tid": threading.get_ident(),
+                **({"args": args} if args else {}),
+            }
+        )
+
+    def annotation(self, name: str):
+        """A ``jax.profiler.TraceAnnotation`` for the span, or None."""
+        if not self.xla_annotations:
+            return None
+        try:
+            import jax
+
+            return jax.profiler.TraceAnnotation(name)
+        except Exception:
+            return None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _flush_locked(self) -> None:
+        if self._buffer and not self._file.closed:
+            self._file.write("\n".join(self._buffer) + "\n")
+            self._file.flush()
+        self._buffer.clear()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            self._flush_locked()
+            if not self._file.closed:
+                self._file.close()
+
+
+class span(ContextDecorator):
+    """``with span("Time/train_time", phase="train"): ...``
+
+    Accumulates into the global :class:`timer` registry under ``name`` (same
+    semantics, including the concurrent-reset re-register path) and, when a
+    tracer is active, emits a trace event categorized under ``phase`` and
+    mirrors the scope into the XLA profiler.
+    """
+
+    def __init__(self, name: str, metric: Any = None, phase: Optional[str] = None):
+        self.name = name
+        self.phase = phase
+        self._timer = timer(name, metric)
+        self._t0: Optional[float] = None
+        self._annotation = None
+
+    def __enter__(self):
+        tracer = _TRACER
+        if tracer is not None:
+            self._t0 = tracer.now()
+            self._annotation = tracer.annotation(self.name)
+            if self._annotation is not None:
+                self._annotation.__enter__()
+        self._timer.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._timer.__exit__(*exc)
+        if self._annotation is not None:
+            self._annotation.__exit__(*exc)
+            self._annotation = None
+        if self._t0 is not None:
+            tracer = _TRACER
+            if tracer is not None:
+                tracer.complete(self.name, self.phase, self._t0)
+            self._t0 = None
+        return False

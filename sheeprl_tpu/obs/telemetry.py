@@ -1,0 +1,309 @@
+"""The run telemetry object: configuration, lifecycle, and the end-of-run
+summary (``telemetry.json``).
+
+One :class:`Telemetry` exists per training run, configured from the
+``metric.telemetry`` config group and owned by the CLI
+(:func:`sheeprl_tpu.cli.run_algorithm` calls :func:`setup_telemetry` before
+launching and :func:`finalize_telemetry` after). Algorithms and the data
+layer never see the object directly — they use :func:`get_telemetry` (None
+when disabled), the :class:`~sheeprl_tpu.obs.spans.span` scopes, and the
+counter helpers, all of which are no-ops in un-instrumented runs.
+
+On finalize the run's aggregate health is printed and written as
+``telemetry.json`` next to the checkpoint dir (``<log_dir>/telemetry.json``):
+
+========================  ====================================================
+key                       meaning
+========================  ====================================================
+``run_wall_s``            wall seconds between setup and finalize
+``policy_steps``          per-process env steps accounted at log boundaries
+``train_steps``           gradient steps accounted at log boundaries
+``sps``                   policy_steps / run_wall_s (whole-run average)
+``sps_env``               policy_steps / timed env-interaction seconds
+``sps_train``             train_steps / timed train seconds
+``mfu``                   % of ``peak_tflops`` sustained during timed train
+                          seconds (null until an algo registers step FLOPs)
+``bytes_staged_h2d``      bytes shipped host→device through the staging paths
+``h2d_transfers``         number of staged transfers
+``recompiles``            XLA backend compiles observed (jax.monitoring)
+``compile_secs``          seconds spent in backend compilation
+``compile_cache_hits``    persistent-compilation-cache hits
+``peak_hbm_bytes``        peak device ``bytes_in_use`` seen by the poller
+``hbm_bytes_limit``       device memory limit (0 where the runtime hides it)
+``nonfinite_metrics``     NaN/inf values caught by the loss guard
+``stalls``                watchdog stall episodes
+========================  ====================================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from sheeprl_tpu.obs import counters as _counters
+from sheeprl_tpu.obs.health import NonFiniteGuard, StallWatchdog
+from sheeprl_tpu.obs.perf import PEAK_TFLOPS_BF16, mfu_pct
+from sheeprl_tpu.obs.spans import TraceWriter, set_tracer
+
+__all__ = ["Telemetry", "setup_telemetry", "get_telemetry", "finalize_telemetry"]
+
+_ACTIVE: Optional["Telemetry"] = None
+
+
+def get_telemetry() -> Optional["Telemetry"]:
+    """The active run telemetry, or None when disabled."""
+    return _ACTIVE
+
+
+class Telemetry:
+    def __init__(self, tcfg: Optional[Dict[str, Any]] = None):
+        tcfg = dict(tcfg or {})
+        self.cfg = tcfg
+        self.trace_enabled = bool(tcfg.get("trace", True))
+        self.trace_file: Optional[str] = tcfg.get("trace_file") or None
+        self.xla_annotations = bool(tcfg.get("xla_annotations", True))
+        self.poll_interval_s = float(tcfg.get("poll_interval_s", 5.0) or 0.0)
+        self.stall_timeout_s = float(tcfg.get("stall_timeout_s", 120.0) or 0.0)
+        self.summary_enabled = bool(tcfg.get("summary", True))
+        self.summary_path: Optional[str] = tcfg.get("summary_path") or None
+        self.peak_tflops = float(tcfg.get("peak_tflops", PEAK_TFLOPS_BF16))
+
+        self.counters = _counters.Counters()
+        self.tracer: Optional[TraceWriter] = None
+        self.poller: Optional[_counters.DevicePoller] = None
+        self.guard: Optional[NonFiniteGuard] = None
+        self._watchdogs: list[StallWatchdog] = []
+        self._t_start = time.perf_counter()
+        self._finalized = False
+        self._printed_trace_note = False
+
+        # accumulated at log boundaries by perf.log_sps_metrics
+        self.policy_steps = 0
+        self.train_steps = 0
+        self.env_seconds = 0.0
+        self.train_seconds = 0.0
+        self.stage_seconds = 0.0
+        #: FLOPs per *unit of the train-step counter* (which advances by
+        #: world_size per dispatched program): register program_flops /
+        #: world_size so `flops_per_train_step × Δtrain_step` is the
+        #: per-device FLOPs actually executed — the MFU numerator against the
+        #: single-chip `peak_tflops`
+        self.flops_per_train_step: Optional[float] = None
+        self._flops_attempted = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        _counters.install(self.counters)
+        if self.poll_interval_s > 0:
+            self.poller = _counters.DevicePoller(self.poll_interval_s)
+            self.poller.start()
+        guard_cfg = self.cfg.get("health", {}) or {}
+        if bool(guard_cfg.get("nan_guard", True)):
+            self.guard = NonFiniteGuard(
+                prefixes=tuple(guard_cfg.get("nan_guard_prefixes", ("Loss/", "Grads/"))),
+                raise_on_nonfinite=bool(guard_cfg.get("raise_on_nonfinite", False)),
+                counters=self.counters,
+            )
+            from sheeprl_tpu.utils.metric import set_value_guard
+
+            set_value_guard(self.guard)
+        if self.trace_file:  # explicit path: trace from the very beginning
+            self._open_tracer(self.trace_file)
+
+    def _open_tracer(self, path: str) -> None:
+        if self.tracer is not None or not self.trace_enabled:
+            return
+        self.tracer = TraceWriter(path, xla_annotations=self.xla_annotations)
+        set_tracer(self.tracer)
+
+    def attach_run_dir(self, log_dir: str) -> None:
+        """Called once the versioned run directory exists (logger layer)."""
+        if not log_dir:
+            return
+        try:
+            import jax
+
+            if jax.process_index() != 0:
+                return
+        except Exception:
+            pass
+        if self.summary_path is None:
+            self.summary_path = os.path.join(log_dir, "telemetry.json")
+        self._open_tracer(os.path.join(log_dir, "telemetry", "trace.jsonl"))
+
+    def watchdog(self, **kwargs) -> StallWatchdog:
+        """A stall watchdog wired to this run's counters and timeout config.
+
+        The telemetry stops it at finalize; callers still stop it eagerly
+        when their threads exit so a finished run is not flagged."""
+        kwargs.setdefault("timeout_s", self.stall_timeout_s)
+        dog = StallWatchdog(counters=self.counters, **kwargs)
+        self._watchdogs.append(dog)
+        return dog
+
+    # -- run accounting -----------------------------------------------------
+
+    def record_window(
+        self,
+        policy_steps: int = 0,
+        train_steps: int = 0,
+        env_seconds: float = 0.0,
+        train_seconds: float = 0.0,
+        stage_seconds: float = 0.0,
+    ) -> None:
+        self.policy_steps += int(policy_steps)
+        self.train_steps += int(train_steps)
+        self.env_seconds += float(env_seconds)
+        self.train_seconds += float(train_seconds)
+        self.stage_seconds += float(stage_seconds)
+
+    def set_train_flops(self, flops_per_step: Optional[float]) -> None:
+        """Register per-train-step-unit FLOPs (None records the attempt, so a
+        backend without cost analysis is probed once, not every update)."""
+        self._flops_attempted = True
+        if flops_per_step:
+            self.flops_per_train_step = float(flops_per_step)
+
+    def needs_train_flops(self) -> bool:
+        """Should the algorithm spend one AOT cost-analysis on its program?"""
+        return not self._flops_attempted and self.flops_per_train_step is None
+
+    # -- summary ------------------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        wall = time.perf_counter() - self._t_start
+        # no windows were ever accounted (metric.log_level=0 disables the log
+        # boundaries that feed record_window): report null, not a fake 0.0 —
+        # the counters below are still exact
+        accounted = self.policy_steps > 0 or self.train_steps > 0
+        out: Dict[str, Any] = {
+            "run_wall_s": round(wall, 3),
+            "policy_steps": self.policy_steps if accounted else None,
+            "train_steps": self.train_steps if accounted else None,
+            "sps": round(self.policy_steps / wall, 3) if wall > 0 and accounted else None,
+            "sps_env": (
+                round(self.policy_steps / self.env_seconds, 3) if self.env_seconds else None
+            ),
+            "sps_train": (
+                round(self.train_steps / self.train_seconds, 3) if self.train_seconds else None
+            ),
+            "mfu": mfu_pct(
+                self.flops_per_train_step,
+                self.train_steps,
+                self.train_seconds,
+                self.peak_tflops,
+            ),
+            "mfu_peak_tflops": self.peak_tflops,
+            "flops_per_train_step": self.flops_per_train_step,
+            "env_seconds": round(self.env_seconds, 3),
+            "train_seconds": round(self.train_seconds, 3),
+            "stage_seconds": round(self.stage_seconds, 3),
+        }
+        out.update(self.counters.as_dict())
+        out.update(
+            self.poller.snapshot()
+            if self.poller is not None
+            else {"peak_hbm_bytes": 0, "hbm_bytes_limit": 0, "hbm_samples": 0}
+        )
+        if self.tracer is not None:
+            out["trace_file"] = self.tracer.path
+        return out
+
+    def finalize(self, print_summary: bool = True) -> Optional[Dict[str, Any]]:
+        if self._finalized:
+            return None
+        self._finalized = True
+        for dog in self._watchdogs:
+            dog.stop()
+        if self.poller is not None:
+            self.poller.stop()
+        if self.guard is not None:
+            from sheeprl_tpu.utils.metric import set_value_guard
+
+            set_value_guard(None)
+        summary = self.summary()
+        if self.tracer is not None:
+            set_tracer(None)
+            self.tracer.close()
+        _counters.install(None)
+        if self.summary_enabled and self.summary_path:
+            os.makedirs(os.path.dirname(os.path.abspath(self.summary_path)), exist_ok=True)
+            with open(self.summary_path, "w") as f:
+                json.dump(summary, f, indent=2, sort_keys=True)
+                f.write("\n")
+        if print_summary:
+            self._print(summary)
+        return summary
+
+    def _print(self, s: Dict[str, Any]) -> None:
+        try:
+            import jax
+
+            if jax.process_index() != 0:
+                return
+        except Exception:
+            pass
+
+        def fmt_bytes(n):
+            if not n:
+                return "0 B"
+            for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+                if abs(n) < 1024 or unit == "TiB":
+                    return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+                n /= 1024
+
+        steps = (
+            f"policy steps {s['policy_steps']} (sps {s['sps']}) · "
+            f"train steps {s['train_steps']}"
+            + (f" (sps_train {s['sps_train']})" if s["sps_train"] else "")
+            if s["policy_steps"] is not None
+            else "steps not accounted (metric.log_level=0)"
+        )
+        lines = [
+            "── run telemetry "
+            + "─" * 46,
+            f"  wall {s['run_wall_s']:.1f}s · " + steps,
+            f"  staged h2d {fmt_bytes(s['bytes_staged_h2d'])} over "
+            f"{s['h2d_transfers']} transfers · recompiles {s['recompiles']} "
+            f"({s['compile_secs']}s, {s['compile_cache_hits']} cache hits)",
+            f"  peak HBM {fmt_bytes(s['peak_hbm_bytes'])}"
+            + (f" / {fmt_bytes(s['hbm_bytes_limit'])}" if s["hbm_bytes_limit"] else "")
+            + (f" · MFU {s['mfu']}%" if s["mfu"] is not None else "")
+            + f" · non-finite {s['nonfinite_metrics']} · stalls {s['stalls']}",
+        ]
+        if self.summary_enabled and self.summary_path:
+            lines.append(f"  written to {self.summary_path}")
+        if "trace_file" in s:
+            lines.append(f"  trace: {s['trace_file']}")
+        lines.append("─" * 63)
+        print("\n".join(lines), flush=True)
+
+
+def setup_telemetry(cfg) -> Optional[Telemetry]:
+    """Build and activate telemetry from a composed run config (or return
+    None when ``metric.telemetry.enabled`` is off/absent)."""
+    global _ACTIVE
+    tcfg = {}
+    try:
+        tcfg = dict(cfg.metric.get("telemetry", {}) or {})
+    except AttributeError:
+        pass
+    if not tcfg.get("enabled", False):
+        _ACTIVE = None
+        return None
+    telemetry = Telemetry(tcfg)
+    telemetry.start()
+    _ACTIVE = telemetry
+    return telemetry
+
+
+def finalize_telemetry(print_summary: bool = True) -> Optional[Dict[str, Any]]:
+    """Finalize and deactivate the run telemetry (idempotent)."""
+    global _ACTIVE
+    telemetry, _ACTIVE = _ACTIVE, None
+    if telemetry is None:
+        return None
+    return telemetry.finalize(print_summary=print_summary)

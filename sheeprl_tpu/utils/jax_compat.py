@@ -1,0 +1,53 @@
+"""Version portability shims for the jax APIs this framework leans on.
+
+The framework targets the current jax API surface; two symbols it uses moved
+between releases and break older pinned containers:
+
+- ``jax.shard_map`` graduated from ``jax.experimental.shard_map`` (and its
+  replication-check kwarg was renamed ``check_rep`` → ``check_vma`` along the
+  way).
+- ``jax.lax.axis_size`` did not exist before 0.5; under an active named-axis
+  trace the size is available from the axis environment.
+
+Every call site imports from here instead of feature-testing jax inline, so
+the framework runs unmodified on both sides of the rename.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+__all__ = ["shard_map", "axis_size"]
+
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+
+else:  # pre-graduation jax: experimental module, check_rep kwarg
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+        )
+
+
+if hasattr(jax.lax, "axis_size"):
+
+    def axis_size(axis_name: Any) -> int:
+        return jax.lax.axis_size(axis_name)
+
+else:
+
+    def axis_size(axis_name: Any) -> int:
+        """Size of a bound mesh axis (static python int, like jax.lax.axis_size)."""
+        from jax._src import core as _core
+
+        return _core.trace_ctx.axis_env.axis_size(axis_name)

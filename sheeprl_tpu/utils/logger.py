@@ -91,4 +91,12 @@ def create_tensorboard_logger(cfg, exp_name: Optional[str] = None):
     logger = None
     if jax.process_index() == 0 and cfg.metric.log_level > 0:
         logger = TensorBoardLogger(log_dir)
+    # every algorithm resolves its run dir here, so this is where the run
+    # telemetry learns where its trace / telemetry.json belong (no-op when
+    # metric.telemetry is disabled)
+    from sheeprl_tpu.obs.telemetry import get_telemetry
+
+    telemetry = get_telemetry()
+    if telemetry is not None:
+        telemetry.attach_run_dir(log_dir)
     return logger, log_dir

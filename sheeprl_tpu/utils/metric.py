@@ -22,6 +22,18 @@ import jax
 import numpy as np
 
 
+# Run-health hook (obs/health.py NonFiniteGuard): when set, every value
+# entering an aggregator is offered to the guard — the one chokepoint all
+# algorithms log losses through, so NaN/inf detection needs no per-algo code.
+_VALUE_GUARD = None
+
+
+def set_value_guard(guard) -> None:
+    """Install (or with ``None`` remove) the metric value guard."""
+    global _VALUE_GUARD
+    _VALUE_GUARD = guard
+
+
 def _to_scalar(value: Any) -> float:
     """Accept python numbers, numpy scalars, and (possibly device) jax arrays."""
     if hasattr(value, "item"):
@@ -160,6 +172,8 @@ class MetricAggregator:
             if self._raise_on_missing:
                 raise KeyError(f"Metric '{name}' not present in the aggregator")
             return
+        if _VALUE_GUARD is not None:
+            _VALUE_GUARD(name, value)
         metric.update(value, weight)
 
     def reset(self) -> None:

@@ -179,3 +179,45 @@ def test_resume_reapplies_explicit_overrides(tmp_path):
     )
     assert merged2.total_steps == 5000
     assert merged2.algo.update_epochs == stored.algo.update_epochs
+
+
+def test_resume_accounts_for_every_typed_override(tmp_path):
+    """Silently-skipped override classes (group selections, dict-valued keys,
+    ~deletions, bare flags) must be reported in the re-apply warning with a
+    reason, so every typed token is accounted for as re-applied, rejected, or
+    ignored-with-reason (round-5 ADVICE)."""
+    import warnings as _warnings
+
+    import yaml
+
+    from sheeprl_tpu.cli import resume_from_checkpoint
+
+    stored = compose(overrides=["exp=ppo", "total_steps=5000"])
+    log_dir = tmp_path / "run"
+    (log_dir / ".hydra").mkdir(parents=True)
+    (log_dir / "checkpoint").mkdir()
+    (log_dir / ".hydra" / "config.yaml").write_text(yaml.safe_dump(stored.as_dict()))
+    ckpt = log_dir / "checkpoint" / "ckpt_100_0"
+    ckpt.mkdir()
+
+    overrides = [
+        "exp=ppo",                      # defaults-list selection
+        "env=gym",                      # group selection (dict-valued key)
+        "~env.wrapper",                 # deletion
+        f"checkpoint.resume_from={ckpt}",
+        "algo.update_epochs=7",         # genuine leaf re-apply
+    ]
+    cfg = compose(overrides=[o for o in overrides if not o.startswith("~")])
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        merged = resume_from_checkpoint(cfg, overrides)
+    assert merged.algo.update_epochs == 7
+    text = " ".join(str(w.message) for w in caught)
+    assert "re-applied: ['algo.update_epochs=7']" in text
+    assert "ignored 'exp=ppo'" in text and "compose time" in text
+    assert "ignored 'env=gym'" in text and "swap-time semantics" in text
+    assert "ignored '~env.wrapper'" in text and "deletions" in text
+
+    # a typo'd key is still REJECTED loudly, not silently invented
+    with pytest.raises(ValueError, match="absent from"):
+        resume_from_checkpoint(cfg, ["algo.does_not_exist=1"])

@@ -1,0 +1,23 @@
+"""Telemetry tests mutate module-global hooks (active tracer, installed
+counters, metric value guard, the active Telemetry); restore all of them
+around every test so a failure cannot leak instrumentation into the rest of
+the suite."""
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry_globals():
+    yield
+    from sheeprl_tpu.obs import counters as obs_counters
+    from sheeprl_tpu.obs import telemetry as obs_telemetry
+    from sheeprl_tpu.obs.spans import get_tracer, set_tracer
+    from sheeprl_tpu.utils.metric import set_value_guard
+
+    obs_telemetry.finalize_telemetry(print_summary=False)
+    tracer = get_tracer()
+    if tracer is not None:
+        tracer.close()
+    set_tracer(None)
+    obs_counters.install(None)
+    set_value_guard(None)

@@ -1,0 +1,149 @@
+"""Run-health tests: the NaN guard firing through the shared metric path and
+the stall watchdog flagging a deliberately hung fake player thread."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.obs.counters import Counters
+from sheeprl_tpu.obs.health import NonFiniteGuard, StallWatchdog
+from sheeprl_tpu.utils.metric import MeanMetric, MetricAggregator, set_value_guard
+
+
+def test_nan_guard_fires_on_injected_nonfinite_loss():
+    counters = Counters()
+    guard = NonFiniteGuard(counters=counters)
+    set_value_guard(guard)
+    try:
+        aggregator = MetricAggregator(
+            {"Loss/value_loss": MeanMetric(), "Rewards/rew_avg": MeanMetric()}
+        )
+        aggregator.update("Loss/value_loss", 1.0)
+        assert guard.fired == 0
+        with pytest.warns(RuntimeWarning, match="non-finite"):
+            aggregator.update("Loss/value_loss", float("nan"))
+        assert guard.fired == 1
+        assert counters.nonfinite_metrics == 1
+        # warn once per key; later occurrences only count
+        aggregator.update("Loss/value_loss", float("inf"))
+        assert guard.fired == 2
+        # non-guarded prefixes pass through untouched
+        aggregator.update("Rewards/rew_avg", float("nan"))
+        assert guard.fired == 2
+    finally:
+        set_value_guard(None)
+
+
+def test_nan_guard_accepts_numpy_and_respects_raise():
+    guard = NonFiniteGuard(raise_on_nonfinite=True)
+    guard("Loss/x", np.float32(3.0))
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(FloatingPointError):
+            guard("Loss/x", np.float32("inf"))
+
+
+def test_stall_watchdog_triggers_on_hung_player():
+    counters = Counters()
+    stalled = []
+    watchdog = StallWatchdog(
+        timeout_s=0.2,
+        poll_s=0.05,
+        on_stall=lambda role, age: stalled.append(role),
+        counters=counters,
+        warmup_factor=1.0,  # no cold-start grace: flag on the first hang
+    )
+    watchdog.register("player")
+    watchdog.register("trainer")
+
+    def player():  # wedged: beats once, then hangs well past the timeout
+        watchdog.beat("player")
+        time.sleep(10)
+
+    def trainer():  # healthy: beats until told to stop
+        while not trainer_stop.is_set():
+            watchdog.beat("trainer")
+            time.sleep(0.02)
+
+    trainer_stop = threading.Event()
+    threads = [
+        threading.Thread(target=player, daemon=True),
+        threading.Thread(target=trainer, daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    watchdog.start()
+    try:
+        deadline = time.monotonic() + 5
+        with pytest.warns(RuntimeWarning, match="'player' has not made progress"):
+            while not stalled and time.monotonic() < deadline:
+                time.sleep(0.05)
+            watchdog.check()  # deterministic final pass inside the warns block
+        assert stalled == ["player"]
+        assert watchdog.stalled_roles == ["player"]
+        assert counters.stalls == 1
+    finally:
+        trainer_stop.set()
+        watchdog.stop()
+
+
+def test_stall_watchdog_warmup_grace_covers_first_iteration():
+    """Until a role has beaten twice (one full iteration, i.e. past the cold
+    XLA compiles), the threshold is timeout_s x warmup_factor — a slow first
+    step must not be reported as a stall."""
+    watchdog = StallWatchdog(timeout_s=0.05, poll_s=10, warmup_factor=100.0)
+    watchdog.register("player")
+    watchdog.beat("player")  # first beat: still warming up
+    time.sleep(0.08)  # past timeout_s, inside the warmup allowance
+    watchdog.check()
+    assert watchdog.stall_events == []
+    watchdog.beat("player")  # second beat: armed at the normal threshold
+    time.sleep(0.08)
+    with pytest.warns(RuntimeWarning):
+        watchdog.check()
+    assert len(watchdog.stall_events) == 1
+
+
+def test_stall_watchdog_rearms_after_recovery():
+    # manual check()s; warmup_factor=1 so the first interval is armed
+    watchdog = StallWatchdog(timeout_s=0.05, poll_s=10, warmup_factor=1.0)
+    watchdog.register("player")
+    time.sleep(0.08)
+    with pytest.warns(RuntimeWarning):
+        watchdog.check()
+    assert len(watchdog.stall_events) == 1
+    watchdog.check()  # still stalled: flagged once per episode, no re-warn
+    assert len(watchdog.stall_events) == 1
+    watchdog.beat("player")  # recovery re-arms
+    time.sleep(0.08)
+    with pytest.warns(RuntimeWarning):
+        watchdog.check()
+    assert len(watchdog.stall_events) == 2
+
+
+def test_stall_watchdog_unregister_silences_finished_role():
+    watchdog = StallWatchdog(timeout_s=0.05, poll_s=10, warmup_factor=1.0)
+    watchdog.register("player")
+    watchdog.unregister("player")
+    time.sleep(0.08)
+    watchdog.check()  # must not warn
+    assert watchdog.stall_events == []
+
+
+def test_stall_watchdog_pause_suspends_monitoring():
+    """A role blocked on the player<->trainer exchange pauses itself; waiting
+    for the peer is idleness, not a stall. beat()/resume() re-arm it."""
+    watchdog = StallWatchdog(timeout_s=0.05, poll_s=10, warmup_factor=1.0)
+    watchdog.register("player")
+    watchdog.pause("player")
+    time.sleep(0.08)
+    watchdog.check()  # paused: must not flag
+    assert watchdog.stall_events == []
+    watchdog.resume("player")  # resumes with a fresh baseline
+    watchdog.check()
+    assert watchdog.stall_events == []
+    time.sleep(0.08)  # now genuinely idle past the timeout
+    with pytest.warns(RuntimeWarning):
+        watchdog.check()
+    assert len(watchdog.stall_events) == 1

@@ -1,0 +1,132 @@
+"""Span/trace-writer unit tests: JSONL schema round-trip and the
+timer-registry layering (including the concurrent-reset path the decoupled
+algorithms exercise, utils/timer.py:10-13)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from sheeprl_tpu.obs.spans import TraceWriter, set_tracer, span
+from sheeprl_tpu.utils.metric import SumMetric
+from sheeprl_tpu.utils.timer import timer
+
+
+@pytest.fixture(autouse=True)
+def _clean_timer_registry():
+    timer.reset()
+    yield
+    timer.reset()
+
+
+def _read_events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_trace_jsonl_schema_round_trip(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    writer = TraceWriter(path, xla_annotations=False)
+    set_tracer(writer)
+    try:
+        with span("Time/env_interaction_time", phase="env"):
+            time.sleep(0.01)
+        with span("Time/train_time", phase="train"):
+            pass
+        writer.counter("hbm_bytes_in_use", {"0": 123.0})
+        writer.instant("stall", args={"role": "player"})
+    finally:
+        set_tracer(None)
+        writer.close()
+
+    events = _read_events(path)
+    complete = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {
+        "Time/env_interaction_time",
+        "Time/train_time",
+    }
+    for e in complete:
+        # the complete-event subset of the Chrome trace-event format
+        assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid", "tid"}
+        assert e["dur"] >= 0 and e["ts"] >= 0
+    env = next(e for e in complete if e["cat"] == "env")
+    assert env["dur"] >= 10_000 * 0.5  # slept 10ms, µs scale
+    assert any(e["ph"] == "C" and e["args"] == {"0": 123.0} for e in events)
+    assert any(e["ph"] == "i" and e["name"] == "stall" for e in events)
+    # thread-name metadata emitted once per thread
+    assert sum(e["ph"] == "M" for e in events) == 1
+
+
+def test_span_accumulates_into_timer_registry(tmp_path):
+    writer = TraceWriter(str(tmp_path / "t.jsonl"), xla_annotations=False)
+    set_tracer(writer)
+    try:
+        with span("Time/train_time", SumMetric(sync_on_compute=False), phase="train"):
+            time.sleep(0.005)
+    finally:
+        set_tracer(None)
+        writer.close()
+    computed = timer.compute()
+    assert computed["Time/train_time"] >= 0.004
+
+
+def test_span_without_tracer_is_plain_timer():
+    with span("Time/train_time"):
+        pass
+    assert "Time/train_time" in timer.compute()
+
+
+def test_span_survives_concurrent_registry_reset(tmp_path):
+    """The decoupled player times env interaction while the trainer calls
+    ``timer.compute()``; a span whose registry entry vanished mid-scope must
+    re-register on exit instead of raising (utils/timer.py:10-13)."""
+    writer = TraceWriter(str(tmp_path / "t.jsonl"), xla_annotations=False)
+    set_tracer(writer)
+    entered = threading.Event()
+    release = threading.Event()
+    errors = []
+
+    def scoped():
+        try:
+            with span("Time/env_interaction_time", phase="env"):
+                entered.set()
+                release.wait(timeout=5)
+        except Exception as exc:  # pragma: no cover - the regression itself
+            errors.append(exc)
+
+    worker = threading.Thread(target=scoped)
+    worker.start()
+    try:
+        assert entered.wait(timeout=5)
+        timer.compute()  # concurrent reset: wipes the in-flight scope's entry
+        release.set()
+        worker.join(timeout=5)
+        assert not errors
+        # the scope re-registered and recorded its elapsed time
+        assert timer.compute()["Time/env_interaction_time"] > 0
+    finally:
+        set_tracer(None)
+        writer.close()
+    events = _read_events(writer.path)
+    assert any(
+        e["ph"] == "X" and e["name"] == "Time/env_interaction_time" for e in events
+    )
+
+
+def test_disabled_timer_still_emits_trace_events(tmp_path):
+    """metric.log_level=0 disables the rate timers, but an active tracer
+    (telemetry explicitly on) still sees the phases."""
+    writer = TraceWriter(str(tmp_path / "t.jsonl"), xla_annotations=False)
+    set_tracer(writer)
+    timer.disabled = True
+    try:
+        with span("Time/train_time", phase="train"):
+            pass
+    finally:
+        timer.disabled = False
+        set_tracer(None)
+        writer.close()
+    assert timer.compute() == {}
+    events = _read_events(writer.path)
+    assert any(e.get("name") == "Time/train_time" for e in events)

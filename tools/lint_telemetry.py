@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Telemetry-uniformity lint: every algorithm entrypoint must log its rate
+gauges through the shared plumbing.
+
+The ``Time/sps_*`` / ``Perf/mfu`` computation lives exactly once, in
+``sheeprl_tpu/obs/perf.py`` (``log_sps_metrics``); before it existed the same
+block was copy-pasted across all 17 entrypoints and had already drifted. This
+lint fails when a file under ``sheeprl_tpu/algos/`` re-grows its own copy:
+
+- a ``"Time/sps_..."`` or ``"Perf/mfu"`` string literal (hand-rolled gauge);
+- a ``timer.compute()`` / ``timer.reset()`` call (private registry drain —
+  the shared helper owns the read-and-reset cycle);
+- a ``with timer(...)`` scope (use ``obs.span`` so the phase also reaches the
+  trace timeline and XLA profiles).
+
+AST-based, so comments and docstrings mentioning the metric names are fine.
+
+Usage: ``python tools/lint_telemetry.py`` — exits non-zero with a findings
+list on violation. Wired into the CI tier-1 lane (.github/workflows/tests.yml).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
+
+FORBIDDEN_LITERAL_PREFIXES = ("Time/sps_", "Perf/mfu")
+FORBIDDEN_TIMER_CALLS = ("compute", "reset")
+
+
+def _docstring_nodes(tree: ast.AST) -> set:
+    """Constant nodes that are docstrings (allowed to mention metric names)."""
+    allowed = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and isinstance(body[0].value, ast.Constant):
+                allowed.add(id(body[0].value))
+    return allowed
+
+
+def lint_file(path: str) -> list:
+    src = open(path).read()
+    tree = ast.parse(src, filename=path)
+    docstrings = _docstring_nodes(tree)
+    findings = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and id(node) not in docstrings
+            and node.value.startswith(FORBIDDEN_LITERAL_PREFIXES)
+        ):
+            findings.append(
+                (node.lineno,
+                 f"hand-rolled {node.value!r} gauge — log rates through "
+                 "sheeprl_tpu.obs.log_sps_metrics")
+            )
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "timer"
+                and fn.attr in FORBIDDEN_TIMER_CALLS
+            ):
+                findings.append(
+                    (node.lineno,
+                     f"timer.{fn.attr}() drains the shared registry — "
+                     "log_sps_metrics owns the read-and-reset cycle")
+                )
+            if isinstance(fn, ast.Name) and fn.id == "timer":
+                findings.append(
+                    (node.lineno,
+                     "raw timer(...) scope — use sheeprl_tpu.obs.span so the "
+                     "phase reaches the trace timeline and XLA profiles")
+                )
+    return findings
+
+
+def main() -> int:
+    failures = []
+    for root, _dirs, files in os.walk(ALGOS_DIR):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            for lineno, message in lint_file(path):
+                failures.append(f"{os.path.relpath(path, REPO)}:{lineno}: {message}")
+    if failures:
+        print("telemetry-uniformity lint FAILED:")
+        for f in failures:
+            print(f"  {f}")
+        print(
+            f"\n{len(failures)} finding(s). Algorithm entrypoints must go "
+            "through the shared telemetry plumbing (sheeprl_tpu/obs/perf.py)."
+        )
+        return 1
+    print("telemetry-uniformity lint OK (all entrypoints use the shared plumbing)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
