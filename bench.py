@@ -264,6 +264,13 @@ def _ppo_line() -> str:
                 "compile_secs",
                 "compile_cache_hits",
                 "peak_hbm_bytes",
+                # checkpoint stall on the step path (ckpt subsystem): the
+                # bench protocol runs with checkpoints effectively off, so
+                # this stays ~0 — it is here so any future regression that
+                # re-introduces step-path checkpoint cost shows in the
+                # headline trajectory
+                "ckpt_blocked_ms",
+                "ckpt_saves",
             )
         }
         line = json.dumps(data)

@@ -33,6 +33,7 @@ from sheeprl_tpu.algos.ppo.agent import (
 )
 from sheeprl_tpu.algos.ppo.ppo import make_vector_env
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
+from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -241,11 +242,7 @@ def main(fabric, cfg: Dict[str, Any]):
             f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
             f"policy_steps_per_update value ({policy_steps_per_update})."
         )
-    if cfg.checkpoint.every % policy_steps_per_update != 0:
-        warnings.warn(
-            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
-            f"policy_steps_per_update value ({policy_steps_per_update})."
-        )
+    warn_checkpoint_rounding(cfg, policy_steps_per_update)
 
     obs = envs.reset(seed=cfg.seed)[0]
     next_obs = prepare_obs(obs, cnn_keys, n_envs)
@@ -359,9 +356,7 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            update == num_updates and cfg.checkpoint.save_last
-        ):
+        if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
             last_checkpoint = policy_step
             ckpt_state = {
                 "params": jax.device_get(params),
@@ -378,7 +373,11 @@ def main(fabric, cfg: Dict[str, Any]):
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
             )
+            if preemption_requested():
+                # SIGTERM/SIGINT: the final checkpoint is saved (the CLI
+                # drains the in-flight write) — leave the train loop cleanly
+                break
 
     envs.close()
-    if fabric.is_global_zero and cfg.algo.get("run_test", True):
+    if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         test(agent, jax.device_get(params), fabric, cfg, log_dir)

@@ -54,6 +54,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     test,
     update_moments,
 )
+from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
@@ -740,13 +741,7 @@ def main(fabric, cfg: Dict[str, Any]):
             "the metrics will be logged at the nearest greater multiple of the "
             "policy_steps_per_update value."
         )
-    if cfg.checkpoint.every % policy_steps_per_update != 0:
-        warnings.warn(
-            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
-            f"policy_steps_per_update value ({policy_steps_per_update}), so "
-            "the checkpoint will be saved at the nearest greater multiple of the "
-            "policy_steps_per_update value."
-        )
+    warn_checkpoint_rounding(cfg, policy_steps_per_update)
 
     # Data sharding for the train batch [T, B_total, ...]
     burst_sharding = fabric.sharding(None, None, fabric.data_axis)
@@ -1172,9 +1167,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 trace_acc.clear()
 
         # Checkpoint (reference main :803-830)
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            update == num_updates and cfg.checkpoint.save_last
-        ):
+        if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": jax.device_get(agent_state),
@@ -1192,7 +1185,11 @@ def main(fabric, cfg: Dict[str, Any]):
                     state=ckpt_state,
                     replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
                 )
+            if preemption_requested():
+                # SIGTERM/SIGINT: the final checkpoint is saved (the CLI
+                # drains the in-flight write) — leave the train loop cleanly
+                break
 
     envs.close()
-    if fabric.is_global_zero and cfg.algo.get("run_test", True):
+    if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         test(player_fns, jax.device_get(agent_state["params"]), fabric, cfg, log_dir, sample_actions=True)

@@ -19,6 +19,7 @@ import numpy as np
 from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import build_train_fn
 from sheeprl_tpu.algos.dreamer_v1.utils import normalize_obs_jnp, prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv1.agent import build_agent, build_player_fns
+from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
@@ -213,6 +214,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
             f"policy_steps_per_update value ({policy_steps_per_update})."
         )
+    warn_checkpoint_rounding(cfg, policy_steps_per_update)
 
     data_sharding = fabric.sharding(None, fabric.data_axis)
 
@@ -381,9 +383,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            update == num_updates and cfg.checkpoint.save_last
-        ):
+        if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": jax.device_get(agent_state),
@@ -401,9 +401,13 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
             )
+            if preemption_requested():
+                # SIGTERM/SIGINT: the final checkpoint is saved (the CLI
+                # drains the in-flight write) — leave the train loop cleanly
+                break
 
     envs.close()
-    if fabric.is_global_zero and cfg.algo.get("run_test", True):
+    if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         final = jax.device_get(agent_state["params"])
         test(
             player_fns,

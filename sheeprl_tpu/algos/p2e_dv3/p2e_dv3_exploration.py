@@ -55,6 +55,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     update_moments,
 )
 from sheeprl_tpu.algos.p2e_dv3.agent import apply_ensemble, build_agent, build_player_fns
+from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
@@ -688,6 +689,7 @@ def main(fabric, cfg: Dict[str, Any]):
             f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
             f"policy_steps_per_update value ({policy_steps_per_update})."
         )
+    warn_checkpoint_rounding(cfg, policy_steps_per_update)
 
     data_sharding = fabric.sharding(None, fabric.data_axis)
 
@@ -874,9 +876,7 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            update == num_updates and cfg.checkpoint.save_last
-        ):
+        if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": jax.device_get(agent_state),
@@ -893,10 +893,14 @@ def main(fabric, cfg: Dict[str, Any]):
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
             )
+            if preemption_requested():
+                # SIGTERM/SIGINT: the final checkpoint is saved (the CLI
+                # drains the in-flight write) — leave the train loop cleanly
+                break
 
     envs.close()
     # Final greedy test runs the *task* policy (reference main :1124)
-    if fabric.is_global_zero and cfg.algo.get("run_test", True):
+    if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         final = jax.device_get(agent_state["params"])
         test(
             player_fns,

@@ -41,6 +41,7 @@ import numpy as np
 from sheeprl_tpu.algos.ppo.agent import build_agent, sample_actions
 from sheeprl_tpu.algos.ppo.ppo import build_update_fn, make_vector_env
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
+from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.obs import (
     count_h2d,
@@ -172,11 +173,7 @@ def main(fabric, cfg: Dict[str, Any]):
             f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
             f"policy_steps_per_update value ({policy_steps_per_update})."
         )
-    if cfg.checkpoint.every % policy_steps_per_update != 0:
-        warnings.warn(
-            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
-            f"policy_steps_per_update value ({policy_steps_per_update})."
-        )
+    warn_checkpoint_rounding(cfg, policy_steps_per_update)
 
     # ------------------------------------------------------------------
     # the player thread (reference player(), :33-346)
@@ -413,9 +410,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     update, initial=initial_ent_coef, final=0.0, max_decay_steps=num_updates, power=1.0
                 )
 
-            if (
-                cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
-            ) or (update == num_updates and cfg.checkpoint.save_last):
+            if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
                 last_checkpoint = policy_step
                 ckpt_state = {
                     "params": jax.device_get(params),
@@ -430,6 +425,10 @@ def main(fabric, cfg: Dict[str, Any]):
                 )
                 with span("Time/checkpoint_time", phase="checkpoint"):
                     fabric.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state)
+                if preemption_requested():
+                    # SIGTERM/SIGINT: the final checkpoint is saved (the CLI
+                    # drains the in-flight write) — leave the train loop cleanly
+                    break
     finally:
         stop.set()
         try:  # unblock a player waiting on the full queue
@@ -441,5 +440,5 @@ def main(fabric, cfg: Dict[str, Any]):
             watchdog.stop()
 
     envs.close()
-    if fabric.is_global_zero and cfg.algo.get("run_test", True):
+    if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         test(agent, jax.device_get(params), fabric, cfg, log_dir)

@@ -35,6 +35,7 @@ from sheeprl_tpu.algos.sac.agent import (
 )
 from sheeprl_tpu.algos.sac.sac import build_train_fn
 from sheeprl_tpu.algos.sac.utils import concat_obs, test
+from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -172,6 +173,7 @@ def main(fabric, cfg: Dict[str, Any]):
     last_log = int(np.asarray(state["last_log"])) if state is not None else 0
     last_checkpoint = int(np.asarray(state["last_checkpoint"])) if state is not None else 0
     policy_steps_per_update = int(n_envs)
+    warn_checkpoint_rounding(cfg, policy_steps_per_update)
     num_updates = int(cfg.total_steps // policy_steps_per_update) if not cfg.dry_run else 1
     learning_starts = cfg.algo.learning_starts // policy_steps_per_update if not cfg.dry_run else 0
     if cfg.checkpoint.resume_from and not cfg.buffer.get("checkpoint", False):
@@ -364,9 +366,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 last_log = policy_step
                 last_train = train_step
 
-            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-                update == num_updates and cfg.checkpoint.save_last
-            ):
+            if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
                 last_checkpoint = policy_step
                 ckpt_state = {
                     "agent": jax.device_get(agent_state),
@@ -385,6 +385,10 @@ def main(fabric, cfg: Dict[str, Any]):
                         state=ckpt_state,
                         replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
                     )
+                if preemption_requested():
+                    # SIGTERM/SIGINT: the final checkpoint is saved (the CLI
+                    # drains the in-flight write) — leave the train loop cleanly
+                    break
 
             # release the player for the next step (bounded one-step lead)
             with step_cv:
@@ -399,5 +403,5 @@ def main(fabric, cfg: Dict[str, Any]):
             watchdog.stop()
         envs.close()
 
-    if fabric.is_global_zero and cfg.algo.get("run_test", True):
+    if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         test(actor, agent_state["actor"], scale_j, bias_j, fabric, cfg, log_dir)

@@ -32,6 +32,7 @@ from sheeprl_tpu.algos.sac.agent import action_bounds, squash_sample
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac_ae.agent import build_agent, ensemble_q, preprocess_obs
 from sheeprl_tpu.algos.sac_ae.utils import normalize_obs_jnp, prepare_obs, test
+from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -396,11 +397,7 @@ def main(fabric, cfg: Dict[str, Any]):
             f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
             f"policy_steps_per_update value ({policy_steps_per_update})."
         )
-    if cfg.checkpoint.every % policy_steps_per_update != 0:
-        warnings.warn(
-            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
-            f"policy_steps_per_update value ({policy_steps_per_update})."
-        )
+    warn_checkpoint_rounding(cfg, policy_steps_per_update)
 
     o = envs.reset(seed=cfg.seed)[0]
     obs = prepare_obs(o, cnn_keys, mlp_keys, n_envs)
@@ -522,9 +519,7 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            update == num_updates and cfg.checkpoint.save_last
-        ):
+        if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": jax.device_get(agent_state),
@@ -541,9 +536,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
             )
+            if preemption_requested():
+                # SIGTERM/SIGINT: the final checkpoint is saved (the CLI
+                # drains the in-flight write) — leave the train loop cleanly
+                break
 
     envs.close()
-    if fabric.is_global_zero and cfg.algo.get("run_test", True):
+    if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         test(
             encoder, actor_trunk, jax.device_get(agent_state), scale_j, bias_j,
             fabric, cfg, log_dir,

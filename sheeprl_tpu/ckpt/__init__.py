@@ -1,0 +1,81 @@
+"""Fault-tolerant checkpoint subsystem.
+
+Replaces the inline synchronous ``fabric.save`` (orbax pickle) that used to
+run inside every train loop with a production-style checkpoint pipeline
+(t5x/Orbax async checkpointing; Check-N-Run's snapshot/persist split):
+
+- :mod:`~sheeprl_tpu.ckpt.saver` — the step path only snapshots the state
+  pytree to host (``jax.device_get``); serialization and disk writes happen
+  on a background thread with double-buffering (at most one save in flight,
+  a new request waits instead of stacking), a bounded-retry/backoff wrapper
+  around filesystem errors, and a degrade-to-synchronous fallback;
+- :mod:`~sheeprl_tpu.ckpt.manifest` + :mod:`~sheeprl_tpu.ckpt.writer` — an
+  atomic, verified on-disk layout: everything is written into
+  ``ckpt_<step>.tmp/`` (npz shards, per-env replay-buffer shards, then a
+  manifest with per-array shapes/dtypes/checksums, config hash and schema
+  version, all fsynced) and renamed to final last, so a killed writer can
+  never produce a checkpoint that resume will half-load;
+- :mod:`~sheeprl_tpu.ckpt.preemption` — SIGTERM/SIGINT (the TPU preemption
+  notice) requests an immediate final checkpoint from the train loop, the
+  in-flight save is drained, and the run exits cleanly;
+- :mod:`~sheeprl_tpu.ckpt.resume` — ``checkpoint.resume_from=latest``
+  resolves the newest *manifest-valid* checkpoint in the run dir (skipping
+  ``.tmp`` partials and corrupt manifests) and checksums arrays before the
+  state reaches the algorithms' resume path.
+
+Algorithms keep dispatching through ``fabric.call("on_checkpoint_*")`` — the
+:class:`~sheeprl_tpu.utils.callback.CheckpointCallback` routes into the
+:class:`~sheeprl_tpu.ckpt.manager.CheckpointManager` configured here by the
+CLI (``checkpoint.async_save`` / ``checkpoint.keep_last`` /
+``checkpoint.write_retries`` / ``checkpoint.write_backoff_s``). Keep-policy
+GC lives on the manager's writer thread, serialized with the writes it could
+otherwise race. The step-path cost of every save is visible in telemetry as
+the ``ckpt_blocked_ms`` / ``ckpt_write_ms`` / ``ckpt_bytes`` counters
+(``sheeprl_tpu/obs/``).
+"""
+
+from sheeprl_tpu.ckpt.manager import (
+    CheckpointManager,
+    get_checkpoint_manager,
+    setup_checkpoint,
+    should_checkpoint,
+    teardown_checkpoint,
+    warn_checkpoint_rounding,
+)
+from sheeprl_tpu.ckpt.manifest import (
+    SCHEMA_VERSION,
+    CheckpointCorruptedError,
+)
+from sheeprl_tpu.ckpt.preemption import (
+    install_preemption_handlers,
+    preemption_requested,
+    reset_preemption,
+    uninstall_preemption_handlers,
+)
+from sheeprl_tpu.ckpt.resume import (
+    is_manifest_checkpoint,
+    read_checkpoint,
+    resolve_latest,
+    resolve_resume_from,
+    validate_checkpoint,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "CheckpointCorruptedError",
+    "CheckpointManager",
+    "get_checkpoint_manager",
+    "install_preemption_handlers",
+    "is_manifest_checkpoint",
+    "preemption_requested",
+    "read_checkpoint",
+    "reset_preemption",
+    "resolve_latest",
+    "resolve_resume_from",
+    "setup_checkpoint",
+    "should_checkpoint",
+    "teardown_checkpoint",
+    "uninstall_preemption_handlers",
+    "validate_checkpoint",
+    "warn_checkpoint_rounding",
+]

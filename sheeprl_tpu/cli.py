@@ -298,7 +298,14 @@ def run_algorithm(cfg) -> None:
     # by create_tensorboard_logger once the versioned path exists.
     from sheeprl_tpu.obs.telemetry import finalize_telemetry, setup_telemetry
 
+    # Checkpoint subsystem (checkpoint config group, ckpt/): async saver,
+    # keep-policy GC, SIGTERM/SIGINT preemption capture. Torn down in the
+    # same finally so an in-flight async save is drained before the process
+    # exits (and before telemetry finalizes, so its counters are complete).
+    from sheeprl_tpu.ckpt import setup_checkpoint, teardown_checkpoint
+
     setup_telemetry(cfg)
+    setup_checkpoint(cfg)
     try:
         # jax.profiler trace capture around the whole run (SURVEY §5.1 — the
         # TPU superset of the reference's named-scope timers)
@@ -319,6 +326,7 @@ def run_algorithm(cfg) -> None:
 
         fabric.launch(entrypoint, cfg, **kwargs)
     finally:
+        teardown_checkpoint()
         finalize_telemetry()
 
 
@@ -375,6 +383,11 @@ def run(args: Optional[Sequence[str]] = None) -> None:
         init_distributed()
     sheeprl_tpu.register_algorithms()
     if cfg.checkpoint.resume_from:
+        # `latest` (or a run-dir path) resolves to the newest manifest-valid
+        # checkpoint BEFORE the config merge, which needs a concrete path
+        from sheeprl_tpu.ckpt import resolve_resume_from
+
+        cfg.checkpoint.resume_from = resolve_resume_from(cfg)
         cfg = resume_from_checkpoint(cfg, overrides)
     # print AFTER the resume merge so the tree shown is the effective config
     # (printing pre-merge showed override values the merge then discarded)

@@ -17,8 +17,11 @@ design is SPMD-first instead of process-per-rank:
   checkpoint ownership, video capture). Per-rank batch/env counts from the
   reference configs are interpreted per-device, preserving the step-accounting
   contract (``howto/work_with_steps.md``).
-- ``fabric.save/load`` checkpoints a single pytree via Orbax (async-capable);
-  ``fabric.call(hook)`` dispatches to callbacks (reference callback.py).
+- ``fabric.load`` restores both the ``sheeprl_tpu/ckpt`` manifest layout
+  (checksum-verified npz shards) and legacy Orbax pytree checkpoints;
+  ``fabric.save`` remains the legacy synchronous Orbax writer — train loops
+  checkpoint through ``fabric.call("on_checkpoint_*")``, which routes into
+  the async, atomic checkpoint subsystem (reference callback.py).
 """
 
 from __future__ import annotations
@@ -347,16 +350,24 @@ class Fabric:
     def load(self, path: str, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Restore a checkpoint pytree (reference fabric.load semantics).
 
-        With ``state`` given, the raw restore is conformed to its structure
-        (NamedTuple optimizer states rebuilt, extra on-disk keys like the
-        optional replay-buffer snapshot kept raw at top level)."""
-        import orbax.checkpoint as ocp
-
+        Manifest-format checkpoints (the ``sheeprl_tpu.ckpt`` subsystem's
+        atomic npz layout) are read with per-array checksum verification;
+        legacy orbax directories restore as before. With ``state`` given,
+        the raw restore is conformed to its structure (NamedTuple optimizer
+        states rebuilt, extra on-disk keys like the optional replay-buffer
+        snapshot kept raw at top level)."""
         from sheeprl_tpu.utils.utils import conform_pytree, migrate_legacy_checkpoint
 
         path = os.path.abspath(path)
-        with ocp.PyTreeCheckpointer() as ckptr:
-            restored = ckptr.restore(path)
+        from sheeprl_tpu.ckpt.resume import is_manifest_checkpoint, read_checkpoint
+
+        if is_manifest_checkpoint(path):
+            restored = read_checkpoint(path, rank=self.global_rank)
+        else:
+            import orbax.checkpoint as ocp
+
+            with ocp.PyTreeCheckpointer() as ckptr:
+                restored = ckptr.restore(path)
         if state is not None:
             restored = migrate_legacy_checkpoint(state, restored)
             out = conform_pytree(state, restored)

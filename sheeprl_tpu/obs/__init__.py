@@ -23,6 +23,8 @@ defaults to off; disabled, the instrumented code paths reduce to the plain
 from sheeprl_tpu.obs.counters import (
     Counters,
     DevicePoller,
+    add_ckpt_blocked_ms,
+    add_ckpt_write,
     add_h2d_bytes,
     count_h2d,
     device_memory_stats,
@@ -54,6 +56,8 @@ __all__ = [
     "StallWatchdog",
     "Telemetry",
     "TraceWriter",
+    "add_ckpt_blocked_ms",
+    "add_ckpt_write",
     "add_h2d_bytes",
     "count_h2d",
     "cost_flops",

@@ -33,6 +33,8 @@ import numpy as np
 
 __all__ = [
     "Counters",
+    "add_ckpt_blocked_ms",
+    "add_ckpt_write",
     "add_h2d_bytes",
     "device_memory_stats",
     "DevicePoller",
@@ -61,6 +63,11 @@ class Counters:
         self.compile_cache_hits = 0
         self.nonfinite_metrics = 0
         self.stalls = 0
+        self.ckpt_blocked_ms = 0.0
+        self.ckpt_write_ms = 0.0
+        self.ckpt_bytes = 0
+        self.ckpt_saves = 0
+        self.ckpt_failures = 0
 
     def add(self, field: str, amount) -> None:
         with self._lock:
@@ -76,6 +83,11 @@ class Counters:
                 "compile_cache_hits": self.compile_cache_hits,
                 "nonfinite_metrics": self.nonfinite_metrics,
                 "stalls": self.stalls,
+                "ckpt_blocked_ms": round(self.ckpt_blocked_ms, 1),
+                "ckpt_write_ms": round(self.ckpt_write_ms, 1),
+                "ckpt_bytes": self.ckpt_bytes,
+                "ckpt_saves": self.ckpt_saves,
+                "ckpt_failures": self.ckpt_failures,
             }
 
 
@@ -148,6 +160,31 @@ def staged_device_put(data: Any, device: Any):
         out = jax.device_put(data, device)
     add_h2d_bytes(nbytes)
     return out
+
+
+# -- checkpoint accounting --------------------------------------------------
+
+
+def add_ckpt_blocked_ms(ms: float) -> None:
+    """Record wall milliseconds a train step spent blocked on a checkpoint
+    (host snapshot + waiting out the previous in-flight save)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.ckpt_blocked_ms += float(ms)
+
+
+def add_ckpt_write(ms: float, nbytes: int, failed: bool = False) -> None:
+    """Record one checkpoint write (writer-thread time + bytes landed)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.ckpt_write_ms += float(ms)
+            c.ckpt_bytes += int(nbytes)
+            if failed:
+                c.ckpt_failures += 1
+            else:
+                c.ckpt_saves += 1
 
 
 # -- recompile accounting ---------------------------------------------------

@@ -32,6 +32,12 @@ key                       meaning
 ``hbm_bytes_limit``       device memory limit (0 where the runtime hides it)
 ``nonfinite_metrics``     NaN/inf values caught by the loss guard
 ``stalls``                watchdog stall episodes
+``ckpt_blocked_ms``       train-step wall ms blocked on checkpoints (host
+                          snapshot + double-buffer wait — the step-path cost)
+``ckpt_write_ms``         writer-thread ms spent serializing/fsyncing saves
+``ckpt_bytes``            checkpoint bytes landed on disk
+``ckpt_saves``            completed checkpoint writes
+``ckpt_failures``         writes that exhausted their retry budget
 ========================  ====================================================
 """
 
@@ -274,6 +280,13 @@ class Telemetry:
             + (f" · MFU {s['mfu']}%" if s["mfu"] is not None else "")
             + f" · non-finite {s['nonfinite_metrics']} · stalls {s['stalls']}",
         ]
+        if s["ckpt_saves"] or s["ckpt_failures"]:
+            lines.append(
+                f"  ckpt {s['ckpt_saves']} saves ({fmt_bytes(s['ckpt_bytes'])}), "
+                f"step path blocked {s['ckpt_blocked_ms']:.0f} ms of "
+                f"{s['ckpt_write_ms']:.0f} ms write time"
+                + (f" · {s['ckpt_failures']} FAILED" if s["ckpt_failures"] else "")
+            )
         if self.summary_enabled and self.summary_path:
             lines.append(f"  written to {self.summary_path}")
         if "trace_file" in s:

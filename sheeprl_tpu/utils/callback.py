@@ -2,24 +2,32 @@
 
 Reference behavior (``sheeprl/utils/callback.py:10-92``): dispatched via
 ``fabric.call("on_checkpoint_{coupled|player|trainer}")``; optionally embeds
-the replay-buffer state with the last stored ``dones`` forced to 1 so the
-in-progress episode terminates cleanly on restore (callback.py:32-40,59-64),
-and prunes old checkpoints. Buffers are host-side numpy, so each process saves
-its own buffer state alongside the (replicated) model pytree.
+the replay-buffer state with the last stored terminal flags forced to 1 so
+the in-progress episode terminates cleanly on restore (callback.py:32-40,
+59-64 — applied to ``dones`` AND the gymnasium five-tuple ``terminated`` /
+``truncated`` keys, so both termination paths end restored episodes).
+
+Persistence itself is the :mod:`sheeprl_tpu.ckpt` subsystem's job: the hooks
+snapshot the buffer state on the step path and hand everything to the run's
+:class:`~sheeprl_tpu.ckpt.manager.CheckpointManager` (async double-buffered
+writes, atomic manifest layout, keep-policy GC on the writer thread — which
+is also where the old ``_prune`` moved, so GC can no longer race an
+in-flight async write).
 """
 
 from __future__ import annotations
 
-import glob
-import os
-import re
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 
 class CheckpointCallback:
-    """Saves `state` (a pytree of arrays + counters) and optionally buffers."""
+    """Saves `state` (a pytree of arrays + counters) and optionally buffers.
+
+    ``keep_last`` (fabric-config knob) overrides the manager's
+    ``checkpoint.keep_last`` policy when set.
+    """
 
     def __init__(self, keep_last: Optional[int] = None):
         self.keep_last = keep_last
@@ -27,40 +35,34 @@ class CheckpointCallback:
     # -- buffer embedding ------------------------------------------------
 
     @staticmethod
-    def _buffer_state(rb) -> Dict[str, Any]:
-        """Snapshot buffer state with trailing dones forced terminal."""
-        if isinstance(rb, (list, tuple)):  # per-env buffer lists (AsyncReplayBuffer parts)
-            return {"__list__": [CheckpointCallback._buffer_state(b) for b in rb]}
-        state = rb.state_dict()
+    def _force_terminal(state: Dict[str, Any]) -> Dict[str, Any]:
+        """Force the last stored step of a ReplayBuffer-style state dict to be
+        terminal on every termination key present (reference :32-40)."""
         buf = state.get("buffer")
         if isinstance(buf, dict):
-            # force the step before `pos` to be terminal (reference :32-40)
+            pos = int(np.asarray(state.get("pos", 0)))
+            written = bool(state.get("full", False)) or pos > 0
             for key in ("dones", "terminated", "truncated"):
-                if key in buf and key == "dones":
+                if key in buf:
                     arr = np.asarray(buf[key])
-                    pos = state.get("pos", 0)
-                    if arr.size and len(rb) > 0:
+                    if arr.size and written:
                         arr = arr.copy()
                         arr[(pos - 1) % arr.shape[0]] = 1
                         buf[key] = arr
         return state
 
-    def _prune(self, ckpt_dir: str) -> None:
-        if not self.keep_last or not os.path.isdir(ckpt_dir):
-            return
-        paths = glob.glob(os.path.join(ckpt_dir, "ckpt_*"))
-
-        def step_of(p: str) -> int:
-            m = re.search(r"ckpt_(\d+)", os.path.basename(p))
-            return int(m.group(1)) if m else -1
-
-        for path in sorted(paths, key=step_of)[: -self.keep_last]:
-            try:
-                import shutil
-
-                shutil.rmtree(path, ignore_errors=True)
-            except OSError:
-                pass
+    @staticmethod
+    def _buffer_state(rb) -> Dict[str, Any]:
+        """Snapshot buffer state with trailing terminal flags forced."""
+        if isinstance(rb, (list, tuple)):  # per-env buffer lists (AsyncReplayBuffer parts)
+            return {"__list__": [CheckpointCallback._buffer_state(b) for b in rb]}
+        state = rb.state_dict()
+        if isinstance(state.get("buffers"), list):  # EnvIndependentReplayBuffer
+            state["buffers"] = [
+                CheckpointCallback._force_terminal(s) for s in state["buffers"]
+            ]
+            return state
+        return CheckpointCallback._force_terminal(state)
 
     # -- hooks (dispatched by fabric.call) -------------------------------
 
@@ -72,10 +74,12 @@ class CheckpointCallback:
         replay_buffer=None,
         **_: Any,
     ) -> None:
-        if replay_buffer is not None:
-            state = {**state, "rb": self._buffer_state(replay_buffer)}
-        fabric.save(ckpt_path, state)
-        self._prune(os.path.dirname(ckpt_path))
+        from sheeprl_tpu.ckpt import get_checkpoint_manager
+
+        rb_state = self._buffer_state(replay_buffer) if replay_buffer is not None else None
+        get_checkpoint_manager().save(
+            ckpt_path, state, rb_state=rb_state, fabric=fabric, keep_last=self.keep_last
+        )
 
     def on_checkpoint_player(self, fabric, ckpt_path: str, state: Dict[str, Any], replay_buffer=None, **_: Any):
         self.on_checkpoint_coupled(fabric, ckpt_path, state, replay_buffer)

@@ -1,0 +1,53 @@
+"""Subprocess target for the mid-write SIGKILL test.
+
+Writes one valid checkpoint (step 100), then starts a second save (step 200)
+whose manifest write blocks forever — printing ``MIDWRITE`` once the shard
+files are on disk but the directory is still a ``.tmp`` partial. The parent
+test SIGKILLs this process at that point: whatever is left in the run dir is
+exactly what a preempted/killed writer leaves behind.
+
+Run: ``python ckpt_kill_worker.py <ckpt_dir>``
+"""
+
+import os
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from sheeprl_tpu.ckpt import manifest as manifest_mod
+from sheeprl_tpu.ckpt.manager import CheckpointManager
+
+
+def main() -> None:
+    ckpt_dir = sys.argv[1]
+    state = {
+        "params": {"w": np.arange(64, dtype=np.float32).reshape(8, 8)},
+        "update": 1,
+    }
+    mgr = CheckpointManager(async_save=False)
+    mgr.save(os.path.join(ckpt_dir, "ckpt_100_0"), state)
+
+    real_write_manifest = manifest_mod.write_manifest
+    blocked = threading.Event()
+
+    def blocking_write_manifest(dirname, manifest, fsync=True):
+        # shards are fully written at this point; the commit record is not —
+        # announce and hang so the parent can SIGKILL mid-write
+        print("MIDWRITE", flush=True)
+        blocked.wait()  # forever
+        real_write_manifest(dirname, manifest, fsync)
+
+    # patch through the writer module's import site
+    from sheeprl_tpu.ckpt import writer as writer_mod
+
+    writer_mod.write_manifest = blocking_write_manifest
+    state["update"] = 2
+    mgr.save(os.path.join(ckpt_dir, "ckpt_200_0"), state, sync=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""A writer SIGKILLed mid-write must leave the run dir resumable.
+
+The acceptance scenario for the atomic layout: the subprocess writes a valid
+checkpoint, then hangs inside its second save after the shards are on disk
+but before the manifest commit; SIGKILL at that point leaves a
+``ckpt_200_0.tmp`` partial next to the valid ``ckpt_100_0`` — and
+``resume_from=latest`` must pick the valid one.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from sheeprl_tpu.ckpt.resume import read_checkpoint, resolve_latest
+
+WORKER = os.path.join(os.path.dirname(__file__), "ckpt_kill_worker.py")
+
+
+@pytest.fixture(scope="module")
+def killed_run_dir(tmp_path_factory):
+    ckpt_dir = str(tmp_path_factory.mktemp("killed") / "checkpoint")
+    os.makedirs(ckpt_dir)
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, ckpt_dir],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        for line in proc.stdout:  # wait for the mid-write announcement
+            if "MIDWRITE" in line:
+                break
+        else:
+            pytest.fail(f"worker exited early (rc={proc.wait()}) without MIDWRITE")
+        os.kill(proc.pid, signal.SIGKILL)
+    finally:
+        proc.wait(timeout=30)
+        proc.stdout.close()
+    return ckpt_dir
+
+
+def test_kill_leaves_tmp_partial_not_final(killed_run_dir):
+    names = sorted(os.listdir(killed_run_dir))
+    assert "ckpt_100_0" in names
+    assert "ckpt_200_0" not in names, "a killed writer must never produce a final dir"
+    assert "ckpt_200_0.tmp" in names  # the partial is visibly a partial
+
+
+def test_resolve_latest_skips_the_partial(killed_run_dir):
+    latest = resolve_latest(killed_run_dir)
+    assert latest is not None and os.path.basename(latest) == "ckpt_100_0"
+    out = read_checkpoint(latest)  # checksums verify: the survivor is intact
+    assert int(out["update"]) == 1
+
+
+def test_resolve_latest_skips_buffer_only_shard_without_state_sibling(tmp_path):
+    # world_size=2 run killed after rank 1's buffer shard landed but before
+    # rank 0's state-bearing dir renamed: `latest` must fall back to the
+    # older step that has model state, not hand resume an empty pytree
+    import numpy as np
+
+    from sheeprl_tpu.ckpt.manager import CheckpointManager
+
+    root = str(tmp_path / "checkpoint")
+    fab0 = type("F", (), {"global_rank": 0, "world_size": 2})
+    fab1 = type("F", (), {"global_rank": 1, "world_size": 2})
+    mgr = CheckpointManager(async_save=False)
+    rb = {"buffer": {"obs": np.ones((2, 1, 1), np.float32)}, "pos": 0, "full": True}
+    mgr.save(os.path.join(root, "ckpt_100_0"), {"u": 1}, fabric=fab0)
+    mgr.save(os.path.join(root, "ckpt_100_1"), {"u": 1}, rb_state=rb, fabric=fab1)
+    # step 200: only rank 1 landed (rank 0 died mid-write)
+    mgr.save(os.path.join(root, "ckpt_200_1"), {"u": 2}, rb_state=rb, fabric=fab1)
+    with pytest.warns(UserWarning, match="skipping invalid checkpoint"):
+        latest = resolve_latest(root)
+    # fell back to step 100 (preferring the rank-0, state-bearing dir)
+    assert os.path.basename(latest) == "ckpt_100_0"
+    # once rank 0's step-200 dir exists, step 200 wins again
+    mgr.save(os.path.join(root, "ckpt_200_0"), {"u": 2}, fabric=fab0)
+    assert os.path.basename(resolve_latest(root)).startswith("ckpt_200")
+
+
+def test_resolve_latest_skips_corrupted_manifest(killed_run_dir, tmp_path):
+    # a *renamed-final* checkpoint whose manifest later rots must also be
+    # skipped in favor of an older valid one
+    import shutil
+
+    root = str(tmp_path / "checkpoint")
+    shutil.copytree(killed_run_dir, root)
+    newer = os.path.join(root, "ckpt_300_0")
+    shutil.copytree(os.path.join(root, "ckpt_100_0"), newer)
+    with open(os.path.join(newer, "manifest.json"), "w") as f:
+        f.write("not json at all")
+    with pytest.warns(UserWarning, match="skipping invalid checkpoint"):
+        latest = resolve_latest(root)
+    assert os.path.basename(latest) == "ckpt_100_0"

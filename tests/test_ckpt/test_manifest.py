@@ -1,0 +1,201 @@
+"""Manifest codec: pytree↔npz round trips, integrity, schema gating."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sheeprl_tpu.ckpt.manifest import (
+    SCHEMA_VERSION,
+    CheckpointCorruptedError,
+    decode_array,
+    encode_array,
+    flatten_tree,
+    read_manifest,
+    unflatten_tree,
+    write_manifest,
+)
+from sheeprl_tpu.ckpt.resume import read_checkpoint, validate_checkpoint
+from sheeprl_tpu.ckpt.writer import write_checkpoint
+from sheeprl_tpu.utils.utils import conform_pytree
+
+
+def _tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype, (x.dtype, y.dtype)
+        assert np.array_equal(x, y)
+
+
+def test_flatten_round_trips_containers():
+    tree = {
+        "params": {"dense": {"kernel": np.ones((3, 2), np.float32)}},
+        "steps": 7,
+        "flags": [np.zeros(2, np.bool_), (np.float64(1.5), None)],
+        "empty": {},
+    }
+    arrays = {}
+    treedef = flatten_tree(tree, arrays)
+    out = unflatten_tree(treedef, arrays)
+    assert out["steps"] == 7
+    assert out["flags"][1][1] is None
+    assert isinstance(out["flags"][1], tuple)
+    assert out["empty"] == {}
+    _tree_equal(
+        jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(out)
+    )
+
+
+def test_optax_state_round_trips_through_conform():
+    params = {"w": jnp.ones((4, 3)), "b": jnp.zeros((3,))}
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3))
+    opt_state = tx.init(params)
+    arrays = {}
+    treedef = flatten_tree(jax.device_get(opt_state), arrays)
+    restored = unflatten_tree(treedef, arrays)
+    # NamedTuples come back as field dicts; conform rebuilds the classes
+    conformed = conform_pytree(opt_state, restored)
+    assert type(conformed[1][0]).__name__ == "ScaleByAdamState"
+    _tree_equal(jax.device_get(opt_state), conformed)
+
+
+def test_bfloat16_preserves_dtype():
+    arr = np.asarray(jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3))
+    stored, meta = encode_array(arr)
+    assert meta["stored_as"] == "raw_bytes" and meta["dtype"] == "bfloat16"
+    decoded = decode_array(stored, meta)
+    assert decoded.dtype == arr.dtype
+    assert np.array_equal(decoded.view(np.uint16), arr.view(np.uint16))
+
+
+def test_object_leaf_rejected():
+    with pytest.raises(TypeError):
+        encode_array(np.array([object()], dtype=object))
+
+
+def test_checksum_mismatch_raises():
+    arrays = {}
+    treedef = flatten_tree({"x": np.arange(4.0)}, arrays)
+    arrays["a0"] = arrays["a0"].copy()
+    arrays["a0"][0] += 1
+    with pytest.raises(CheckpointCorruptedError, match="checksum"):
+        unflatten_tree(treedef, arrays)
+
+
+def test_missing_array_raises():
+    treedef = flatten_tree({"x": np.arange(4.0)}, {})
+    with pytest.raises(CheckpointCorruptedError, match="missing"):
+        unflatten_tree(treedef, {})
+
+
+def test_schema_version_gate(tmp_path):
+    write_manifest(str(tmp_path), {"schema_version": SCHEMA_VERSION + 1})
+    with pytest.raises(CheckpointCorruptedError, match="schema_version"):
+        read_manifest(str(tmp_path))
+
+
+def test_write_checkpoint_atomic_layout(tmp_path):
+    final = str(tmp_path / "ckpt_128_0")
+    state = {"params": {"w": np.ones((2, 2), np.float32)}, "update": 4}
+    rb = {
+        "buffer": {
+            "obs": np.arange(12, dtype=np.float32).reshape(2, 3, 2),
+            "dones": np.zeros((2, 3, 1), np.float32),
+        },
+        "pos": 1,
+        "full": False,
+    }
+    nbytes = write_checkpoint(final, state, rb, step=128, algo="ppo")
+    assert nbytes > 0
+    assert os.path.isdir(final) and not os.path.isdir(final + ".tmp")
+    names = sorted(os.listdir(final))
+    # per-env buffer shards, not one giant blob
+    assert names == ["manifest.json", "rb_env0.npz", "rb_env1.npz", "rb_env2.npz", "state.npz"]
+    manifest = validate_checkpoint(final)
+    assert manifest["step"] == 128 and manifest["algo"] == "ppo"
+
+    out = read_checkpoint(final)
+    assert int(out["update"]) == 4
+    _tree_equal(out["rb"]["buffer"], rb["buffer"])
+    assert out["rb"]["pos"] == 1 and out["rb"]["full"] is False
+
+
+def test_same_step_overwrite_never_deletes_before_rename(tmp_path, monkeypatch):
+    """Re-writing an existing step parks the old dir at .old and swaps, so a
+    kill between the renames still leaves one fully valid checkpoint."""
+    import sheeprl_tpu.ckpt.writer as writer_mod
+
+    final = str(tmp_path / "ckpt_7_0")
+    write_checkpoint(final, {"x": np.zeros(3, np.float32)})
+
+    real_replace = os.replace
+    seen = []
+
+    def tracing_replace(src, dst):
+        # at the instant the tmp dir is promoted, the old content must still
+        # exist somewhere on disk (parked at .old), never already deleted
+        if src.endswith(".tmp"):
+            seen.append(os.path.isdir(final + ".old"))
+        real_replace(src, dst)
+
+    monkeypatch.setattr(writer_mod.os, "replace", tracing_replace)
+    write_checkpoint(final, {"x": np.ones(3, np.float32)})
+    assert seen == [True]
+    assert not os.path.isdir(final + ".old")  # cleaned after the swap
+    assert np.array_equal(read_checkpoint(final)["x"], np.ones(3, np.float32))
+
+
+def test_truncated_shard_fails_quick_validation(tmp_path):
+    final = str(tmp_path / "ckpt_1_0")
+    write_checkpoint(final, {"x": np.arange(1000.0)})
+    shard = os.path.join(final, "state.npz")
+    with open(shard, "r+b") as f:
+        f.truncate(os.path.getsize(shard) // 2)
+    with pytest.raises(CheckpointCorruptedError, match="missing or truncated"):
+        validate_checkpoint(final)
+
+
+def test_corrupt_manifest_fails(tmp_path):
+    final = str(tmp_path / "ckpt_1_0")
+    write_checkpoint(final, {"x": np.arange(4.0)})
+    with open(os.path.join(final, "manifest.json"), "w") as f:
+        f.write('{"schema_version": 1')  # truncated JSON
+    with pytest.raises(CheckpointCorruptedError):
+        validate_checkpoint(final)
+
+
+def test_env_independent_buffer_shards(tmp_path):
+    final = str(tmp_path / "ckpt_2_0")
+    sub = lambda i: {  # noqa: E731
+        "buffer": {"obs": np.full((3, 1, 2), float(i), np.float32)},
+        "pos": i,
+        "full": False,
+    }
+    rb = {"buffers": [sub(0), sub(1)]}
+    write_checkpoint(final, {"u": 1}, rb)
+    assert {"rb_env0.npz", "rb_env1.npz"} <= set(os.listdir(final))
+    out = read_checkpoint(final)
+    assert len(out["rb"]["buffers"]) == 2
+    assert int(np.asarray(out["rb"]["buffers"][1]["pos"])) == 1
+    _tree_equal(out["rb"]["buffers"][0]["buffer"], sub(0)["buffer"])
+
+
+def test_generic_tree_buffer_fallback(tmp_path):
+    # EpisodeBuffer-style ragged state: falls back to one treedef shard
+    final = str(tmp_path / "ckpt_3_0")
+    rb = {
+        "buffer": [{"obs": np.ones((5, 2), np.float32)}, {"obs": np.ones((3, 2), np.float32)}],
+        "open_episodes": [[]],
+    }
+    write_checkpoint(final, {"u": 1}, rb)
+    assert "rb.npz" in os.listdir(final)
+    out = read_checkpoint(final)
+    assert len(out["rb"]["buffer"]) == 2
+    assert out["rb"]["buffer"][1]["obs"].shape == (3, 2)

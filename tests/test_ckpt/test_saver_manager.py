@@ -1,0 +1,232 @@
+"""Async saver discipline (double-buffering, retry/degrade) and manager GC."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.ckpt.manager import CheckpointManager, should_checkpoint, warn_checkpoint_rounding
+from sheeprl_tpu.ckpt.preemption import (
+    install_preemption_handlers,
+    preemption_requested,
+    reset_preemption,
+    uninstall_preemption_handlers,
+)
+from sheeprl_tpu.ckpt.resume import read_checkpoint
+from sheeprl_tpu.ckpt.saver import AsyncSaver
+from sheeprl_tpu.obs import counters as counters_mod
+from sheeprl_tpu.utils.utils import dotdict
+
+
+@pytest.fixture
+def run_counters():
+    c = counters_mod.Counters()
+    counters_mod.install(c)
+    yield c
+    counters_mod.install(None)
+
+
+def test_submit_returns_before_slow_write_finishes():
+    saver = AsyncSaver()
+    release = threading.Event()
+    done = threading.Event()
+
+    def slow_write():
+        release.wait(10)
+        done.set()
+        return 1
+
+    t0 = time.perf_counter()
+    saver.submit(slow_write)
+    assert time.perf_counter() - t0 < 1.0  # returned while the write blocks
+    assert not done.is_set()
+    release.set()
+    assert saver.drain(10)
+    assert done.is_set()
+
+
+def test_double_buffer_waits_out_the_inflight_save():
+    saver = AsyncSaver()
+    order = []
+    release = threading.Event()
+
+    def first():
+        release.wait(10)
+        order.append("first")
+        return 1
+
+    def second():
+        order.append("second")
+        return 1
+
+    saver.submit(first)
+    threading.Timer(0.2, release.set).start()
+    saver.submit(second)  # must wait for `first` to land — never stacks
+    saver.drain(10)
+    assert order == ["first", "second"]
+
+
+def test_retry_then_success(run_counters):
+    saver = AsyncSaver(retries=2, backoff_s=0.01)
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return 42
+
+    with pytest.warns(UserWarning, match="retrying"):
+        saver.submit(flaky, sync=True)
+    assert len(attempts) == 3
+    assert run_counters.ckpt_saves == 1 and run_counters.ckpt_failures == 0
+    assert run_counters.ckpt_bytes == 42
+
+
+def test_async_failure_degrades_to_sync(run_counters):
+    saver = AsyncSaver(retries=1, backoff_s=0.01)
+
+    def always_fails():
+        raise OSError("disk on fire")
+
+    with pytest.warns(UserWarning, match="degrading to synchronous"):
+        saver.submit(always_fails)
+        saver.drain(10)
+    assert saver.degraded
+    assert run_counters.ckpt_failures == 1
+
+    # degraded: the next save runs inline and surfaces its error to the caller
+    with pytest.raises(OSError, match="disk on fire"):
+        with pytest.warns(UserWarning, match="retrying"):
+            saver.submit(always_fails)
+
+
+def test_manager_save_counts_blocked_and_write_time(tmp_path, run_counters):
+    mgr = CheckpointManager(async_save=True)
+    state = {"params": {"w": np.ones((16, 16), np.float32)}, "update": 1}
+    mgr.save(str(tmp_path / "ckpt_10_0"), state)
+    assert mgr.drain(10)
+    assert run_counters.ckpt_saves == 1
+    assert run_counters.ckpt_bytes > 0
+    assert run_counters.ckpt_blocked_ms >= 0.0
+    assert run_counters.ckpt_write_ms > 0.0
+
+
+def test_snapshot_owns_its_bytes(tmp_path, monkeypatch):
+    """The save must deep-copy on the step path: mutating the caller's state
+    while the (slowed) writer is mid-serialization must not corrupt the
+    checkpoint. Without the copy, device_get's zero-copy CPU views let a
+    donated train step rewrite the bytes under the writer."""
+    import time as time_mod
+
+    import sheeprl_tpu.ckpt.writer as writer_mod
+
+    orig = writer_mod._write_npz
+
+    def slow(path, arrays, fsync=True):
+        time_mod.sleep(0.3)
+        return orig(path, arrays, fsync)
+
+    monkeypatch.setattr(writer_mod, "_write_npz", slow)
+    backing = np.zeros(8, np.float32)
+    state = {"w": backing[:], "update": 1}  # owndata=False view, like CPU device_get
+    assert not state["w"].flags.owndata
+    mgr = CheckpointManager(async_save=True)
+    mgr.save(str(tmp_path / "ckpt_1_0"), state)
+    backing[:] = 999.0  # the train loop moves on while the writer works
+    assert mgr.drain(10)
+    out = read_checkpoint(str(tmp_path / "ckpt_1_0"))  # checksums verify
+    assert np.array_equal(out["w"], np.zeros(8, np.float32))
+
+
+def test_manager_keep_last_gc_and_stale_tmp_sweep(tmp_path):
+    mgr = CheckpointManager(async_save=False, keep_last=2)
+    stale = tmp_path / "ckpt_5_0.tmp"
+    stale.mkdir()
+    for step in (10, 20, 30):
+        mgr.save(str(tmp_path / f"ckpt_{step}_0"), {"u": step})
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["ckpt_20_0", "ckpt_30_0"]  # keep policy + stale .tmp swept
+
+
+def test_stale_tmp_sweep_never_touches_other_ranks_inflight(tmp_path):
+    # rank 1 is mid-write (its .tmp is live); rank 0's GC pass must only
+    # sweep rank-0 partials or it would crash rank 1's rename
+    other_inflight = tmp_path / "ckpt_10_1.tmp"
+    other_inflight.mkdir()
+    own_stale = tmp_path / "ckpt_5_0.tmp"
+    own_stale.mkdir()
+
+    class Fab:
+        global_rank = 0
+        world_size = 2
+
+    mgr = CheckpointManager(async_save=False, keep_last=5)
+    mgr.save(str(tmp_path / "ckpt_10_0"), {"u": 1}, fabric=Fab())
+    names = sorted(os.listdir(tmp_path))
+    assert "ckpt_10_1.tmp" in names  # sibling's in-flight write untouched
+    assert "ckpt_5_0.tmp" not in names  # own dead partial swept
+
+
+def test_manager_gc_only_touches_own_rank(tmp_path):
+    class Fab:
+        global_rank = 0
+        world_size = 2
+
+    other = tmp_path / "ckpt_1_1"
+    other.mkdir()
+    mgr = CheckpointManager(async_save=False, keep_last=1)
+    for step in (1, 2):
+        mgr.save(str(tmp_path / f"ckpt_{step}_0"), {"u": step}, fabric=Fab())
+    assert sorted(os.listdir(tmp_path)) == ["ckpt_1_1", "ckpt_2_0"]
+
+
+def test_nonzero_rank_writes_buffers_only(tmp_path):
+    class Fab:
+        global_rank = 1
+        world_size = 2
+
+    rb = {"buffer": {"obs": np.ones((2, 2, 1), np.float32)}, "pos": 0, "full": True}
+    mgr = CheckpointManager(async_save=False)
+    mgr.save(str(tmp_path / "ckpt_1_1"), {"u": 1}, rb_state=rb, fabric=Fab())
+    names = os.listdir(tmp_path / "ckpt_1_1")
+    assert "state.npz" not in names and "rb_env0.npz" in names
+    # rank-1 restore pulls the model from the rank-0 sibling
+    mgr.save(str(tmp_path / "ckpt_1_0"), {"u": 1}, fabric=type("F", (), {"global_rank": 0, "world_size": 2}))
+    out = read_checkpoint(str(tmp_path / "ckpt_1_1"), rank=1)
+    assert int(out["u"]) == 1 and "rb" in out
+
+
+def test_should_checkpoint_gate_and_preemption():
+    cfg = dotdict({"checkpoint": {"every": 100, "save_last": True}})
+    assert should_checkpoint(cfg, 100, 0, 1, 10)
+    assert not should_checkpoint(cfg, 99, 0, 1, 10)
+    assert should_checkpoint(cfg, 1, 0, 10, 10)  # save_last on final update
+    cfg.checkpoint.save_last = False
+    assert not should_checkpoint(cfg, 1, 0, 10, 10)
+    reset_preemption()
+    try:
+        install_preemption_handlers()
+        import os as _os
+        import signal as _signal
+
+        _os.kill(_os.getpid(), _signal.SIGTERM)
+        # the flag flips on the next bytecode boundary in the main thread
+        assert preemption_requested()
+        assert should_checkpoint(cfg, 1, 0, 1, 10)  # preemption forces a save
+        # ...but not when the run disabled checkpointing entirely
+        off = dotdict({"checkpoint": {"every": 0, "save_last": False}})
+        assert not should_checkpoint(off, 1, 0, 1, 10)
+    finally:
+        uninstall_preemption_handlers()
+        reset_preemption()
+
+
+def test_warn_checkpoint_rounding():
+    cfg = dotdict({"checkpoint": {"every": 150}})
+    with pytest.warns(UserWarning, match="checkpoint.every"):
+        warn_checkpoint_rounding(cfg, 100)
+    cfg.checkpoint.every = 200
+    warn_checkpoint_rounding(cfg, 100)  # multiple: no warning

@@ -40,10 +40,9 @@ def _train_once(tmp_path, run_name):
         glob.glob(f"{tmp_path}/logs/**/{run_name}*/**/ckpt_*", recursive=True)
     )
     assert ckpts, f"no checkpoint for {run_name}"
-    import orbax.checkpoint as ocp
+    from sheeprl_tpu.ckpt import read_checkpoint
 
-    with ocp.PyTreeCheckpointer() as ckptr:
-        return ckptr.restore(os.path.abspath(ckpts[-1]))
+    return read_checkpoint(os.path.abspath(ckpts[-1]))
 
 
 def test_same_seed_same_bits(tmp_path, monkeypatch):

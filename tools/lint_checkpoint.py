@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Checkpoint-uniformity lint: every algorithm entrypoint must persist state
+through the ``sheeprl_tpu/ckpt`` subsystem.
+
+The fault-tolerant pipeline (async double-buffered writes, atomic manifest
+layout, preemption capture, keep-policy GC) only holds if no train loop
+bypasses it. This lint fails when a file under ``sheeprl_tpu/algos/``:
+
+- calls ``fabric.save(...)`` / ``self.fabric.save(...)`` — a raw synchronous
+  orbax write on the step path; route through
+  ``fabric.call("on_checkpoint_*")`` so the CheckpointCallback hands the
+  state to the run's CheckpointManager;
+- re-grows its own ``checkpoint.every`` rounding warning (string literal
+  containing "The checkpoint.every parameter") — the shared copy lives in
+  ``sheeprl_tpu.ckpt.warn_checkpoint_rounding``;
+- dispatches an ``on_checkpoint_*`` hook without gating it through
+  ``should_checkpoint`` somewhere in the same file — hand-rolled cadence
+  conditions silently drop preemption capture.
+
+AST-based, so comments and docstrings are fine.
+
+Usage: ``python tools/lint_checkpoint.py`` — exits non-zero with a findings
+list on violation. Wired into the CI tier-1 lane (.github/workflows/tests.yml).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
+
+FORBIDDEN_WARNING_FRAGMENT = "The checkpoint.every parameter"
+
+
+def _docstring_nodes(tree: ast.AST) -> set:
+    allowed = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and isinstance(body[0].value, ast.Constant):
+                allowed.add(id(body[0].value))
+    return allowed
+
+
+def lint_file(path: str) -> list:
+    src = open(path).read()
+    tree = ast.parse(src, filename=path)
+    docstrings = _docstring_nodes(tree)
+    findings = []
+    dispatches_checkpoint = False
+    uses_gate = False
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and id(node) not in docstrings
+            and FORBIDDEN_WARNING_FRAGMENT in node.value
+        ):
+            findings.append(
+                (node.lineno,
+                 "hand-rolled checkpoint.every rounding warning — use "
+                 "sheeprl_tpu.ckpt.warn_checkpoint_rounding")
+            )
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "save":
+                base = fn.value
+                if (isinstance(base, ast.Name) and base.id == "fabric") or (
+                    isinstance(base, ast.Attribute) and base.attr == "fabric"
+                ):
+                    findings.append(
+                        (node.lineno,
+                         "raw fabric.save() on the step path — dispatch "
+                         'fabric.call("on_checkpoint_*") so the save routes '
+                         "through the ckpt subsystem (async, atomic, GC-safe)")
+                    )
+            if isinstance(fn, ast.Attribute) and fn.attr == "call" and node.args:
+                arg0 = node.args[0]
+                if (
+                    isinstance(arg0, ast.Constant)
+                    and isinstance(arg0.value, str)
+                    and arg0.value.startswith("on_checkpoint_")
+                ):
+                    dispatches_checkpoint = True
+            if isinstance(fn, ast.Name) and fn.id == "should_checkpoint":
+                uses_gate = True
+    if dispatches_checkpoint and not uses_gate:
+        findings.append(
+            (1,
+             "dispatches on_checkpoint_* without a should_checkpoint(...) "
+             "gate — hand-rolled cadence conditions drop preemption capture")
+        )
+    return findings
+
+
+def main() -> int:
+    failures = []
+    for root, _dirs, files in os.walk(ALGOS_DIR):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            for lineno, message in lint_file(path):
+                failures.append(f"{os.path.relpath(path, REPO)}:{lineno}: {message}")
+    if failures:
+        print("checkpoint-uniformity lint FAILED:")
+        for f in failures:
+            print(f"  {f}")
+        print(
+            f"\n{len(failures)} finding(s). Algorithm entrypoints must persist "
+            "state through the checkpoint subsystem (sheeprl_tpu/ckpt/)."
+        )
+        return 1
+    print("checkpoint-uniformity lint OK (all entrypoints use the ckpt subsystem)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
