@@ -287,6 +287,15 @@ def _sac_line() -> str:
     # matrix on THIS host (on a real TPU-VM host it runs in minutes). Full
     # protocol when the budget allows; otherwise a disclosed 1/8-protocol
     # run (8192 steps) whose vs_baseline uses the time-scaled baseline.
+    # Runs with the now-universal TPU-first replay path (transition-mode
+    # device ring: per-burst batch uploads become index-plan uploads, and
+    # the host fallback prefetch overlaps staging with train); telemetry
+    # rides along so the line carries bytes_staged_h2d / ring_gathers /
+    # prefetch counters as evidence.
+    import tempfile
+
+    tel_path = os.path.join(tempfile.mkdtemp(prefix="bench_sac_tel_"), "telemetry.json")
+
     def build_args(steps):
         return [
             "exp=sac",  # env defaults to LunarLanderContinuous-v3 (exp/sac.yaml)
@@ -294,30 +303,57 @@ def _sac_line() -> str:
             "env.sync_env=True",
             f"total_steps={steps}",
             "exp_name=bench_sac",
+            "buffer.device_ring=True",
+            "metric.telemetry.enabled=true",
+            "metric.telemetry.trace=false",
+            f"metric.telemetry.summary_path={tel_path}",
             *_QUIET,
         ]
 
     if _remaining() > 2400:
-        return _repeat_line(
+        line = _repeat_line(
             "sac_lunarlander_65536_steps",
             lambda: _timed_subprocess_run(build_args(65536), timeout=1800),
             SAC_BASELINE_SECONDS,
             "reference benchmark_sb3.py:21-29 (LunarLanderContinuous, 4 envs, "
-            "1024*64 steps, test/log/ckpt off); -v3 replaces the retired -v2",
+            "1024*64 steps, test/log/ckpt off, buffer.device_ring=True); -v3 "
+            "replaces the retired -v2",
             repeats=3,
             min_stage_s=120.0,
         )
-    return _repeat_line(
-        "sac_lunarlander_8192_steps",
-        lambda: _timed_subprocess_run(build_args(8192), timeout=1800),
-        SAC_BASELINE_SECONDS / 8.0,
-        "1/8 of reference benchmark_sb3.py:21-29 (8192 of 65536 steps, same "
-        "4-env LunarLanderContinuous, test/log/ckpt off); vs_baseline uses "
-        "the baseline time-scaled by 1/8 — the full protocol exceeds this "
-        "host's wall budget (per-step dispatch through a tunneled relay)",
-        repeats=1,
-        min_stage_s=220.0,
-    )
+    else:
+        line = _repeat_line(
+            "sac_lunarlander_8192_steps",
+            lambda: _timed_subprocess_run(build_args(8192), timeout=1800),
+            SAC_BASELINE_SECONDS / 8.0,
+            "1/8 of reference benchmark_sb3.py:21-29 (8192 of 65536 steps, same "
+            "4-env LunarLanderContinuous, test/log/ckpt off, "
+            "buffer.device_ring=True); vs_baseline uses the baseline time-"
+            "scaled by 1/8 — the full protocol exceeds this host's wall budget "
+            "(per-step dispatch through a tunneled relay)",
+            repeats=1,
+            min_stage_s=220.0,
+        )
+    try:  # fold the last run's staging counters into the evidence line
+        with open(tel_path) as f:
+            tel = json.load(f)
+        data = json.loads(line)
+        data["telemetry"] = {
+            k: tel.get(k)
+            for k in (
+                "bytes_staged_h2d",
+                "h2d_transfers",
+                "ring_gathers",
+                "prefetch_hits",
+                "prefetch_misses",
+                "prefetch_wait_ms",
+                "recompiles",
+            )
+        }
+        line = json.dumps(data)
+    except Exception:
+        pass  # a skipped/failed stage has no summary; keep the line as-is
+    return line
 
 
 def _dreamer_e2e_line(family, baseline, total_steps, min_stage_s, extra=()) -> str:
@@ -326,6 +362,9 @@ def _dreamer_e2e_line(family, baseline, total_steps, min_stage_s, extra=()) -> s
         "env.num_envs=1",
         f"total_steps={total_steps}",
         f"exp_name=bench_{family}",
+        # the replay path is universal now: pixel bursts gather from the
+        # device ring instead of re-crossing the host link every burst
+        "buffer.device_ring=True",
         *extra,
         *_QUIET,
     ]
@@ -360,9 +399,11 @@ def main() -> None:
     emit(_dreamer_line("dv3", min_stage_s=180.0, extra=("bench.profile=1",)))
     # DV2/DV1 device-step lines (grad-steps/s + scan-corrected MFU vs wall
     # rate; no xplane pass — keeps each under ~3 min warm). Their e2e
-    # micro-runs upload a ~12 MB host batch per burst and take >15 min each
-    # through the tunneled link (no device ring outside DV3), so the
-    # wall-clock e2e rows only run when a big budget is configured.
+    # micro-runs now ride the universal device ring (buffer.device_ring in
+    # _dreamer_e2e_line), so bursts gather on device instead of uploading a
+    # ~12 MB host batch each — but the per-step dispatch cost through the
+    # tunneled link still dominates, so the wall-clock e2e rows only run
+    # when a big budget is configured.
     emit(_dreamer_line("dv2", min_stage_s=170.0, extra=("bench.steps=10",)))
     emit(_dreamer_line("dv1", min_stage_s=170.0, extra=("bench.steps=10",)))
     # SAC last: the only stage that can overrun its estimate by minutes

@@ -53,12 +53,13 @@ from sheeprl_tpu.data.buffers import (
     EpisodeBuffer,
     SequentialReplayBuffer,
 )
+from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.distributions import Bernoulli, Independent, Normal
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import count_h2d, log_sps_metrics, span
+from sheeprl_tpu.obs import log_sps_metrics, span
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -576,7 +577,20 @@ def main(fabric, cfg: Dict[str, Any]):
         )
     warn_checkpoint_rounding(cfg, policy_steps_per_update)
 
-    data_sharding = fabric.sharding(None, fabric.data_axis)
+    # TPU-first replay staging (data/staging.py): device-ring gathers when
+    # buffer.device_ring=True (sequential buffers; the episode buffer falls
+    # back), double-buffered host prefetch otherwise; the [n, L, B, ...]
+    # burst arrives on device in one step and the per-gradient-step loop
+    # below slices device arrays (no H2D per step)
+    staging = make_replay_staging(
+        cfg,
+        fabric,
+        rb,
+        sequence_length=int(cfg.per_rank_sequence_length),
+        batch_sharding=fabric.sharding(None, None, fabric.data_axis),
+        seed=cfg.seed,
+    )
+    rb = staging.rb
 
     # First observation: a zero-action is_first row (reference main :614-632)
     o = envs.reset(seed=cfg.seed)[0]
@@ -637,11 +651,10 @@ def main(fabric, cfg: Dict[str, Any]):
         if "restart_on_exception" in infos:
             for i, env_roe in enumerate(infos["restart_on_exception"]):
                 if env_roe and not dones[i]:
-                    if isinstance(rb, EnvIndependentReplayBuffer):
-                        sub = rb.buffer[i]
-                        last_idx = (sub._pos - 1) % sub.buffer_size
-                        sub["dones"][last_idx] = np.ones_like(sub["dones"][last_idx])
-                        sub["is_first"][last_idx] = np.zeros_like(sub["is_first"][last_idx])
+                    if not isinstance(rb, EpisodeBuffer):
+                        # both the host copy and (when the ring is on) the
+                        # HBM mirror are patched by the staging facade
+                        staging.force_done_last(i)
                     step_data["is_first"][0, i] = 1.0
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
@@ -712,7 +725,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 if update == learning_starts
                 else cfg.algo.per_rank_gradient_steps
             )
-            local_data = rb.sample(
+            local_data = staging.sample_device(
                 cfg.per_rank_batch_size * world_size,
                 sequence_length=cfg.per_rank_sequence_length,
                 n_samples=n_samples,
@@ -725,15 +738,10 @@ def main(fabric, cfg: Dict[str, Any]):
                         if per_rank_gradient_steps % cfg.algo.critic.target_network_update_freq == 0
                         else 0.0
                     )
-                    # ship native dtypes (uint8 pixels = 4x less than f32
-                    # over the host->HBM link) straight to the sharding; the
-                    # train step normalizes on device
-                    sliced = {k: v[i] for k, v in local_data.items()}
-                    batch = jax.device_put(sliced, data_sharding)
-                    # bytes counted here; the staging time is interleaved
-                    # with the gradient-step dispatches and stays inside the
-                    # train phase for this per-sample loop
-                    count_h2d(sliced)
+                    # device-side slice of the staged burst — a [L, B, ...]
+                    # view batch-sharded over the data axis; no per-gradient-
+                    # step host→HBM upload
+                    batch = {k: v[i] for k, v in local_data.items()}
                     root_key, train_key = jax.random.split(root_key)
                     agent_state, metrics = train_fn(
                         agent_state, batch, train_key, jnp.float32(tau)
@@ -805,6 +813,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 # drains the in-flight write) — leave the train loop cleanly
                 break
 
+    staging.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         test(

@@ -58,13 +58,13 @@ from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_check
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.distributions import MSEDistribution, SymlogDistribution, TwoHotEncodingDistribution
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import (
-    count_h2d,
     cost_flops_of,
     get_telemetry,
     log_sps_metrics,
@@ -676,34 +676,23 @@ def main(fabric, cfg: Dict[str, Any]):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
         buffer_cls=SequentialReplayBuffer,
     )
-    # Device-resident ring: transitions stream to HBM once at collection and
-    # train batches are gathered on device — no per-gradient-step host→device
-    # pixel upload (data/device_ring.py). On a multi-device mesh the ring
-    # shards itself env-wise over the data axis: each device keeps a private
-    # ring shard and gathers exactly the batch slice it consumes.
-    # (n_envs = num_envs * world_size always divides over the data axis; the
-    # unsupported case is MULTI-PROCESS, where the global batch sharding is
-    # not addressable shard-per-slice from one process)
-    use_device_ring = bool(cfg.buffer.get("device_ring", False))
-    if use_device_ring and jax.process_count() > 1:
-        warnings.warn(
-            "buffer.device_ring=True is not supported on multi-process "
-            f"(multi-host) runs yet ({jax.process_count()} processes); "
-            "falling back to host-staged batches."
-        )
-        use_device_ring = False
-    if use_device_ring:
-        from sheeprl_tpu.data.device_ring import DeviceRingReplay
-
-        rb = DeviceRingReplay(
-            rb,
-            device=fabric.device,
-            seed=cfg.seed,
-            sequence_overlap=int(cfg.per_rank_sequence_length),
-            batch_sharding=(
-                fabric.sharding(None, None, fabric.data_axis) if world_size > 1 else None
-            ),
-        )
+    # TPU-first replay staging, shared with every off-policy algo
+    # (data/staging.py): with buffer.device_ring=True transitions stream to
+    # HBM once at collection and train bursts are gathered on device — no
+    # per-gradient-step host→device pixel upload; on a multi-device mesh the
+    # ring shards itself env-wise over the data axis (each device keeps a
+    # private ring shard and gathers exactly the batch slice it consumes).
+    # Multi-process runs (and ring off) get the double-buffered host
+    # prefetch pipeline instead.
+    staging = make_replay_staging(
+        cfg,
+        fabric,
+        rb,
+        sequence_length=int(cfg.per_rank_sequence_length),
+        batch_sharding=fabric.sharding(None, None, fabric.data_axis),
+        seed=cfg.seed,
+    )
+    rb = staging.rb
     if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
         rb.load_state_dict(state["rb"])
 
@@ -742,9 +731,6 @@ def main(fabric, cfg: Dict[str, Any]):
             "policy_steps_per_update value."
         )
     warn_checkpoint_rounding(cfg, policy_steps_per_update)
-
-    # Data sharding for the train batch [T, B_total, ...]
-    burst_sharding = fabric.sharding(None, None, fabric.data_axis)
 
     # First observation (reference main :574-590)
     o = envs.reset(seed=cfg.seed)[0]
@@ -892,13 +878,9 @@ def main(fabric, cfg: Dict[str, Any]):
         if "restart_on_exception" in infos:
             for i, env_roe in enumerate(infos["restart_on_exception"]):
                 if env_roe and not dones[i]:
-                    if use_device_ring:
-                        rb.force_done_last(i)
-                    else:
-                        sub = rb.buffer[i]
-                        last_idx = (sub._pos - 1) % sub.buffer_size
-                        sub["dones"][last_idx] = np.ones_like(sub["dones"][last_idx])
-                        sub["is_first"][last_idx] = np.zeros_like(sub["is_first"][last_idx])
+                    # both the host copy and (when the ring is on) the HBM
+                    # mirror are patched by the staging facade
+                    staging.force_done_last(i)
                     step_data["is_first"][0, i] = 1.0
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
@@ -1013,18 +995,11 @@ def main(fabric, cfg: Dict[str, Any]):
                 # degrade to "no training this window" but keep the cadence
                 metrics = None
             else:
-                if use_device_ring:
-                    local_data = rb.sample_device(
-                        cfg.per_rank_batch_size * world_size,
-                        sequence_length=cfg.per_rank_sequence_length,
-                        n_samples=n_samples,
-                    )
-                else:
-                    local_data = rb.sample(
-                        cfg.per_rank_batch_size * world_size,
-                        sequence_length=cfg.per_rank_sequence_length,
-                        n_samples=n_samples,
-                    )
+                local_data = staging.sample_device(
+                    cfg.per_rank_batch_size * world_size,
+                    sequence_length=cfg.per_rank_sequence_length,
+                    n_samples=n_samples,
+                )
                 _t = _tr("sample", _t)
                 # On a bandwidth-limited host link every blocking device→host
                 # metric fetch costs a round trip; fetch_train_metrics_every=k
@@ -1066,17 +1041,9 @@ def main(fabric, cfg: Dict[str, Any]):
                     g = per_rank_gradient_steps + i
                     if g % cfg.algo.critic.target_network_update_freq == 0:
                         taus[i] = 1.0 if g == 0 else cfg.algo.critic.tau
-                if use_device_ring:
-                    batches = local_data  # already stacked on device
-                else:
-                    # ship native dtypes (uint8 pixels = 4x less than f32
-                    # over the host->HBM link) straight to the sharding; the
-                    # train step normalizes on device. Staged OUTSIDE the
-                    # train span so Time/train_time means the same thing in
-                    # every algo (dispatch + metric fetch, no staging).
-                    with span("Time/stage_h2d_time", phase="stage_h2d"):
-                        batches = jax.device_put(local_data, burst_sharding)
-                    count_h2d(local_data)
+                # already on device: a ring gather, or a host burst whose
+                # sampling + upload overlapped the previous train burst
+                batches = local_data
                 with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                     # the whole burst (n_samples gradient steps) is ONE dispatch:
                     # per-call overhead on a remote-attached device scales with
@@ -1190,6 +1157,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 # drains the in-flight write) — leave the train loop cleanly
                 break
 
+    staging.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         test(player_fns, jax.device_get(agent_state["params"]), fabric, cfg, log_dir, sample_actions=True)

@@ -41,11 +41,12 @@ from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_check
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import count_h2d, log_sps_metrics, span
+from sheeprl_tpu.obs import log_sps_metrics, span
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -275,7 +276,15 @@ def main(fabric, cfg: Dict[str, Any]):
         actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric, action_scale, action_bias, target_entropy
     )
     critic_sharding = fabric.sharding(None, fabric.data_axis)
-    actor_sharding = fabric.data_sharding
+    # TPU-first replay staging (data/staging.py): device-ring gathers when
+    # buffer.device_ring=True, double-buffered host prefetch otherwise; the
+    # actor batch is the [0] slice of a [1, B, ...] burst, so both batches
+    # flow through the same facade (its burst sharding matches
+    # critic_sharding, and slicing yields the actor's fabric.data_sharding)
+    staging = make_replay_staging(
+        cfg, fabric, rb, batch_sharding=critic_sharding, seed=cfg.seed
+    )
+    rb = staging.rb
 
     last_train = 0
     train_step = 0
@@ -341,23 +350,19 @@ def main(fabric, cfg: Dict[str, Any]):
         obs = next_obs
 
         if update > learning_starts:
-            critic_sample = rb.sample(
-                per_rank_gradient_steps * cfg.per_rank_batch_size * world_size,
+            # both bursts arrive as device arrays: ring-gathered from HBM, or
+            # host-sampled + device_put overlapped with the previous burst
+            critic_batch = staging.sample_device(
+                world_size * cfg.per_rank_batch_size,
+                n_samples=per_rank_gradient_steps,
                 sample_next_obs=cfg.buffer.sample_next_obs,
             )
-            critic_batch = {
-                k: np.reshape(
-                    v, (per_rank_gradient_steps, world_size * cfg.per_rank_batch_size) + v.shape[2:]
-                )
-                for k, v in critic_sample.items()
+            actor_batch = {
+                k: v[0]
+                for k, v in staging.sample_device(
+                    world_size * cfg.per_rank_batch_size
+                ).items()
             }
-            actor_sample = rb.sample(cfg.per_rank_batch_size * world_size)
-            actor_batch = {k: v[0] for k, v in actor_sample.items()}
-            with span("Time/stage_h2d_time", phase="stage_h2d"):
-                critic_batch = jax.device_put(critic_batch, critic_sharding)
-                actor_batch = jax.device_put(actor_batch, actor_sharding)
-            count_h2d(critic_sample)
-            count_h2d(actor_sample)
 
             with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 root_key, train_key = jax.random.split(root_key)
@@ -415,6 +420,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 # drains the in-flight write) — leave the train loop cleanly
                 break
 
+    staging.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         test(actor, agent_state["actor"], scale_j, bias_j, fabric, cfg, log_dir)

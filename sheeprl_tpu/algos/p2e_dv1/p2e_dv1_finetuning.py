@@ -23,11 +23,12 @@ from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_check
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import count_h2d, log_sps_metrics, span
+from sheeprl_tpu.obs import log_sps_metrics, span
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
 
@@ -216,7 +217,19 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         )
     warn_checkpoint_rounding(cfg, policy_steps_per_update)
 
-    data_sharding = fabric.sharding(None, fabric.data_axis)
+    # TPU-first replay staging (data/staging.py): device-ring gathers when
+    # buffer.device_ring=True, double-buffered host prefetch otherwise; the
+    # whole [n, L, B, ...] burst arrives on device in one step, and the
+    # per-gradient-step loop below slices device arrays (no H2D per step)
+    staging = make_replay_staging(
+        cfg,
+        fabric,
+        rb,
+        sequence_length=int(cfg.per_rank_sequence_length),
+        batch_sharding=fabric.sharding(None, None, fabric.data_axis),
+        seed=cfg.seed,
+    )
+    rb = staging.rb
 
     o = envs.reset(seed=cfg.seed)[0]
     obs = prepare_obs(o, cnn_keys, mlp_keys, n_envs)
@@ -321,7 +334,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
 
         if update >= learning_starts and updates_before_training <= 0:
             n_samples = cfg.algo.per_rank_gradient_steps
-            local_data = rb.sample(
+            local_data = staging.sample_device(
                 cfg.per_rank_batch_size * world_size,
                 sequence_length=cfg.per_rank_sequence_length,
                 n_samples=n_samples,
@@ -329,15 +342,10 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 metrics = None
                 for i in range(n_samples):
-                    # ship native dtypes (uint8 pixels = 4x less than f32
-                    # over the host->HBM link) straight to the sharding; the
-                    # train step normalizes on device
-                    sliced = {k: v[i] for k, v in local_data.items()}
-                    batch = jax.device_put(sliced, data_sharding)
-                    # bytes counted here; the staging time is interleaved
-                    # with the gradient-step dispatches and stays inside the
-                    # train phase for this per-sample loop
-                    count_h2d(sliced)
+                    # device-side slice of the staged burst — a [L, B, ...]
+                    # view batch-sharded over the data axis; no per-gradient-
+                    # step host→HBM upload
+                    batch = {k: v[i] for k, v in local_data.items()}
                     root_key, train_key = jax.random.split(root_key)
                     agent_state, metrics = train_fn(agent_state, batch, train_key)
                     per_rank_gradient_steps += 1
@@ -406,6 +414,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 # drains the in-flight write) — leave the train loop cleanly
                 break
 
+    staging.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         final = jax.device_get(agent_state["params"])

@@ -50,12 +50,12 @@ from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_check
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import (
-    count_h2d,
     cost_flops_of,
     get_telemetry,
     log_sps_metrics,
@@ -295,6 +295,12 @@ def main(fabric, cfg: Dict[str, Any]):
         actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric, action_scale, action_bias, target_entropy
     )
     batch_sharding = fabric.sharding(None, fabric.data_axis)
+    # TPU-first replay staging (data/staging.py): device-ring gathers when
+    # buffer.device_ring=True, double-buffered host prefetch otherwise
+    staging = make_replay_staging(
+        cfg, fabric, rb, batch_sharding=batch_sharding, seed=cfg.seed
+    )
+    rb = staging.rb
 
     # Global counters (reference sac.py:206-215)
     last_train = 0
@@ -376,17 +382,13 @@ def main(fabric, cfg: Dict[str, Any]):
         if update >= learning_starts:
             training_steps = learning_starts if update == learning_starts else 1
             g_total = training_steps * per_rank_gradient_steps
-            sample = rb.sample(
-                g_total * cfg.per_rank_batch_size * world_size,
+            # [G, B*world, ...] device arrays: ring-gathered from HBM, or
+            # host-sampled + device_put overlapped with the previous burst
+            batch = staging.sample_device(
+                world_size * cfg.per_rank_batch_size,
+                n_samples=g_total,
                 sample_next_obs=cfg.buffer.sample_next_obs,
-            )  # [1, G*B*world, ...]
-            batch = {
-                k: np.reshape(v, (g_total, world_size * cfg.per_rank_batch_size) + v.shape[2:])
-                for k, v in sample.items()
-            }
-            with span("Time/stage_h2d_time", phase="stage_h2d"):
-                batch = jax.device_put(batch, batch_sharding)
-            count_h2d(sample)
+            )
 
             telemetry = get_telemetry()
             train_specs = None
@@ -455,6 +457,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 # drains the in-flight write) — leave the train loop cleanly
                 break
 
+    staging.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         test(actor, agent_state["actor"], scale_j, bias_j, fabric, cfg, log_dir)

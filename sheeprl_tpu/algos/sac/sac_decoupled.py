@@ -39,12 +39,12 @@ from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_check
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import (
-    count_h2d,
     cost_flops_of,
     get_telemetry,
     log_sps_metrics,
@@ -187,7 +187,16 @@ def main(fabric, cfg: Dict[str, Any]):
     # the latest broadcast params and appends to the shared host buffer
     # ------------------------------------------------------------------
 
-    rb_lock = threading.Lock()
+    # reentrant: the staging facade binds this same lock into the buffer's
+    # add, so the player's explicit `with rb_lock` wrapper re-acquires it
+    rb_lock = threading.RLock()
+    # TPU-first replay staging (data/staging.py): device-ring gathers when
+    # buffer.device_ring=True, double-buffered host prefetch otherwise; the
+    # shared lock serializes the player's adds against background sampling
+    staging = make_replay_staging(
+        cfg, fabric, rb, batch_sharding=batch_sharding, seed=cfg.seed, lock=rb_lock
+    )
+    rb = staging.rb
     step_cv = threading.Condition()
     # collected/trained counters bound the player's lead to one step (the
     # reference player blocks on the per-step param exchange, :291-294)
@@ -312,18 +321,14 @@ def main(fabric, cfg: Dict[str, Any]):
             if update >= learning_starts:
                 training_steps = learning_starts if update == learning_starts else 1
                 g_total = max(training_steps, 1) * per_rank_gradient_steps
-                with rb_lock:
-                    sample = rb.sample(
-                        g_total * cfg.per_rank_batch_size * world_size,
-                        sample_next_obs=cfg.buffer.sample_next_obs,
-                    )
-                batch = {
-                    k: np.reshape(v, (g_total, world_size * cfg.per_rank_batch_size) + v.shape[2:])
-                    for k, v in sample.items()
-                }
-                with span("Time/stage_h2d_time", phase="stage_h2d"):
-                    batch = jax.device_put(batch, batch_sharding)
-                count_h2d(sample)
+                # [G, B*world, ...] device arrays: ring-gathered from HBM,
+                # or host-sampled + device_put overlapped with the previous
+                # burst (sampling serializes on rb_lock against player adds)
+                batch = staging.sample_device(
+                    world_size * cfg.per_rank_batch_size,
+                    n_samples=g_total,
+                    sample_next_obs=cfg.buffer.sample_next_obs,
+                )
 
                 with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                     root_key, train_key = jax.random.split(root_key)
@@ -401,6 +406,7 @@ def main(fabric, cfg: Dict[str, Any]):
         player_thread.join(timeout=30)
         if watchdog is not None:
             watchdog.stop()
+        staging.close()
         envs.close()
 
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():

@@ -36,11 +36,12 @@ from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_check
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import count_h2d, log_sps_metrics, span
+from sheeprl_tpu.obs import log_sps_metrics, span
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -379,6 +380,12 @@ def main(fabric, cfg: Dict[str, Any]):
         action_scale, action_bias, target_entropy,
     )
     batch_sharding = fabric.sharding(None, fabric.data_axis)
+    # TPU-first replay staging (data/staging.py): device-ring gathers when
+    # buffer.device_ring=True, double-buffered host prefetch otherwise
+    staging = make_replay_staging(
+        cfg, fabric, rb, batch_sharding=batch_sharding, seed=cfg.seed
+    )
+    rb = staging.rb
 
     last_train = 0
     train_step = 0
@@ -462,22 +469,15 @@ def main(fabric, cfg: Dict[str, Any]):
         if update >= learning_starts:
             training_steps = learning_starts if update == learning_starts else 1
             g_total = training_steps * per_rank_gradient_steps
-            sample = rb.sample(
-                g_total * cfg.per_rank_batch_size * world_size,
+            # [G, B*world, ...] device arrays: ring-gathered from HBM, or
+            # host-sampled + device_put overlapped with the previous burst
+            # (native dtypes either way: uint8 pixels are 4x cheaper over
+            # the host->HBM link; the train step normalizes on device)
+            batch = staging.sample_device(
+                world_size * cfg.per_rank_batch_size,
+                n_samples=g_total,
                 sample_next_obs=cfg.buffer.sample_next_obs,
             )
-            # native dtypes: uint8 pixels are 4x cheaper over the
-            # host->HBM link; the train step normalizes on device
-            batch = {
-                k: np.reshape(
-                    np.asarray(v),
-                    (g_total, world_size * cfg.per_rank_batch_size) + v.shape[2:],
-                )
-                for k, v in sample.items()
-            }
-            with span("Time/stage_h2d_time", phase="stage_h2d"):
-                batch = jax.device_put(batch, batch_sharding)
-            count_h2d(sample)
 
             with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 root_key, train_key = jax.random.split(root_key)
@@ -541,6 +541,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 # drains the in-flight write) — leave the train loop cleanly
                 break
 
+    staging.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         test(

@@ -4,10 +4,23 @@ from sheeprl_tpu.data.buffers import (
     ReplayBuffer,
     SequentialReplayBuffer,
 )
+from sheeprl_tpu.data.device_ring import DeviceRingReplay, DeviceRingTransitions
+from sheeprl_tpu.data.staging import (
+    HostStaging,
+    ReplayStaging,
+    RingStaging,
+    make_replay_staging,
+)
 
 __all__ = [
+    "DeviceRingReplay",
+    "DeviceRingTransitions",
     "EnvIndependentReplayBuffer",
     "EpisodeBuffer",
+    "HostStaging",
     "ReplayBuffer",
+    "ReplayStaging",
+    "RingStaging",
     "SequentialReplayBuffer",
+    "make_replay_staging",
 ]

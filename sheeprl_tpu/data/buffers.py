@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import os
 import uuid
+from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Type, Union
 
 import numpy as np
@@ -75,6 +76,7 @@ class ReplayBuffer:
         self._pos = 0
         self._full = False
         self._rng: np.random.Generator = np.random.default_rng()
+        self._write_lock: Optional[Any] = None
 
     # -- properties -------------------------------------------------------
 
@@ -107,6 +109,15 @@ class ReplayBuffer:
 
     def seed(self, seed: Optional[int] = None) -> None:
         self._rng = np.random.default_rng(seed)
+
+    def bind_write_lock(self, lock: Any) -> None:
+        """Serialize ``add`` against a background sampler.
+
+        The replay-staging prefetch pipeline (``data/staging.py``) samples
+        burst *k+1* on a worker thread while the train program runs burst
+        *k*; binding the pipeline's lock here makes every mutation take it,
+        so a concurrent ``add`` can never tear a row mid-sample."""
+        self._write_lock = lock
 
     # -- storage ----------------------------------------------------------
 
@@ -166,19 +177,20 @@ class ReplayBuffer:
         data = {k: np.asarray(v) for k, v in data.items()}
         first = next(iter(data.values()))
         data_len = first.shape[0]
-        if self._buf is None:
-            self._allocate(data)
-        next_pos = (self._pos + data_len) % self._buffer_size
-        # only the trailing window survives, written at the positions it would
-        # have landed on had every step been inserted one by one
-        write_len = min(data_len, self._buffer_size)
-        start = self._pos + data_len - write_len
-        idxes = np.arange(start, start + write_len) % self._buffer_size
-        for k, v in data.items():
-            self._buf[k][idxes] = v[-write_len:]
-        if self._pos + data_len >= self._buffer_size:
-            self._full = True
-        self._pos = next_pos
+        with self._write_lock or nullcontext():
+            if self._buf is None:
+                self._allocate(data)
+            next_pos = (self._pos + data_len) % self._buffer_size
+            # only the trailing window survives, written at the positions it
+            # would have landed on had every step been inserted one by one
+            write_len = min(data_len, self._buffer_size)
+            start = self._pos + data_len - write_len
+            idxes = np.arange(start, start + write_len) % self._buffer_size
+            for k, v in data.items():
+                self._buf[k][idxes] = v[-write_len:]
+            if self._pos + data_len >= self._buffer_size:
+                self._full = True
+            self._pos = next_pos
 
     # -- sampling ---------------------------------------------------------
 
@@ -194,15 +206,23 @@ class ReplayBuffer:
             return np.arange(self._buffer_size)
         return np.arange(self._pos)
 
-    def sample(
+    def plan_transitions(
         self,
         batch_size: int,
         sample_next_obs: bool = False,
-        clone: bool = False,
         n_samples: int = 1,
-        **kwargs: Any,
-    ) -> Dict[str, np.ndarray]:
-        """Uniformly sample ``[n_samples, batch_size, ...]`` transitions."""
+        rng: Optional[np.random.Generator] = None,
+        envs: Optional[Sequence[int]] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Draw ``batch_size * n_samples`` uniform ``(t_idx, e_idx)`` pairs —
+        the single source of the valid-window semantics (no stored successor
+        for the newest row under ``sample_next_obs``), shared by host sampling
+        and the device-ring transition gather planner (data/device_ring.py).
+
+        ``envs`` restricts the env draw to a subset (uniform within it) — the
+        sharded ring plans each device's batch columns among the envs homed on
+        that device, like the sequence ring's per-group ``pick_envs``."""
+        rng = self._rng if rng is None else rng
         if batch_size <= 0 or n_samples <= 0:
             raise ValueError(
                 f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
@@ -220,8 +240,24 @@ class ReplayBuffer:
                 )
             raise ValueError("No valid sample index to draw from")
         total = batch_size * n_samples
-        t_idx = valid[self._rng.integers(0, len(valid), size=total)]
-        e_idx = self._rng.integers(0, self._n_envs, size=total)
+        t_idx = valid[rng.integers(0, len(valid), size=total)]
+        if envs is None:
+            e_idx = rng.integers(0, self._n_envs, size=total)
+        else:
+            envs_arr = np.asarray(envs, dtype=np.int64)
+            e_idx = envs_arr[rng.integers(0, len(envs_arr), size=total)]
+        return t_idx, e_idx
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        """Uniformly sample ``[n_samples, batch_size, ...]`` transitions."""
+        t_idx, e_idx = self.plan_transitions(batch_size, sample_next_obs, n_samples)
         out = self._gather(t_idx, e_idx, sample_next_obs, clone)
         return {k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in out.items()}
 
@@ -452,6 +488,7 @@ class EpisodeBuffer:
         self._open_episodes: List[List[Dict[str, np.ndarray]]] = [[] for _ in range(n_envs)]
         self._cum_steps = 0  # running step count; kept in sync by save/evict
         self._rng: np.random.Generator = np.random.default_rng()
+        self._write_lock: Optional[Any] = None
 
     # -- properties -------------------------------------------------------
 
@@ -492,6 +529,10 @@ class EpisodeBuffer:
 
     def seed(self, seed: Optional[int] = None) -> None:
         self._rng = np.random.default_rng(seed)
+
+    def bind_write_lock(self, lock: Any) -> None:
+        """Serialize ``add`` against a background sampler (see ReplayBuffer)."""
+        self._write_lock = lock
 
     # -- insertion --------------------------------------------------------
 
@@ -549,9 +590,10 @@ class EpisodeBuffer:
                 raise RuntimeError(
                     f"`data` has {n_cols} env columns but {len(env_idxes)} env indices were given"
                 )
-        for col, env in enumerate(env_idxes):
-            chunk = {k: np.asarray(v)[:, col] for k, v in data.items()}
-            self._add_env_chunk(chunk, env)
+        with self._write_lock or nullcontext():
+            for col, env in enumerate(env_idxes):
+                chunk = {k: np.asarray(v)[:, col] for k, v in data.items()}
+                self._add_env_chunk(chunk, env)
 
     def _add_env_chunk(self, chunk: Dict[str, np.ndarray], env: int) -> None:
         dones = chunk["dones"].reshape(len(chunk["dones"]), -1)[:, 0]
@@ -750,6 +792,7 @@ class EnvIndependentReplayBuffer:
         self._buffer_cls = buffer_cls
         self._concat_along_axis = 2 if issubclass(buffer_cls, SequentialReplayBuffer) else 1
         self._rng: np.random.Generator = np.random.default_rng()
+        self._write_lock: Optional[Any] = None
         self._buf: List[ReplayBuffer] = [
             buffer_cls(
                 buffer_size,
@@ -787,6 +830,10 @@ class EnvIndependentReplayBuffer:
         for i, b in enumerate(self._buf):
             b.seed(None if seed is None else seed + i)
 
+    def bind_write_lock(self, lock: Any) -> None:
+        """Serialize ``add`` against a background sampler (see ReplayBuffer)."""
+        self._write_lock = lock
+
     def add(
         self,
         data: Dict[str, np.ndarray],
@@ -805,11 +852,12 @@ class EnvIndependentReplayBuffer:
                 raise ValueError(
                     f"The indices of the environment must be integers in [0, {self._n_envs}), given {idx}"
                 )
-        for col, env in enumerate(env_idxes):
-            self._buf[env].add(
-                {k: np.asarray(v)[:, col : col + 1] for k, v in data.items()},
-                validate_args=validate_args,
-            )
+        with self._write_lock or nullcontext():
+            for col, env in enumerate(env_idxes):
+                self._buf[env].add(
+                    {k: np.asarray(v)[:, col : col + 1] for k, v in data.items()},
+                    validate_args=validate_args,
+                )
 
     def pick_envs(
         self,

@@ -40,40 +40,51 @@ Design:
 
 from __future__ import annotations
 
+from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, _as_np
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, ReplayBuffer, _as_np
 from sheeprl_tpu.obs.counters import staged_device_put
 
-__all__ = ["DeviceRingReplay"]
+__all__ = ["DeviceRingReplay", "DeviceRingTransitions"]
 
 
 def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
-def _batch_shard_count(batch_sharding) -> int:
-    """Distinct shards along the batch axis (dim 2) of the burst sharding.
+def _pad_rows(n: int) -> int:
+    """Flush-scatter padding: next power of two.
 
-    The ring expects the burst layout ``[n_samples, seq, batch, ...]`` with
-    only dim 2 sharded (e.g. ``P(None, None, 'data')``). A spec that shards
-    some other dim — say a caller passed ``P('data')`` meant for a different
-    layout — would quietly build one shard here and then blow up deep inside
-    ``make_array_from_single_device_arrays`` at sample time, far from the
-    mistake, so validate eagerly.
+    A fixed 32-row bucket compiled few programs but uploaded up to 32x the
+    staged bytes in the steady state (one collected row per training burst,
+    padded to a full bucket, every burst); power-of-two buckets bound the
+    padding waste at <2x while still reusing ~log2(max flush) compiled
+    scatter programs."""
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def _batch_shard_count(batch_sharding, batch_dim: int = 2, layout: str = "[n_samples, seq, batch, ...]") -> int:
+    """Distinct shards along the batch axis (``batch_dim``) of the sharding.
+
+    The ring expects only the batch dim sharded (e.g. ``P(None, None, 'data')``
+    for the sequence burst, ``P(None, 'data')`` for the transition burst). A
+    spec that shards some other dim — say a caller passed ``P('data')`` meant
+    for a different layout — would quietly build one shard here and then blow
+    up deep inside ``make_array_from_single_device_arrays`` at sample time,
+    far from the mistake, so validate eagerly.
     """
     spec = tuple(batch_sharding.spec)
     for dim, entry in enumerate(spec):
-        if dim != 2 and entry is not None:
+        if dim != batch_dim and entry is not None:
             raise ValueError(
-                "DeviceRingReplay batch_sharding must shard only the batch "
-                f"axis (dim 2) of the [n_samples, seq, batch, ...] burst; got "
-                f"PartitionSpec{spec} which shards dim {dim}. Pass e.g. "
-                "NamedSharding(mesh, P(None, None, 'data'))."
+                "Device-ring batch_sharding must shard only the batch "
+                f"axis (dim {batch_dim}) of the {layout} burst; got "
+                f"PartitionSpec{spec} which shards dim {dim}."
             )
-    entry = spec[2] if len(spec) > 2 else None
+    entry = spec[batch_dim] if len(spec) > batch_dim else None
     if entry is None:
         return 1
     axes = (entry,) if isinstance(entry, str) else tuple(entry)
@@ -81,6 +92,84 @@ def _batch_shard_count(batch_sharding) -> int:
     for a in axes:
         size *= int(batch_sharding.mesh.shape[a])
     return size
+
+
+def _homes_for_sharding(batch_sharding, batch_dim: int, n_groups: int) -> Tuple[List[Any], List[List[Any]]]:
+    """Device that OWNS batch slice g (plus replicas along other mesh axes):
+    probe the index map with a shape of ``n_groups`` along the batch dim —
+    slice starts enumerate the shard order along that dim."""
+    probe_shape = tuple(n_groups if d == batch_dim else 1 for d in range(batch_dim + 1))
+    probe = batch_sharding.addressable_devices_indices_map(probe_shape)
+    by_slice: Dict[int, List[Any]] = {}
+    for dev, idx in probe.items():
+        start = idx[batch_dim].start or 0
+        by_slice.setdefault(int(start), []).append(dev)
+    if sorted(by_slice) != list(range(n_groups)):
+        raise ValueError(
+            "Device ring: batch sharding is not addressable shard-per-slice "
+            "from this process (multi-host meshes must pass a process-local "
+            "batch sharding)"
+        )
+    homes = [sorted(by_slice[g], key=lambda d: d.id)[0] for g in range(n_groups)]
+    replicas = [
+        [d for d in sorted(by_slice[g], key=lambda d: d.id) if d is not homes[g]]
+        for g in range(n_groups)
+    ]
+    return homes, replicas
+
+
+def _check_hbm_budget(device, rows: int, bytes_per_row: int, kind: str, fit_rows, n_envs: int) -> None:
+    """Pre-allocation HBM guard shared by both rings: ``rows x bytes_per_row``
+    is the largest single-device shard. Fail with the computed size (and the
+    ``buffer.size`` that would fit in half the device, via ``fit_rows(limit)``)
+    instead of an opaque XLA allocation error later; warn when the ring would
+    crowd the device. With DV3's default buffer.size=1e6 of 64x64x3 uint8
+    pixels the whole ring is ~12 GB before model/optimizer state."""
+    import warnings
+
+    from sheeprl_tpu.obs.counters import device_memory_stats
+
+    total = rows * bytes_per_row
+    stats = device_memory_stats(device)
+    limit = stats.get("bytes_limit") if stats else None
+    if limit and total > 0.95 * limit:
+        # certain OOM: the ring alone leaves no room for params/optimizer
+        rows_fit = max(int(fit_rows(limit)), 0)
+        raise ValueError(
+            f"{kind} would allocate {total / 2**30:.2f} GiB "
+            f"({rows} rows x {bytes_per_row} B) on a device with a "
+            f"{limit / 2**30:.2f} GiB limit; a ring of <= {rows_fit} per-env "
+            f"rows fits in half the device (buffer.size <= "
+            f"{rows_fit * n_envs} under the buffer.size//n_envs "
+            "convention), or disable buffer.device_ring"
+        )
+    if (limit and total > 0.6 * limit) or total > 4 * 2**30:
+        warnings.warn(
+            f"{kind} allocating {total / 2**30:.2f} GiB of HBM "
+            f"per device ({rows} per-env rows x {bytes_per_row} B"
+            + (f", device limit {limit / 2**30:.2f} GiB" if limit else "")
+            + "); lower buffer.size if the device OOMs",
+            UserWarning,
+        )
+
+
+def _assemble_global(parts: List[Dict[str, Any]], sharding, replicas, batch_dim: int, batch_size: int) -> Dict[str, Any]:
+    """Assemble per-group device gathers into global sharded Arrays: shard
+    *g* is already resident on its home device; replicas along non-batch
+    mesh axes (if any) receive a copy. No resharding collective."""
+    import jax
+
+    out: Dict[str, Any] = {}
+    for k in parts[0]:
+        shape = parts[0][k].shape
+        global_shape = shape[:batch_dim] + (batch_size,) + shape[batch_dim + 1 :]
+        arrays = []
+        for g, part in enumerate(parts):
+            arrays.append(part[k])
+            for dev in replicas[g]:
+                arrays.append(jax.device_put(part[k], dev))
+        out[k] = jax.make_array_from_single_device_arrays(global_shape, sharding, arrays)
+    return out
 
 
 class DeviceRingReplay:
@@ -93,8 +182,9 @@ class DeviceRingReplay:
     arrays are global jax Arrays sharded batch-wise over the mesh.
     """
 
-    #: flush scatters are padded to multiples of this many rows so repeated
-    #: bursts reuse a few compiled programs instead of one per row count
+    #: host-side staging threshold: a flush is forced once 8x this many
+    #: rows are staged (bounds staging memory during collection-only phases);
+    #: the scatter itself pads to power-of-two buckets (_pad_rows)
     FLUSH_BUCKET = 32
 
     def __init__(
@@ -131,25 +221,7 @@ class DeviceRingReplay:
                     f"batch shard: n_envs={self._n_envs} does not divide over "
                     f"{n_groups} data-axis shards"
                 )
-            # device that OWNS batch slice g (plus any replicas along other
-            # mesh axes): probe the index map with a [1, 1, n_groups] shape —
-            # slice starts enumerate the shard order along the batch dim
-            probe = batch_sharding.addressable_devices_indices_map((1, 1, n_groups))
-            by_slice: Dict[int, List[Any]] = {}
-            for dev, idx in probe.items():
-                start = idx[2].start or 0
-                by_slice.setdefault(int(start), []).append(dev)
-            if sorted(by_slice) != list(range(n_groups)):
-                raise ValueError(
-                    "DeviceRingReplay: batch sharding is not addressable shard-"
-                    "per-slice from this process (multi-host meshes must pass "
-                    "a process-local batch sharding)"
-                )
-            self._homes = [sorted(by_slice[g], key=lambda d: d.id)[0] for g in range(n_groups)]
-            self._replicas = [
-                [d for d in sorted(by_slice[g], key=lambda d: d.id) if d is not self._homes[g]]
-                for g in range(n_groups)
-            ]
+            self._homes, self._replicas = _homes_for_sharding(batch_sharding, 2, n_groups)
         else:
             self._homes = [device if device is not None else jax.devices()[0]]
             self._replicas = [[]]
@@ -173,6 +245,12 @@ class DeviceRingReplay:
         self._staged: List[Tuple[int, int]] = []
         self._scatter_fns: Dict[int, Any] = {}
         self._gather_fns: Dict[Tuple[int, int, int], Any] = {}
+        self._write_lock: Optional[Any] = None
+        # wrapping a buffer that already holds data (e.g. restored from a
+        # checkpoint before the ring was constructed): mirror it now instead
+        # of depending on wrap-then-load call order
+        if any(not sub.empty for sub in host_rb.buffer):
+            self._remirror_from_host()
 
     # -- proxied host surface ---------------------------------------------
 
@@ -209,15 +287,27 @@ class DeviceRingReplay:
         self._rb.seed(seed)
         self._rng = np.random.default_rng(seed)
 
+    def bind_write_lock(self, lock: Any) -> None:
+        """Serialize ``add``/``force_done_last`` against a concurrent
+        ``sample_device`` (decoupled player/trainer threads): the staged-slot
+        list and the host mirror are shared mutable state."""
+        self._write_lock = lock
+
     def state_dict(self) -> Dict[str, Any]:
         return self._rb.state_dict()
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         """Restore the host buffer, then re-mirror its filled region to the
         device shards as one contiguous block upload per key per shard."""
+        self._rb.load_state_dict(state)
+        self._remirror_from_host()
+
+    def _remirror_from_host(self) -> None:
+        """Rebuild the device shards from whatever the host buffer holds —
+        after a checkpoint restore, or at construction when wrapping a buffer
+        that was filled/restored before the ring existed."""
         import jax
 
-        self._rb.load_state_dict(state)
         self._shards = None
         self._staged.clear()
         n_rows = np.zeros(self._n_envs, np.int64)
@@ -271,71 +361,54 @@ class DeviceRingReplay:
     ) -> None:
         if env_idxes is None:
             env_idxes = list(range(self._n_envs))
-        # capture write targets before the host add advances them (and let a
-        # failing host add leave the mirror untouched)
-        targets = [int(self._rb.buffer[env]._pos) for env in env_idxes]
-        self._rb.add(data, env_idxes, validate_args=validate_args)
-        rows = next(iter(data.values())).shape[0]
-        for col, env in enumerate(env_idxes):
-            for r in range(rows):
-                self._staged.append((env, (targets[col] + r) % self._capacity))
-        # bound host-side staging memory (and batch the upload) during long
-        # collection-only phases such as the learning_starts prefill
-        if len(self._staged) >= 8 * self.FLUSH_BUCKET:
-            self._flush()
+        with self._write_lock or nullcontext():
+            # capture write targets before the host add advances them (and let
+            # a failing host add leave the mirror untouched)
+            targets = [int(self._rb.buffer[env]._pos) for env in env_idxes]
+            self._rb.add(data, env_idxes, validate_args=validate_args)
+            rows = next(iter(data.values())).shape[0]
+            for col, env in enumerate(env_idxes):
+                for r in range(rows):
+                    self._staged.append((env, (targets[col] + r) % self._capacity))
+            # bound host-side staging memory (and batch the upload) during long
+            # collection-only phases such as the learning_starts prefill
+            if len(self._staged) >= 8 * self.FLUSH_BUCKET:
+                self._flush()
 
     def force_done_last(self, env: int) -> None:
         """Fault-tolerance patch (reference dreamer_v3.py:642-650): mark the
         most recent stored step of ``env`` as terminal on both copies."""
-        sub = self._rb.buffer[env]
-        last_idx = (sub._pos - 1) % sub.buffer_size
-        sub["dones"][last_idx] = np.ones_like(sub["dones"][last_idx])
-        sub["is_first"][last_idx] = np.zeros_like(sub["is_first"][last_idx])
-        self._staged.append((env, int(last_idx)))
+        with self._write_lock or nullcontext():
+            sub = self._rb.buffer[env]
+            last_idx = (sub._pos - 1) % sub.buffer_size
+            sub["dones"][last_idx] = np.ones_like(sub["dones"][last_idx])
+            if "is_first" in sub:
+                # DV1-family buffers store no is_first column; keep behavior
+                # identical to the host-path patch (staging.py)
+                sub["is_first"][last_idx] = np.zeros_like(sub["is_first"][last_idx])
+            self._staged.append((env, int(last_idx)))
 
     # -- device plumbing ---------------------------------------------------
 
     def _allocate(self, example_row: Dict[str, np.ndarray]) -> None:
-        import warnings
-
         import jax
         import jax.numpy as jnp
 
-        # every shard is (capacity + overlap) x group_envs of EVERY key in
-        # HBM; with DV3's default buffer.size=1e6 of 64x64x3 uint8 pixels the
-        # whole ring is ~12 GB before model/optimizer state. Fail with the
-        # computed size (and the size that fits) instead of an opaque XLA
-        # allocation error later.
+        # every shard is (capacity + overlap) x group_envs of EVERY key in HBM
         rows = self._capacity + self._overlap
         max_group = max(len(g) for g in self._groups)
         bytes_per_row = sum(
             int(np.prod(np.asarray(v).shape)) * np.asarray(v).dtype.itemsize * max_group
             for v in example_row.values()
         )
-        total = rows * bytes_per_row  # largest single-device shard
-        from sheeprl_tpu.obs.counters import device_memory_stats
-
-        stats = device_memory_stats(self._homes[0])
-        limit = stats.get("bytes_limit") if stats else None
-        if limit and total > 0.95 * limit:
-            # certain OOM: the ring alone leaves no room for params/optimizer
-            fit_rows = max(int(0.5 * limit / max(bytes_per_row, 1)) - self._overlap, 0)
-            raise ValueError(
-                f"DeviceRingReplay would allocate {total / 2**30:.2f} GiB "
-                f"({rows} rows x {bytes_per_row} B) on a device with a "
-                f"{limit / 2**30:.2f} GiB limit; a ring of <= {fit_rows} per-env "
-                f"rows fits in half the device (buffer.size <= "
-                f"{fit_rows * self._n_envs} under the buffer.size//n_envs "
-                "convention), or disable buffer.device_ring"
-            )
-        if (limit and total > 0.6 * limit) or total > 4 * 2**30:
-            warnings.warn(
-                f"DeviceRingReplay allocating {total / 2**30:.2f} GiB of HBM "
-                f"per device ({rows} per-env rows x {bytes_per_row} B"
-                + (f", device limit {limit / 2**30:.2f} GiB" if limit else "")
-                + "); lower buffer.size if the device OOMs",
-                UserWarning,
-            )
+        _check_hbm_budget(
+            self._homes[0],
+            rows,
+            bytes_per_row,
+            "DeviceRingReplay",
+            lambda limit: int(0.5 * limit / max(bytes_per_row, 1)) - self._overlap,
+            self._n_envs,
+        )
         self._shards = []
         for g, envs in enumerate(self._groups):
             with jax.default_device(self._homes[g]):
@@ -389,7 +462,7 @@ class DeviceRingReplay:
             if sel.size == 0:
                 continue
             n = int(sel.size)
-            padded = _round_up(n, self.FLUSH_BUCKET)
+            padded = _pad_rows(n)
             t_idx = np.full(padded, oob, np.int32)  # OOB → dropped
             e_idx = np.zeros(padded, np.int32)
             t_idx[:n] = slots_arr[sel, 1]
@@ -524,33 +597,378 @@ class DeviceRingReplay:
                 f"batch_size {batch_size} must divide evenly over the "
                 f"{n_groups} batch shards"
             )
-        self._flush()
-        if self._shards is None:
-            raise ValueError("No sample has been added to the buffer")
-        b_local = batch_size // n_groups
-        parts: List[Dict[str, Any]] = []
-        for g, envs in enumerate(self._groups):
-            starts, cols = self._plan_group(envs, b_local, sequence_length, n_samples)
-            fn = self._gather_fn(starts.shape[0], sequence_length, n_samples)
-            # the index plan is the ONLY host→device traffic of a ring sample;
-            # counting it keeps the telemetry's bytes_staged_h2d an honest
-            # total (and shows how little the ring ships vs host staging)
-            starts, cols = staged_device_put((starts, cols), self._homes[g])
-            parts.append(fn(self._shards[g], starts, cols))
+        with self._write_lock or nullcontext():
+            self._flush()
+            if self._shards is None:
+                raise ValueError("No sample has been added to the buffer")
+            b_local = batch_size // n_groups
+            parts: List[Dict[str, Any]] = []
+            for g, envs in enumerate(self._groups):
+                starts, cols = self._plan_group(envs, b_local, sequence_length, n_samples)
+                fn = self._gather_fn(starts.shape[0], sequence_length, n_samples)
+                # the index plan is the ONLY host→device traffic of a ring
+                # sample; counting it keeps the telemetry's bytes_staged_h2d an
+                # honest total (and shows how little the ring ships vs host
+                # staging)
+                starts, cols = staged_device_put((starts, cols), self._homes[g])
+                parts.append(fn(self._shards[g], starts, cols))
         if self._sharding is None:
             return parts[0]
-        # assemble the global batch: shard g is already resident on its home
-        # device; replicas along non-data mesh axes (if any) receive a copy
-        out: Dict[str, Any] = {}
-        for k in parts[0]:
-            shape = parts[0][k].shape
-            global_shape = (shape[0], shape[1], batch_size) + shape[3:]
-            arrays = []
-            for g in range(n_groups):
-                arrays.append(parts[g][k])
-                for dev in self._replicas[g]:
-                    arrays.append(jax.device_put(parts[g][k], dev))
-            out[k] = jax.make_array_from_single_device_arrays(
-                global_shape, self._sharding, arrays
+        return _assemble_global(parts, self._sharding, self._replicas, 2, batch_size)
+
+
+class DeviceRingTransitions:
+    """Flat-transition device ring: wrap a :class:`ReplayBuffer` with a
+    device-side mirror for SAC-style ``[n_samples, batch, ...]`` bursts.
+
+    The sequence ring above serves the Dreamer family's
+    ``EnvIndependentReplayBuffer``; this class serves the flat uniform-replay
+    algos (SAC, SAC-AE, DroQ): ``add`` forwards to the host buffer and stages
+    the written time rows for a lazy scatter; ``sample_device`` plans
+    ``(t, env)`` pairs **with the host buffer's own**
+    :meth:`ReplayBuffer.plan_transitions` (so the valid-window and
+    ``sample_next_obs`` semantics cannot diverge from the host path) and
+    gathers the batch on device — including the derived ``next_<obs_key>``
+    rows at ``(t + 1) % capacity``, which never cross the host link at all.
+
+    With ``batch_sharding`` (a ``[n_samples, batch, ...]`` sharding with only
+    dim 1 sharded, e.g. ``P(None, 'data')``) the ring shards env-wise over the
+    mesh exactly like the sequence ring: each device stores the columns of the
+    envs homed on it and gathers the batch slice it consumes, assembled with
+    ``make_array_from_single_device_arrays`` — no resharding collective.
+    """
+
+    #: host-side staging threshold: a flush is forced once 8x this many
+    #: time rows are staged (bounds staging memory during collection-only
+    #: phases); the scatter itself pads to power-of-two buckets (_pad_rows)
+    FLUSH_BUCKET = 32
+
+    def __init__(
+        self,
+        host_rb: ReplayBuffer,
+        device: Optional[Any] = None,
+        seed: Optional[int] = None,
+        batch_sharding: Optional[Any] = None,
+    ):
+        import jax
+
+        if isinstance(host_rb, EnvIndependentReplayBuffer):
+            raise TypeError(
+                "DeviceRingTransitions wraps a flat ReplayBuffer; use "
+                "DeviceRingReplay for EnvIndependentReplayBuffer sequence rings"
             )
-        return out
+        self._rb = host_rb
+        self._capacity = int(host_rb.buffer_size)
+        self._n_envs = int(host_rb.n_envs)
+        self._rng = np.random.default_rng(seed)
+        self._sharding = batch_sharding
+
+        if batch_sharding is not None:
+            n_groups = _batch_shard_count(batch_sharding, 1, "[n_samples, batch, ...]")
+            if self._n_envs < n_groups or self._n_envs % n_groups != 0:
+                raise ValueError(
+                    f"DeviceRingTransitions needs the same number of envs on "
+                    f"every batch shard: n_envs={self._n_envs} does not divide "
+                    f"over {n_groups} data-axis shards"
+                )
+            self._homes, self._replicas = _homes_for_sharding(batch_sharding, 1, n_groups)
+        else:
+            self._homes = [device if device is not None else jax.devices()[0]]
+            self._replicas = [[]]
+
+        n_groups = len(self._homes)
+        self._groups: List[np.ndarray] = [
+            np.asarray(g, np.int64) for g in np.array_split(np.arange(self._n_envs), n_groups)
+        ]
+        self._env_col = np.empty(self._n_envs, np.int64)
+        for envs in self._groups:
+            self._env_col[envs] = np.arange(len(envs))
+        # index plans ship as ONE packed int32 per transition (t * width + col,
+        # decoded on device): the plan is the only recurring host→device
+        # upload of a ring sample, so halving it doubles the staging win
+        self._group_width = len(self._groups[0])
+        if self._capacity * self._group_width >= 2**31:
+            raise ValueError(
+                f"DeviceRingTransitions index plan would overflow int32: "
+                f"{self._capacity} rows x {self._group_width} envs per shard "
+                "(such a ring cannot fit in HBM anyway; lower buffer.size)"
+            )
+
+        # per-group device storage, allocated lazily on the first flush
+        self._shards: Optional[List[Dict[str, Any]]] = None
+        # staged time rows; values are read back from the host buffer at
+        # flush time (it owns the newest copy of every slot)
+        self._staged: List[int] = []
+        self._scatter_fns: Dict[int, Any] = {}
+        self._gather_fns: Dict[Tuple[int, int, bool], Any] = {}
+        self._write_lock: Optional[Any] = None
+        # wrapping a buffer that already holds data (e.g. restored from a
+        # checkpoint before the ring was constructed): mirror it now instead
+        # of depending on wrap-then-load call order
+        if not host_rb.empty:
+            self._remirror_from_host()
+
+    # -- proxied host surface ---------------------------------------------
+
+    @property
+    def host(self) -> ReplayBuffer:
+        return self._rb
+
+    @property
+    def buffer(self):
+        return self._rb.buffer
+
+    @property
+    def buffer_size(self) -> int:
+        return self._rb.buffer_size
+
+    @property
+    def n_envs(self) -> int:
+        return self._rb.n_envs
+
+    @property
+    def full(self) -> bool:
+        return self._rb.full
+
+    @property
+    def empty(self) -> bool:
+        return self._rb.empty
+
+    @property
+    def is_memmap(self) -> bool:
+        return self._rb.is_memmap
+
+    @property
+    def _device(self):
+        return self._homes[0]
+
+    @property
+    def _buf(self) -> Optional[Dict[str, Any]]:
+        """Single-shard view (tests / single-device introspection)."""
+        if self._shards is None:
+            return None
+        if len(self._shards) != 1:
+            raise AttributeError("_buf is only defined for single-shard rings")
+        return self._shards[0]
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self._rb.seed(seed)
+        self._rng = np.random.default_rng(seed)
+
+    def bind_write_lock(self, lock: Any) -> None:
+        """Serialize ``add`` against a concurrent ``sample_device``."""
+        self._write_lock = lock
+
+    def state_dict(self) -> Dict[str, Any]:
+        return self._rb.state_dict()
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        """Restore the host buffer, then re-mirror its filled region to the
+        device shards as one contiguous block upload per key per shard."""
+        self._rb.load_state_dict(state)
+        self._remirror_from_host()
+
+    def _remirror_from_host(self) -> None:
+        """Rebuild the device shards from whatever the host buffer holds —
+        after a checkpoint restore, or at construction when wrapping a buffer
+        that was filled/restored before the ring existed."""
+        import jax
+
+        self._shards = None
+        self._staged.clear()
+        if self._rb.buffer is None:
+            return
+        n_rows = self._capacity if self._rb.full else int(self._rb._pos)
+        if n_rows == 0:
+            return
+        example = {k: _as_np(v)[0] for k, v in self._rb.buffer.items()}
+        self._allocate(example)
+        set_block = jax.jit(
+            lambda buf, blk: {k: v.at[: next(iter(blk.values())).shape[0]].set(blk[k]) for k, v in buf.items()},
+            donate_argnums=(0,),
+        )
+        for g, envs in enumerate(self._groups):
+            blocks = {
+                k: np.ascontiguousarray(_as_np(v)[:n_rows][:, envs])
+                for k, v in self._rb.buffer.items()
+            }
+            blocks = staged_device_put(blocks, self._homes[g])
+            self._shards[g] = set_block(self._shards[g], blocks)
+
+    # -- write path --------------------------------------------------------
+
+    def add(self, data: Dict[str, np.ndarray], validate_args: bool = False) -> None:
+        with self._write_lock or nullcontext():
+            pos_before = int(self._rb._pos)
+            self._rb.add(data, validate_args=validate_args)
+            data_len = next(iter(data.values())).shape[0]
+            # the host keeps only the trailing window of an oversized insert;
+            # mirror exactly the rows it wrote
+            write_len = min(data_len, self._capacity)
+            start = pos_before + data_len - write_len
+            self._staged.extend((start + r) % self._capacity for r in range(write_len))
+            # bound host-side staging memory (and batch the upload) during
+            # long collection-only phases such as the learning_starts prefill
+            if len(self._staged) >= 8 * self.FLUSH_BUCKET:
+                self._flush()
+
+    # -- device plumbing ---------------------------------------------------
+
+    def _allocate(self, example_row: Dict[str, np.ndarray]) -> None:
+        """``example_row`` leaves are per-env rows ``[n_envs, ...]``."""
+        import jax
+        import jax.numpy as jnp
+
+        max_group = max(len(g) for g in self._groups)
+        bytes_per_row = sum(
+            int(np.prod(np.asarray(v).shape[1:], dtype=np.int64))
+            * np.asarray(v).dtype.itemsize
+            * max_group
+            for v in example_row.values()
+        )
+        _check_hbm_budget(
+            self._homes[0],
+            self._capacity,
+            bytes_per_row,
+            "DeviceRingTransitions",
+            lambda limit: int(0.5 * limit / max(bytes_per_row, 1)),
+            self._n_envs,
+        )
+        self._shards = []
+        for g, envs in enumerate(self._groups):
+            with jax.default_device(self._homes[g]):
+                self._shards.append(
+                    {
+                        k: jnp.zeros(
+                            (self._capacity, len(envs)) + np.asarray(v).shape[1:],
+                            np.asarray(v).dtype,
+                        )
+                        for k, v in example_row.items()
+                    }
+                )
+
+    def _scatter_fn(self, n_rows: int):
+        import jax
+
+        fn = self._scatter_fns.get(n_rows)
+        if fn is None:
+            def scatter(buf, t_idx, rows):
+                return {
+                    k: v.at[t_idx].set(rows[k], mode="drop") for k, v in buf.items()
+                }
+
+            fn = jax.jit(scatter, donate_argnums=(0,))
+            self._scatter_fns[n_rows] = fn
+        return fn
+
+    def _flush(self) -> None:
+        if not self._staged:
+            return
+        # dedupe staged rows: a ring can wrap within one staging window, and
+        # XLA's scatter leaves the winner among duplicate indices undefined;
+        # values are read from the host buffer, which holds the newest write
+        rows_t = np.asarray(list(dict.fromkeys(self._staged)), np.int64)
+        host = self._rb.buffer
+        if self._shards is None:
+            self._allocate({k: _as_np(v)[0] for k, v in host.items()})
+        n = int(rows_t.size)
+        padded = _pad_rows(n)
+        t_idx = np.full(padded, self._capacity, np.int32)  # OOB → dropped
+        t_idx[:n] = rows_t
+        for g, envs in enumerate(self._groups):
+            rows: Dict[str, np.ndarray] = {}
+            for k, v in host.items():
+                arr = _as_np(v)
+                stack = np.zeros((padded, len(envs)) + arr.shape[2:], arr.dtype)
+                # fused row+column gather: copies only this group's columns
+                # (arr[rows_t][:, envs] would materialize the full width
+                # n_groups times per flush)
+                stack[:n] = arr[np.ix_(rows_t, envs)]
+                rows[k] = stack
+            payload = staged_device_put((t_idx, rows), self._homes[g])
+            self._shards[g] = self._scatter_fn(padded)(self._shards[g], *payload)
+        self._staged.clear()
+
+    # -- sample path -------------------------------------------------------
+
+    def _gather_fn(self, total: int, n_samples: int, sample_next_obs: bool):
+        import jax
+        import jax.numpy as jnp
+
+        key = (total, n_samples, sample_next_obs)
+        fn = self._gather_fns.get(key)
+        if fn is None:
+            capacity = self._capacity
+            width = self._group_width
+            obs_keys = tuple(self._rb._obs_keys)
+
+            def gather(buf, plan):
+                # plan rows are packed t * width + col (int32): one upload
+                # word per transition instead of two
+                t_idx = plan // width
+                c_idx = plan % width
+                out = {}
+                for k, v in buf.items():
+                    sel = v[t_idx, c_idx]
+                    out[k] = sel.reshape((n_samples, total // n_samples) + sel.shape[1:])
+                    if sample_next_obs and k in obs_keys:
+                        nxt = v[jnp.mod(t_idx + 1, capacity), c_idx]
+                        out[f"next_{k}"] = nxt.reshape(
+                            (n_samples, total // n_samples) + nxt.shape[1:]
+                        )
+                return out
+
+            fn = jax.jit(gather)
+            self._gather_fns[key] = fn
+        return fn
+
+    def sample_device(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+    ) -> Dict[str, Any]:
+        """Gather ``[n_samples, batch, ...]`` transition batches on device.
+
+        The only host→device traffic is the int32 index plan. With a
+        ``batch_sharding`` the result is a global sharded Array whose batch
+        slice *g* was gathered (and stays) on the device that consumes it."""
+        import jax
+
+        n_groups = len(self._groups)
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        if batch_size % n_groups != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must divide evenly over the "
+                f"{n_groups} batch shards"
+            )
+        with self._write_lock or nullcontext():
+            self._flush()
+            if self._shards is None:
+                raise ValueError("No sample has been added to the buffer")
+            b_local = batch_size // n_groups
+            parts: List[Dict[str, Any]] = []
+            for g, envs in enumerate(self._groups):
+                # the host buffer's own planner: valid-window semantics live in
+                # exactly one place; per-group runs restrict the env draw to
+                # the group's columns (uniform within the group, like the
+                # sequence ring's per-group pick_envs)
+                t_idx, e_idx = self._rb.plan_transitions(
+                    b_local,
+                    sample_next_obs=sample_next_obs,
+                    n_samples=n_samples,
+                    rng=self._rng,
+                    envs=None if n_groups == 1 else envs,
+                )
+                packed = (
+                    t_idx.astype(np.int64) * self._group_width + self._env_col[e_idx]
+                ).astype(np.int32)
+                fn = self._gather_fn(packed.shape[0], n_samples, sample_next_obs)
+                plan = staged_device_put(packed, self._homes[g])
+                parts.append(fn(self._shards[g], plan))
+        if self._sharding is None:
+            return parts[0]
+        return _assemble_global(parts, self._sharding, self._replicas, 1, batch_size)

@@ -26,6 +26,8 @@ from sheeprl_tpu.obs.counters import (
     add_ckpt_blocked_ms,
     add_ckpt_write,
     add_h2d_bytes,
+    add_prefetch,
+    add_ring_gather,
     count_h2d,
     device_memory_stats,
     staged_device_put,
@@ -59,6 +61,8 @@ __all__ = [
     "add_ckpt_blocked_ms",
     "add_ckpt_write",
     "add_h2d_bytes",
+    "add_prefetch",
+    "add_ring_gather",
     "count_h2d",
     "cost_flops",
     "cost_flops_of",

@@ -36,6 +36,8 @@ __all__ = [
     "add_ckpt_blocked_ms",
     "add_ckpt_write",
     "add_h2d_bytes",
+    "add_prefetch",
+    "add_ring_gather",
     "device_memory_stats",
     "DevicePoller",
     "install",
@@ -68,6 +70,14 @@ class Counters:
         self.ckpt_bytes = 0
         self.ckpt_saves = 0
         self.ckpt_failures = 0
+        # replay staging (data/staging.py): ring gathers never re-cross the
+        # host→HBM link; prefetch hits are bursts whose sampling + H2D ran
+        # overlapped with the previous train burst, wait_ms the residue the
+        # train thread still blocked on a not-yet-ready prefetched batch
+        self.ring_gathers = 0
+        self.prefetch_hits = 0
+        self.prefetch_misses = 0
+        self.prefetch_wait_ms = 0.0
 
     def add(self, field: str, amount) -> None:
         with self._lock:
@@ -88,6 +98,10 @@ class Counters:
                 "ckpt_bytes": self.ckpt_bytes,
                 "ckpt_saves": self.ckpt_saves,
                 "ckpt_failures": self.ckpt_failures,
+                "ring_gathers": self.ring_gathers,
+                "prefetch_hits": self.prefetch_hits,
+                "prefetch_misses": self.prefetch_misses,
+                "prefetch_wait_ms": round(self.prefetch_wait_ms, 1),
             }
 
 
@@ -160,6 +174,32 @@ def staged_device_put(data: Any, device: Any):
         out = jax.device_put(data, device)
     add_h2d_bytes(nbytes)
     return out
+
+
+# -- replay staging accounting ----------------------------------------------
+
+
+def add_ring_gather(n: int = 1) -> None:
+    """Record ``n`` device-ring batch gathers (no host→HBM batch upload)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.ring_gathers += n
+
+
+def add_prefetch(hit: bool, wait_ms: float = 0.0) -> None:
+    """Record one prefetch-pipeline burst: a *hit* means the batch was
+    sampled + staged while the previous train burst ran (``wait_ms`` is the
+    residue the caller still blocked for); a *miss* means it was produced
+    synchronously (cold start or a changed burst spec)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            if hit:
+                c.prefetch_hits += 1
+            else:
+                c.prefetch_misses += 1
+            c.prefetch_wait_ms += float(wait_ms)
 
 
 # -- checkpoint accounting --------------------------------------------------

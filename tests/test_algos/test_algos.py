@@ -157,6 +157,33 @@ def test_sac_sample_next_obs(tmp_path, monkeypatch):
     cli.run(args)
 
 
+def test_sac_device_ring(tmp_path, monkeypatch):
+    """SAC through the universal device-ring staging path (transition-mode
+    ring + on-device next-obs synthesis), end-to-end on the CPU backend.
+
+    Needs a real (non-dry) run: dry_run forces buffer_size=1 and the ring
+    only gathers once training bursts sample it."""
+    monkeypatch.chdir(tmp_path)
+    args = standard_args(tmp_path) + [
+        "exp=sac",
+        "dry_run=False",
+        "total_steps=16",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+        "per_rank_batch_size=4",
+        "algo.learning_starts=8",
+        "algo.hidden_size=8",
+        "env=gym",
+        "env.id=Pendulum-v1",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "buffer.size=64",
+        "buffer.sample_next_obs=True",
+        "buffer.device_ring=True",
+    ]
+    cli.run(args)
+
+
 def test_droq(tmp_path, devices, monkeypatch):
     monkeypatch.chdir(tmp_path)
     args = standard_args(tmp_path) + [

@@ -155,8 +155,9 @@ def test_flush_bucketing_reuses_compiled_programs():
     for i in range(7):
         ring.add(_step(5 + i, 1))
     ring._flush()
-    # both flushes pad to one bucket => one compiled scatter
-    assert list(ring._scatter_fns.keys()) == [DeviceRingReplay.FLUSH_BUCKET]
+    # both flushes (5 and 7 rows, each doubled by their shadow-region
+    # mirror slots) pad to the same power-of-two bucket => one compiled scatter
+    assert list(ring._scatter_fns.keys()) == [16]
     _ring_equals_host(ring)
 
 

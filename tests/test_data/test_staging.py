@@ -1,0 +1,467 @@
+"""Replay-staging facade: ring-vs-host sampling parity (both modes), the
+double-buffered prefetch pipeline's overlap/fallback behavior, facade
+dispatch, and the staging-uniformity lint (sheeprl_tpu/data/staging.py)."""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+    _as_np,
+)
+from sheeprl_tpu.data.device_ring import DeviceRingReplay, DeviceRingTransitions
+from sheeprl_tpu.data.staging import HostStaging, RingStaging, make_replay_staging
+from sheeprl_tpu.obs import counters as obs_counters
+
+
+def _cfg(**buffer):
+    return types.SimpleNamespace(buffer=buffer)
+
+
+def _fill_flat(rb, steps, n_envs, obs_dim=3, start=0):
+    for i in range(start, start + steps):
+        rb.add(
+            {
+                "observations": np.full((1, n_envs, obs_dim), i, np.float32),
+                "next_observations": np.full((1, n_envs, obs_dim), i + 1, np.float32),
+                "actions": np.full((1, n_envs, 2), -i, np.float32),
+                "rewards": np.full((1, n_envs, 1), float(i), np.float32),
+                "dones": np.asarray(
+                    [[[float(i % 5 == 4)]] * 1] * n_envs, np.float32
+                ).reshape(1, n_envs, 1),
+            }
+        )
+
+
+def _seq_step(i, n_envs):
+    return {
+        "rgb": np.full((1, n_envs, 3, 4, 4), i % 256, np.uint8),
+        "actions": np.full((1, n_envs, 2), i, np.float32),
+        "rewards": np.full((1, n_envs, 1), float(i), np.float32),
+        "dones": np.zeros((1, n_envs, 1), np.float32),
+        "is_first": np.zeros((1, n_envs, 1), np.float32),
+    }
+
+
+# ---------------------------------------------------------------------------
+# seeded ring-vs-host parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sample_next_obs", [False, True])
+@pytest.mark.parametrize("steps", [10, 40])  # not-full and wrapped
+def test_transition_ring_parity_bitwise(sample_next_obs, steps):
+    """Ring transition-mode gather bitwise-matches host ``rb.sample`` for
+    SAC-shaped bursts: same seed → same plan (host ``plan_transitions`` is
+    the single planner) → identical ``[G, B, ...]`` batches."""
+    size, n_envs, G, B = 16, 2, 3, 8
+    host = ReplayBuffer(size, n_envs, obs_keys=("observations",))
+    mirror_host = ReplayBuffer(size, n_envs, obs_keys=("observations",))
+    _fill_flat(host, steps, n_envs)
+    _fill_flat(mirror_host, steps, n_envs)
+    ring = DeviceRingTransitions(mirror_host, seed=0)
+
+    host.seed(7)
+    ring.seed(7)
+    want = host.sample(G * B, sample_next_obs=sample_next_obs)
+    want = {k: v.reshape((G, B) + v.shape[2:]) for k, v in want.items()}
+    got = ring.sample_device(B, sample_next_obs=sample_next_obs, n_samples=G)
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]), want[k], err_msg=k)
+
+
+def test_transition_ring_next_obs_wraps_ring_boundary():
+    """``next_observations`` derived on device must wrap t+1 across the ring
+    end exactly like the host's ``(t_idx + 1) % buffer_size``."""
+    size, n_envs = 8, 1
+    host = ReplayBuffer(size, n_envs, obs_keys=("observations",))
+    _fill_flat(host, 2 * size, n_envs)  # full + wrapped
+    ring = DeviceRingTransitions(host, seed=1)
+    ring.seed(11)
+    got = ring.sample_device(64, sample_next_obs=True, n_samples=1)
+    obs = np.asarray(got["observations"])[0, :, 0]
+    nxt = np.asarray(got["next_observations"])[0, :, 0]
+    # rows store step index i; its stored successor holds either i+1 or, at
+    # the wrap seam, the oldest surviving row — always the host's row at
+    # (t+1) % size, which is what bitwise parity above pins; here we pin the
+    # physical wrap itself
+    host_obs = _as_np(host.buffer["observations"])[:, 0, 0]
+    for o, n in zip(obs, nxt):
+        t = int(np.where(host_obs == o)[0][0])
+        assert n == host_obs[(t + 1) % size]
+
+
+def test_sequence_ring_parity_seeded_plan():
+    """Sequence-mode parity: replay the ring's seeded plan with the host
+    buffers' own planners (``pick_envs`` + ``plan_starts``) and check the
+    device gather returns exactly the host rows for that plan."""
+    size, n_envs, B, L, n_samples = 16, 2, 6, 4, 2
+    host = EnvIndependentReplayBuffer(
+        size, n_envs, obs_keys=("rgb",), buffer_cls=SequentialReplayBuffer
+    )
+    for i in range(12):
+        host.add(_seq_step(i, n_envs))
+    ring = DeviceRingReplay(host, seed=0, sequence_overlap=L)
+    ring.seed(5)
+    got = ring.sample_device(B, sequence_length=L, n_samples=n_samples)
+
+    # replay the plan: same algorithm as DeviceRingReplay._plan_group, same
+    # seed, but gathering from the HOST arrays with numpy
+    rng = np.random.default_rng(5)
+    with_data, counts = host.pick_envs(B, rng, envs=list(range(n_envs)))
+    starts_by_env, envs_order = [], []
+    for j, env in enumerate(with_data):
+        c = int(counts[j])
+        if c == 0:
+            continue
+        starts = host.buffer[env].plan_starts(c * n_samples, L, rng=rng)
+        starts_by_env.append(np.asarray(starts).reshape(n_samples, c))
+        envs_order.append(env)
+    all_starts = np.concatenate(starts_by_env, axis=1)  # [n_samples, B]
+    col_of = np.concatenate(
+        [np.full((n_samples, s.shape[1]), e) for s, e in zip(starts_by_env, envs_order)],
+        axis=1,
+    )
+    for k in got:
+        dev = np.asarray(got[k])
+        assert dev.shape[:3] == (n_samples, L, B)
+        for ns in range(n_samples):
+            for b in range(B):
+                env, start = int(col_of[ns, b]), int(all_starts[ns, b])
+                rows = (start + np.arange(L)) % size
+                want = _as_np(host.buffer[env]._buf[k])[rows, 0]
+                np.testing.assert_array_equal(dev[ns, :, b], want, err_msg=k)
+
+
+def test_transition_ring_mirror_and_checkpoint_roundtrip():
+    size, n_envs = 8, 2
+    host = ReplayBuffer(size, n_envs, obs_keys=("observations",))
+    _fill_flat(host, 11, n_envs)
+    ring = DeviceRingTransitions(host, seed=3)
+    ring._flush()
+    for k, v in host.buffer.items():
+        np.testing.assert_array_equal(np.asarray(ring._buf[k]), _as_np(v), err_msg=k)
+    # restore into a fresh ring: device copy must be rebuilt from the host
+    state = ring.state_dict()
+    host2 = ReplayBuffer(size, n_envs, obs_keys=("observations",))
+    ring2 = DeviceRingTransitions(host2, seed=3)
+    ring2.load_state_dict(state)
+    for k, v in host.buffer.items():
+        np.testing.assert_array_equal(np.asarray(ring2._buf[k]), _as_np(v), err_msg=k)
+
+
+def test_transition_ring_wraps_pre_filled_host():
+    """Wrapping a buffer that already holds data (resume restored before the
+    ring existed) must mirror it immediately — not depend on call order."""
+    size, n_envs = 8, 2
+    host = ReplayBuffer(size, n_envs, obs_keys=("observations",))
+    _fill_flat(host, 5, n_envs)
+    ring = DeviceRingTransitions(host, seed=3)
+    ring.seed(2)
+    got = ring.sample_device(16, n_samples=1)
+    assert np.asarray(got["observations"]).max() <= 5
+
+
+# ---------------------------------------------------------------------------
+# prefetch pipeline
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def counters():
+    c = obs_counters.Counters()
+    obs_counters.install(c)
+    yield c
+    obs_counters.install(None)
+
+
+def test_prefetch_overlap_after_warmup(counters):
+    """After warmup the train thread never blocks on stage_h2d: every repeat
+    burst is a prefetch hit, produced on the worker thread."""
+    n_envs = 2
+    rb = ReplayBuffer(32, n_envs, obs_keys=("observations",))
+    _fill_flat(rb, 10, n_envs)
+    staging = HostStaging(rb, None, sequence_mode=False, prefetch=True)
+
+    produce_threads = []
+    orig = staging._produce
+
+    def recording_produce(spec, clone):
+        produce_threads.append(threading.current_thread().name)
+        return orig(spec, clone)
+
+    staging._produce = recording_produce
+    try:
+        n_bursts = 6
+        for i in range(n_bursts):
+            batch = staging.sample_device(4, n_samples=2, sample_next_obs=False)
+            assert np.asarray(batch["observations"]).shape == (2, 4, 3)
+            _fill_flat(rb, 1, n_envs, start=10 + i)  # adds interleave safely
+    finally:
+        staging.close()
+    # burst 1: cold miss (sync). burst 2: spec seen once -> still a miss, but
+    # schedules the prefetch. bursts 3+: hits served by the worker.
+    assert counters.prefetch_misses <= 2
+    assert counters.prefetch_hits >= n_bursts - 2
+    main_produces = [t for t in produce_threads if not t.startswith("replay-prefetch")]
+    assert len(main_produces) <= 2  # only the warmup bursts block the caller
+    assert any(t.startswith("replay-prefetch") for t in produce_threads)
+    # pipeline bytes are accounted like any other staging
+    assert counters.h2d_bytes > 0
+    assert "prefetch_hits" in counters.as_dict()
+
+
+def test_prefetch_spec_change_falls_back_sync(counters):
+    rb = ReplayBuffer(32, 2, obs_keys=("observations",))
+    _fill_flat(rb, 12, 2)
+    staging = HostStaging(rb, None, sequence_mode=False, prefetch=True)
+    try:
+        # two alternating specs (the DroQ shape): both become hits once each
+        # has been requested twice
+        for _ in range(4):
+            a = staging.sample_device(4, n_samples=2)
+            b = staging.sample_device(4, n_samples=1)
+            assert np.asarray(a["observations"]).shape == (2, 4, 3)
+            assert np.asarray(b["observations"]).shape == (1, 4, 3)
+        assert len(staging._pending) <= HostStaging.MAX_PENDING
+    finally:
+        staging.close()
+    assert counters.prefetch_hits >= 4
+    # a never-repeated spec is never prefetched (no dead HBM batch pinned)
+    one_off_spec = (4, 0, 7, False)
+    assert one_off_spec not in staging._pending
+
+
+def test_prefetch_disabled_is_synchronous_and_deterministic():
+    rb1 = ReplayBuffer(32, 2, obs_keys=("observations",))
+    rb2 = ReplayBuffer(32, 2, obs_keys=("observations",))
+    _fill_flat(rb1, 12, 2)
+    _fill_flat(rb2, 12, 2)
+    rb1.seed(9)
+    rb2.seed(9)
+    staging = HostStaging(rb1, None, sequence_mode=False, prefetch=False)
+    assert staging._pool is None
+    got = staging.sample_device(4, n_samples=3, sample_next_obs=True)
+    want = rb2.sample(12, sample_next_obs=True)
+    want = {k: v.reshape((3, 4) + v.shape[2:]) for k, v in want.items()}
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]), want[k], err_msg=k)
+    staging.close()
+
+
+def test_prefetch_sequence_mode_layout():
+    rb = EnvIndependentReplayBuffer(
+        16, 2, obs_keys=("rgb",), buffer_cls=SequentialReplayBuffer
+    )
+    for i in range(12):
+        rb.add(_seq_step(i, 2))
+    staging = HostStaging(rb, None, sequence_mode=True, prefetch=True)
+    try:
+        for _ in range(3):
+            batch = staging.sample_device(4, sequence_length=5, n_samples=2)
+            assert np.asarray(batch["rgb"]).shape == (2, 5, 4, 3, 4, 4)
+            assert np.asarray(batch["rgb"]).dtype == np.uint8  # native dtype
+    finally:
+        staging.close()
+
+
+def test_prefetch_error_surfaces_on_caller_thread():
+    rb = ReplayBuffer(32, 2, obs_keys=("observations",))
+    staging = HostStaging(rb, None, sequence_mode=False, prefetch=True)
+    try:
+        with pytest.raises(ValueError, match="No sample has been added"):
+            staging.sample_device(4, n_samples=1)
+    finally:
+        staging.close()
+
+
+def test_force_done_last_host_path():
+    rb = EnvIndependentReplayBuffer(
+        16, 2, obs_keys=("rgb",), buffer_cls=SequentialReplayBuffer
+    )
+    for i in range(4):
+        rb.add(_seq_step(i, 2))
+    staging = HostStaging(rb, None, sequence_mode=True, prefetch=False)
+    staging.force_done_last(1)
+    sub = rb.buffer[1]
+    last = (sub._pos - 1) % sub.buffer_size
+    assert float(_as_np(sub._buf["dones"])[last, 0, 0]) == 1.0
+    assert float(_as_np(sub._buf["is_first"])[last, 0, 0]) == 0.0
+    staging.close()
+
+
+# ---------------------------------------------------------------------------
+# facade dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_make_replay_staging_dispatch():
+    flat = ReplayBuffer(16, 2, obs_keys=("observations",))
+    seq = EnvIndependentReplayBuffer(
+        16, 2, obs_keys=("rgb",), buffer_cls=SequentialReplayBuffer
+    )
+    s1 = make_replay_staging(_cfg(device_ring=True), None, flat, seed=0)
+    assert isinstance(s1, RingStaging) and isinstance(s1.rb, DeviceRingTransitions)
+    s2 = make_replay_staging(
+        _cfg(device_ring=True), None, seq, sequence_length=8, seed=0
+    )
+    assert isinstance(s2, RingStaging) and isinstance(s2.rb, DeviceRingReplay)
+    assert s2.rb._overlap == 8
+    s3 = make_replay_staging(_cfg(device_ring=False, prefetch=False), None, flat)
+    assert isinstance(s3, HostStaging) and s3._pool is None and s3.rb is flat
+    s4 = make_replay_staging(_cfg(), None, flat)
+    assert isinstance(s4, HostStaging) and s4._pool is not None  # prefetch default on
+    s4.close()
+
+
+def test_make_replay_staging_episode_buffer_falls_back():
+    ep = EpisodeBuffer(16, sequence_length=4, n_envs=1, obs_keys=("rgb",))
+    with pytest.warns(UserWarning, match="episode buffer"):
+        staging = make_replay_staging(
+            _cfg(device_ring=True), None, ep, sequence_length=4
+        )
+    assert isinstance(staging, HostStaging)
+    staging.close()
+
+
+def test_make_replay_staging_ring_failure_falls_back():
+    # 2 envs cannot shard over 8 batch slices -> warn + host pipeline, not a
+    # refused run
+    import jax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    devs = np.asarray(jax.devices())
+    if devs.size < 2:
+        pytest.skip("needs a multi-device mesh (tests/conftest.py provides 8)")
+    mesh = Mesh(devs, ("data",))
+    sharding = NamedSharding(mesh, P(None, "data"))
+    flat = ReplayBuffer(16, devs.size - 1, obs_keys=("observations",))
+    fabric = types.SimpleNamespace(world_size=devs.size, device=jax.devices()[0])
+    with pytest.warns(UserWarning, match="could not be enabled"):
+        staging = make_replay_staging(
+            _cfg(device_ring=True), fabric, flat, batch_sharding=sharding
+        )
+    assert isinstance(staging, HostStaging)
+    staging.close()
+
+
+def test_ring_counters(counters):
+    rb = ReplayBuffer(16, 2, obs_keys=("observations",))
+    _fill_flat(rb, 6, 2)
+    staging = make_replay_staging(_cfg(device_ring=True), None, rb, seed=0)
+    staging.sample_device(4, n_samples=2)
+    assert counters.ring_gathers == 1
+    assert counters.as_dict()["ring_gathers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# staging-uniformity lint
+# ---------------------------------------------------------------------------
+
+
+def test_lint_staging_flags_inline_staging(tmp_path):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_staging",
+        os.path.join(os.path.dirname(__file__), "..", "..", "tools", "lint_staging.py"),
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def main(rb, jax, sharding):\n"
+        "    sample = rb.sample(8)\n"
+        "    batch = {k: v for k, v in sample.items()}\n"
+        "    batch = jax.device_put(batch, sharding)\n"
+        "    local_data = {}\n"
+        "    jax.device_put(local_data, sharding)\n"
+    )
+    findings = lint.lint_file(str(bad))
+    assert len(findings) == 3
+    good = tmp_path / "good.py"
+    good.write_text(
+        "def main(staging, jax, fabric, agent_state):\n"
+        "    batch = staging.sample_device(8, n_samples=2)\n"
+        "    agent_state = jax.device_put(agent_state, fabric.replicated)\n"
+    )
+    assert lint.lint_file(str(good)) == []
+    # the live tree must be clean
+    assert lint.main() == 0
+
+
+# ---------------------------------------------------------------------------
+# sharded transition ring (8-virtual-device CPU mesh from tests/conftest.py)
+# ---------------------------------------------------------------------------
+
+
+def _make_sharded_transitions(buffer_size=16, n_envs=8, n_dev=4, seed=3):
+    import jax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("data",))
+    sharding = NamedSharding(mesh, P(None, "data"))
+    host = ReplayBuffer(buffer_size, n_envs, obs_keys=("observations",))
+    return DeviceRingTransitions(host, seed=seed, batch_sharding=sharding), mesh
+
+
+def test_sharded_transition_ring_shards_match_host():
+    ring, _ = _make_sharded_transitions(buffer_size=8, n_envs=8, n_dev=4)
+    _fill_flat(ring, 13, 8)  # wraps
+    ring._flush()
+    assert len(ring._shards) == 4
+    host = ring.host.buffer
+    for g, envs in enumerate(ring._groups):
+        shard = ring._shards[g]
+        assert next(iter(shard.values())).devices() == {ring._homes[g]}
+        for k, v in host.items():
+            np.testing.assert_array_equal(
+                np.asarray(shard[k]), _as_np(v)[:, envs], err_msg=f"{k} group {g}"
+            )
+
+
+def test_sharded_transition_sample_is_global_and_local():
+    ring, _ = _make_sharded_transitions(buffer_size=16, n_envs=8, n_dev=4)
+    _fill_flat(ring, 16, 8)
+    out = ring.sample_device(batch_size=8, n_samples=3, sample_next_obs=True)
+    assert out["observations"].shape == (3, 8, 3)
+    assert out["next_observations"].shape == (3, 8, 3)
+    arr = out["observations"]
+    assert len(arr.sharding.device_set) == 4
+    # each batch slice was gathered from the envs homed on its device and
+    # needed no resharding
+    for shard in arr.addressable_shards:
+        np.testing.assert_array_equal(
+            np.asarray(shard.data), np.asarray(arr)[shard.index]
+        )
+    # value parity: obs rows store their step index, next rows its successor
+    obs = np.asarray(out["observations"])[..., 0]
+    nxt = np.asarray(out["next_observations"])[..., 0]
+    np.testing.assert_array_equal(nxt, obs + 1)  # valid window excludes newest
+
+
+def test_sharded_transition_ring_rejects_bad_spec():
+    import jax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("data",))
+    host = ReplayBuffer(16, 8, obs_keys=("observations",))
+    with pytest.raises(ValueError, match="batch_sharding must shard only"):
+        DeviceRingTransitions(host, batch_sharding=NamedSharding(mesh, P("data")))
+    host6 = ReplayBuffer(16, 6, obs_keys=("observations",))
+    with pytest.raises(ValueError, match="does not divide"):
+        DeviceRingTransitions(
+            host6, batch_sharding=NamedSharding(mesh, P(None, "data"))
+        )

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Staging-uniformity lint: every off-policy algo must stage replay batches
+through the shared facade.
+
+The host→HBM replay staging decision lives exactly once, in
+``sheeprl_tpu/data/staging.py`` (``make_replay_staging`` →
+``sample_device``): device-ring gathers when ``buffer.device_ring=True``,
+a double-buffered host prefetch pipeline otherwise. Before the facade
+existed, the same ``rb.sample`` → reshape → ``jax.device_put`` block was
+copy-pasted across eleven entrypoints and had already drifted (DreamerV3
+had the ring, everything else paid a synchronous per-burst upload). This
+lint fails when a file under ``sheeprl_tpu/algos/`` re-grows inline
+staging:
+
+- a ``rb.sample(...)`` / ``rb.sample_tensors(...)`` / ``rb.sample_device(...)``
+  call (replay sampling belongs to the facade — call
+  ``staging.sample_device(...)``);
+- a ``jax.device_put(batch, ...)``-shaped call whose payload name looks like
+  a replay batch (``batch``/``sample``/``sliced``/``*_data``/... ) — the
+  facade owns the upload, including its telemetry accounting and prefetch
+  overlap.
+
+On-policy algos (PPO, recurrent PPO, A2C) are exempt: their rollout buffers
+are filled and consumed once per update on the step path — there is no
+replay ring to mirror and nothing to prefetch against.
+
+AST-based, so comments and docstrings are fine. Usage:
+``python tools/lint_staging.py`` — exits non-zero with a findings list on
+violation. Wired into the CI tier-1 lane (.github/workflows/tests.yml).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
+
+#: rollout-buffer algos: no replay path, staged once per update by design
+ON_POLICY_DIRS = {"ppo", "ppo_recurrent", "a2c"}
+
+#: receivers that name the replay buffer in the entrypoints
+REPLAY_RECEIVERS = {"rb", "replay_buffer"}
+
+#: replay sampling entrances (facade-only)
+FORBIDDEN_SAMPLE_ATTRS = {"sample", "sample_tensors", "sample_device"}
+
+#: first-arg names that identify a replay batch being device_put by hand
+BATCH_NAME_RE = re.compile(r"(^|_)(batch|batches|sample|samples|sliced)($|_)|_data$")
+
+
+def _is_device_put(fn: ast.AST) -> bool:
+    if isinstance(fn, ast.Name) and fn.id == "device_put":
+        return True
+    return (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "device_put"
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id == "jax"
+    )
+
+
+def lint_file(path: str) -> list:
+    src = open(path).read()
+    tree = ast.parse(src, filename=path)
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in FORBIDDEN_SAMPLE_ATTRS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in REPLAY_RECEIVERS
+        ):
+            findings.append(
+                (node.lineno,
+                 f"inline replay sampling `{fn.value.id}.{fn.attr}(...)` — "
+                 "stage train bursts through the shared facade: "
+                 "make_replay_staging(...).sample_device(...)")
+            )
+        if _is_device_put(fn) and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and BATCH_NAME_RE.search(arg.id):
+                findings.append(
+                    (node.lineno,
+                     f"inline replay staging `jax.device_put({arg.id}, ...)` — "
+                     "the staging facade owns host→HBM batch uploads (ring "
+                     "gather / prefetch overlap / telemetry accounting)")
+                )
+    return findings
+
+
+def main() -> int:
+    failures = []
+    for root, _dirs, files in os.walk(ALGOS_DIR):
+        algo = os.path.relpath(root, ALGOS_DIR).split(os.sep)[0]
+        if algo in ON_POLICY_DIRS:
+            continue
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            for lineno, msg in lint_file(path):
+                failures.append(f"{os.path.relpath(path, REPO)}:{lineno}: {msg}")
+    if failures:
+        print("staging-uniformity lint FAILED:")
+        for f in failures:
+            print(f"  {f}")
+        print(
+            "\nAll replay staging in sheeprl_tpu/algos/ must go through "
+            "sheeprl_tpu/data/staging.py (make_replay_staging)."
+        )
+        return 1
+    print("staging-uniformity lint passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
