@@ -206,6 +206,21 @@ def _repeat_line(metric, run_once, baseline, protocol, repeats=3, min_stage_s=60
     return json.dumps(line)
 
 
+def _phase_tails(tel) -> dict:
+    """p50/p95 step-time tails from a telemetry.json's phase percentiles
+    (obs/hist.py streaming histograms) — `{train_p50_ms, train_p95_ms,
+    env_p95_ms}`, absent keys skipped."""
+    out = {}
+    pct = tel.get("phase_percentiles") or {}
+    for phase, prefix in (("Time/train_time", "train"), ("Time/env_interaction_time", "env")):
+        p = pct.get(phase) or {}
+        if p.get("p95_ms") is not None:
+            if prefix == "train":
+                out[f"{prefix}_p50_ms"] = p.get("p50_ms")
+            out[f"{prefix}_p95_ms"] = p["p95_ms"]
+    return out
+
+
 _QUIET = [
     "env.capture_video=False",
     "checkpoint.every=1000000000",
@@ -273,6 +288,10 @@ def _ppo_line() -> str:
                 "ckpt_saves",
             )
         }
+        # tail latency next to the averages: a regression that only bloats
+        # p95 (a periodic stall, a recompile storm) is invisible in the
+        # wall-clock median this line is judged on
+        data["telemetry"].update(_phase_tails(tel))
         line = json.dumps(data)
     except Exception:
         pass  # a skipped/failed stage has no summary; keep the line as-is
@@ -350,6 +369,7 @@ def _sac_line() -> str:
                 "recompiles",
             )
         }
+        data["telemetry"].update(_phase_tails(tel))
         line = json.dumps(data)
     except Exception:
         pass  # a skipped/failed stage has no summary; keep the line as-is

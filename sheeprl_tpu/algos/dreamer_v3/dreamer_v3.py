@@ -65,6 +65,7 @@ from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import (
+    LoopProbe,
     cost_flops_of,
     get_telemetry,
     log_sps_metrics,
@@ -765,16 +766,7 @@ def main(fabric, cfg: Dict[str, Any]):
     # updates — the remote-attached-device loop is latency-dominated and the
     # TB timers can't see through async dispatch, so this is the ground truth
     # for where a slow loop actually spends its time.
-    trace = os.environ.get("SHEEPRL_LOOP_TRACE") not in (None, "", "0")
-    trace_acc: Dict[str, float] = {}
-    trace_n = 0
-    import time as _time
-
-    def _tr(name: str, t0: float) -> float:
-        t1 = _time.perf_counter()
-        if trace:
-            trace_acc[name] = trace_acc.get(name, 0.0) + (t1 - t0)
-        return t1
+    probe = LoopProbe(every=50)
 
     # SHEEPRL_GC_TUNE=1: move everything built so far out of GC's reach and
     # relax collection thresholds — the hot loop allocates heavily (numpy
@@ -794,7 +786,7 @@ def main(fabric, cfg: Dict[str, Any]):
     _dump_digest = None
     for update in range(start_step, num_updates + 1):
         policy_step += n_envs
-        _t = _time.perf_counter()
+        probe.mark()
 
         with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
             if update <= learning_starts and cfg.checkpoint.resume_from is None:
@@ -863,16 +855,16 @@ def main(fabric, cfg: Dict[str, Any]):
                         [np.argmax(np.asarray(a), axis=-1) for a in actions_j], axis=-1
                     )
 
-            _t = _tr("act", _t)
+            probe.lap("act")
             step_data["actions"] = actions.reshape(1, n_envs, -1).astype(np.float32)
             rb.add(step_data)
-            _t = _tr("rb_add", _t)
+            probe.lap("rb_add")
 
             o, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
             dones = np.logical_or(terminated, truncated).astype(np.float32)
-            _t = _tr("env_step", _t)
+            probe.lap("env_step")
 
         step_data["is_first"] = np.zeros_like(step_data["dones"])
         if "restart_on_exception" in infos:
@@ -980,7 +972,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     play_wm, player_state, jnp.asarray(reset_mask)
                 )
 
-        _t = _tr("bookkeeping", _t)
+        probe.lap("bookkeeping")
         updates_before_training -= 1
 
         # Train the agent (reference main :719-765)
@@ -1000,7 +992,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     sequence_length=cfg.per_rank_sequence_length,
                     n_samples=n_samples,
                 )
-                _t = _tr("sample", _t)
+                probe.lap("sample")
                 # On a bandwidth-limited host link every blocking device→host
                 # metric fetch costs a round trip; fetch_train_metrics_every=k
                 # samples the train metrics every k-th burst (always on the last
@@ -1061,7 +1053,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         burst_specs = shape_specs(burst_args)
                     agent_state, metrics, play_packed_new = train_fn.burst(*burst_args)
                     per_rank_gradient_steps += n_samples
-                    _t = _tr("train_dispatch", _t)
+                    probe.lap("train_dispatch")
                     if metrics is not None and fetch_metrics:
                         metrics = jax.device_get(metrics)
                     else:
@@ -1072,7 +1064,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         # devices the wait is the device's own step time.
                         np.asarray(metrics["Loss/world_model_loss"])
                         metrics = None
-                    _t = _tr("metric_fetch", _t)
+                    probe.lap("metric_fetch")
                     if use_packed_player:
                         play_packed = play_packed_new
                         _dump_digest = None
@@ -1124,14 +1116,7 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        if trace:
-            trace_n += 1
-            if trace_n % 50 == 0:
-                parts = " ".join(
-                    f"{k}={v / 50 * 1000:.0f}ms" for k, v in sorted(trace_acc.items())
-                )
-                print(f"[loop-trace] update={update} mean/iter: {parts}", flush=True)
-                trace_acc.clear()
+        probe.tick(update)
 
         # Checkpoint (reference main :803-830)
         if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):

@@ -327,7 +327,10 @@ def run_algorithm(cfg) -> None:
         fabric.launch(entrypoint, cfg, **kwargs)
     finally:
         teardown_checkpoint()
-        finalize_telemetry()
+        # inside a finally, exc_info() sees the in-flight exception (if any):
+        # a crashed run's telemetry.json records `"crashed": true` plus the
+        # exception type next to the partial counters
+        finalize_telemetry(error=sys.exc_info()[1])
 
 
 def eval_algorithm(cfg) -> None:

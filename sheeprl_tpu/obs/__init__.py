@@ -13,7 +13,12 @@ pieces (see ``howto/telemetry.md``):
 - :mod:`~sheeprl_tpu.obs.health` — NaN/inf guards on logged losses and a
   stall watchdog for decoupled player↔trainer threads;
 - :mod:`~sheeprl_tpu.obs.perf` — the shared ``Time/sps_*`` / ``Perf/mfu``
-  gauge plumbing every entrypoint logs through.
+  gauge plumbing every entrypoint logs through;
+- :mod:`~sheeprl_tpu.obs.hist` — mergeable log-bucket streaming histograms
+  of every span duration (per-phase ``p50/p95/p99``);
+- :mod:`~sheeprl_tpu.obs.live` — the live plane: periodic atomic
+  ``telemetry/live.json`` snapshots, an optional Prometheus endpoint, and
+  the anomaly-triggered flight recorder.
 
 Everything is configured by the ``metric.telemetry`` config group and
 defaults to off; disabled, the instrumented code paths reduce to the plain
@@ -34,8 +39,17 @@ from sheeprl_tpu.obs.counters import (
     tree_nbytes,
 )
 from sheeprl_tpu.obs.health import NonFiniteGuard, StallWatchdog
+from sheeprl_tpu.obs.hist import HistogramSet, StreamingHist
+from sheeprl_tpu.obs.live import (
+    FlightRecorder,
+    LiveExporter,
+    PromServer,
+    profiler_capture,
+    prometheus_text,
+)
 from sheeprl_tpu.obs.perf import (
     PEAK_TFLOPS_BF16,
+    LoopProbe,
     cost_flops,
     cost_flops_of,
     log_sps_metrics,
@@ -53,9 +67,15 @@ from sheeprl_tpu.obs.telemetry import (
 __all__ = [
     "Counters",
     "DevicePoller",
+    "FlightRecorder",
+    "HistogramSet",
+    "LiveExporter",
+    "LoopProbe",
     "NonFiniteGuard",
     "PEAK_TFLOPS_BF16",
+    "PromServer",
     "StallWatchdog",
+    "StreamingHist",
     "Telemetry",
     "TraceWriter",
     "add_ckpt_blocked_ms",
@@ -72,6 +92,8 @@ __all__ = [
     "get_tracer",
     "log_sps_metrics",
     "mfu_pct",
+    "profiler_capture",
+    "prometheus_text",
     "set_tracer",
     "setup_telemetry",
     "shape_specs",

@@ -42,6 +42,7 @@ __all__ = [
     "DevicePoller",
     "install",
     "installed",
+    "set_compile_hook",
     "staged_device_put",
     "tree_nbytes",
 ]
@@ -51,6 +52,15 @@ _CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 
 _COUNTERS: Optional["Counters"] = None
 _LISTENERS_REGISTERED = False
+#: telemetry-installed callback fired on every backend compile (duration_s);
+#: the flight recorder uses it to catch post-warmup recompile storms
+_COMPILE_HOOK: Optional[Any] = None
+
+
+def set_compile_hook(hook) -> None:
+    """Install (or with ``None`` remove) the backend-compile callback."""
+    global _COMPILE_HOOK
+    _COMPILE_HOOK = hook
 
 
 class Counters:
@@ -236,6 +246,12 @@ def _on_event_duration(event: str, duration: float, **_kw) -> None:
         with c._lock:
             c.recompiles += 1
             c.compile_secs += float(duration)
+        hook = _COMPILE_HOOK
+        if hook is not None:
+            try:
+                hook(float(duration))
+            except Exception:
+                pass
 
 
 def _on_event(event: str, **_kw) -> None:

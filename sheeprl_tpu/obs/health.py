@@ -39,10 +39,12 @@ class NonFiniteGuard:
         prefixes: Sequence[str] = ("Loss/", "Grads/"),
         raise_on_nonfinite: bool = False,
         counters=None,
+        on_fire: Optional[Callable[[str, float], None]] = None,
     ):
         self.prefixes: Tuple[str, ...] = tuple(prefixes)
         self.raise_on_nonfinite = bool(raise_on_nonfinite)
         self._counters = counters
+        self.on_fire = on_fire
         self._warned: set = set()
         self.fired = 0
 
@@ -63,6 +65,11 @@ class NonFiniteGuard:
         tracer = get_tracer()
         if tracer is not None:
             tracer.instant("nonfinite_metric", args={"metric": name, "value": str(v)})
+        if self.on_fire is not None:
+            try:
+                self.on_fire(name, v)
+            except Exception:
+                pass
         if name not in self._warned:
             self._warned.add(name)
             warnings.warn(
@@ -166,6 +173,21 @@ class StallWatchdog:
     def stalled_roles(self) -> list:
         with self._lock:
             return [r for r, f in self._flagged.items() if f]
+
+    def beat_ages(self) -> Dict[str, Dict[str, object]]:
+        """Seconds since each role's last beat (the live snapshot reads
+        this): ``{role: {"age_s", "paused", "beats"}}`` — a paused role is
+        blocked on its peer's exchange, so its age is idleness, not delay."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                role: {
+                    "age_s": round(now - last, 1),
+                    "paused": role in self._paused,
+                    "beats": self._beat_counts.get(role, 0),
+                }
+                for role, last in self._beats.items()
+            }
 
     def check(self) -> None:
         """One watchdog pass (the poll thread calls this; tests may too)."""

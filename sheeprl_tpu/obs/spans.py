@@ -36,6 +36,7 @@ import time
 from contextlib import ContextDecorator
 from typing import Any, Dict, Optional
 
+from sheeprl_tpu.obs import hist as _hist
 from sheeprl_tpu.utils.timer import timer
 
 __all__ = ["span", "TraceWriter", "get_tracer", "set_tracer"]
@@ -57,13 +58,23 @@ def set_tracer(tracer: Optional["TraceWriter"]) -> None:
 
 
 class TraceWriter:
-    """Thread-safe buffered Chrome trace-event JSONL writer."""
+    """Thread-safe buffered Chrome trace-event JSONL writer.
 
-    def __init__(self, path: str, xla_annotations: bool = True):
+    ``path=None`` runs the writer file-less: events are still produced (and
+    fed to ``ring`` — the flight recorder's bounded buffer) but nothing
+    touches the disk. That is how a run with ``metric.telemetry.trace=false``
+    keeps its flight recorder armed.
+    """
+
+    def __init__(self, path: Optional[str] = None, xla_annotations: bool = True, ring=None):
         self.path = path
         self.xla_annotations = bool(xla_annotations)
-        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-        self._file = open(path, "w")
+        self.ring = ring
+        if path is not None:
+            os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+            self._file = open(path, "w")
+        else:
+            self._file = None
         self._lock = threading.Lock()
         self._buffer: list[str] = []
         self._origin = time.perf_counter()
@@ -74,6 +85,16 @@ class TraceWriter:
             self._pid = int(jax.process_index())
         except Exception:
             self._pid = 0
+        # wall-clock anchor so tools/trace_view.py can align per-rank files
+        # captured by processes with different perf_counter origins
+        self._emit(
+            {
+                "ph": "M",
+                "name": "clock_sync",
+                "pid": self._pid,
+                "args": {"unix_ts": time.time()},
+            }
+        )
 
     # -- time ---------------------------------------------------------------
 
@@ -87,6 +108,10 @@ class TraceWriter:
     # -- events -------------------------------------------------------------
 
     def _emit(self, event: Dict[str, Any]) -> None:
+        if self.ring is not None:
+            self.ring.record(event)
+        if self._file is None:
+            return
         line = json.dumps(event)
         with self._lock:
             self._buffer.append(line)
@@ -165,7 +190,7 @@ class TraceWriter:
     # -- lifecycle ----------------------------------------------------------
 
     def _flush_locked(self) -> None:
-        if self._buffer and not self._file.closed:
+        if self._buffer and self._file is not None and not self._file.closed:
             self._file.write("\n".join(self._buffer) + "\n")
             self._file.flush()
         self._buffer.clear()
@@ -177,7 +202,7 @@ class TraceWriter:
     def close(self) -> None:
         with self._lock:
             self._flush_locked()
-            if not self._file.closed:
+            if self._file is not None and not self._file.closed:
                 self._file.close()
 
 
@@ -199,8 +224,9 @@ class span(ContextDecorator):
 
     def __enter__(self):
         tracer = _TRACER
+        if tracer is not None or _hist.installed() is not None:
+            self._t0 = time.perf_counter()
         if tracer is not None:
-            self._t0 = tracer.now()
             self._annotation = tracer.annotation(self.name)
             if self._annotation is not None:
                 self._annotation.__enter__()
@@ -213,8 +239,12 @@ class span(ContextDecorator):
             self._annotation.__exit__(*exc)
             self._annotation = None
         if self._t0 is not None:
+            t0, self._t0 = self._t0, None
+            t1 = time.perf_counter()
+            # histograms first: a slow-span trigger fired here lands its
+            # flight dump before this very event rotates into the ring
+            _hist.observe(self.name, t1 - t0)
             tracer = _TRACER
             if tracer is not None:
-                tracer.complete(self.name, self.phase, self._t0)
-            self._t0 = None
+                tracer.complete(self.name, self.phase, t0, t1)
         return False

@@ -38,7 +38,17 @@ key                       meaning
 ``ckpt_bytes``            checkpoint bytes landed on disk
 ``ckpt_saves``            completed checkpoint writes
 ``ckpt_failures``         writes that exhausted their retry budget
+``phase_percentiles``     per-phase ``p50/p95/p99`` span durations (ms) from
+                          the streaming histograms (``obs/hist.py``)
+``flight_dumps``          flight-recorder evidence files written
+``crashed``               True when the entrypoint raised; ``exception``
+                          then carries the type and message
 ========================  ====================================================
+
+The same object owns the **live plane** (``obs/live.py``): a periodic
+exporter that atomically rewrites ``telemetry/live.json`` with this summary
+plus rolling-window rates and watchdog beat ages, an optional Prometheus
+endpoint, and the anomaly-triggered flight recorder.
 """
 
 from __future__ import annotations
@@ -49,7 +59,9 @@ import time
 from typing import Any, Dict, Optional
 
 from sheeprl_tpu.obs import counters as _counters
+from sheeprl_tpu.obs import hist as _hist
 from sheeprl_tpu.obs.health import NonFiniteGuard, StallWatchdog
+from sheeprl_tpu.obs.live import FlightRecorder, LiveExporter, PromServer, atomic_write_json
 from sheeprl_tpu.obs.perf import PEAK_TFLOPS_BF16, mfu_pct
 from sheeprl_tpu.obs.spans import TraceWriter, set_tracer
 
@@ -61,6 +73,15 @@ _ACTIVE: Optional["Telemetry"] = None
 def get_telemetry() -> Optional["Telemetry"]:
     """The active run telemetry, or None when disabled."""
     return _ACTIVE
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
 
 
 class Telemetry:
@@ -75,11 +96,25 @@ class Telemetry:
         self.summary_enabled = bool(tcfg.get("summary", True))
         self.summary_path: Optional[str] = tcfg.get("summary_path") or None
         self.peak_tflops = float(tcfg.get("peak_tflops", PEAK_TFLOPS_BF16))
+        # live plane (obs/live.py)
+        self.live_interval_s = float(tcfg.get("live_interval_s", 30.0) or 0.0)
+        self.live_window_s = float(tcfg.get("live_window_s", 60.0) or 60.0)
+        self.serve_port: Optional[int] = (
+            int(tcfg.get("serve_port") or 0) or None
+        )
+        self.histograms_enabled = bool(tcfg.get("histograms", True))
+        self._flight_cfg = dict(tcfg.get("flight", {}) or {})
 
         self.counters = _counters.Counters()
         self.tracer: Optional[TraceWriter] = None
         self.poller: Optional[_counters.DevicePoller] = None
         self.guard: Optional[NonFiniteGuard] = None
+        self.hists: Optional[_hist.HistogramSet] = None
+        self.flight: Optional[FlightRecorder] = None
+        self.live: Optional[LiveExporter] = None
+        self.prom: Optional[PromServer] = None
+        self.run_dir: Optional[str] = None
+        self._rank = 0
         self._watchdogs: list[StallWatchdog] = []
         self._t_start = time.perf_counter()
         self._finalized = False
@@ -106,49 +141,159 @@ class Telemetry:
         if self.poll_interval_s > 0:
             self.poller = _counters.DevicePoller(self.poll_interval_s)
             self.poller.start()
+        fcfg = self._flight_cfg
+        if bool(fcfg.get("enabled", True)):
+            self.flight = FlightRecorder(
+                capacity=int(fcfg.get("ring_events", 2048)),
+                min_interval_s=float(fcfg.get("min_interval_s", 30.0)),
+                max_dumps=int(fcfg.get("max_dumps", 8)),
+                profiler_capture_s=float(fcfg.get("profiler_capture_s", 0.0) or 0.0),
+                step_source=lambda: self.policy_steps,
+                context_fn=self._flight_context,
+            )
+            self._recompile_warmup_s = float(fcfg.get("recompile_warmup_s", 120.0))
+            _counters.set_compile_hook(self._on_compile)
+        if self.histograms_enabled:
+            self.hists = _hist.HistogramSet(
+                slow_factor=(
+                    float(fcfg.get("slow_span_factor", 8.0)) if self.flight is not None else 0.0
+                ),
+                slow_warmup=int(fcfg.get("slow_span_warmup", 64)),
+                slow_min_s=float(fcfg.get("slow_span_min_ms", 100.0)) / 1e3,
+                on_slow=self._on_slow_span if self.flight is not None else None,
+            )
+            _hist.install(self.hists)
         guard_cfg = self.cfg.get("health", {}) or {}
         if bool(guard_cfg.get("nan_guard", True)):
             self.guard = NonFiniteGuard(
                 prefixes=tuple(guard_cfg.get("nan_guard_prefixes", ("Loss/", "Grads/"))),
                 raise_on_nonfinite=bool(guard_cfg.get("raise_on_nonfinite", False)),
                 counters=self.counters,
+                on_fire=self._on_nonfinite if self.flight is not None else None,
             )
             from sheeprl_tpu.utils.metric import set_value_guard
 
             set_value_guard(self.guard)
         if self.trace_file:  # explicit path: trace from the very beginning
             self._open_tracer(self.trace_file)
+        elif not self.trace_enabled and self.flight is not None:
+            # no trace file wanted, but the flight recorder still needs the
+            # event stream: run the writer file-less from the start
+            self._open_tracer(None)
 
-    def _open_tracer(self, path: str) -> None:
-        if self.tracer is not None or not self.trace_enabled:
+    def _open_tracer(self, path: Optional[str]) -> None:
+        if self.tracer is not None:
             return
-        self.tracer = TraceWriter(path, xla_annotations=self.xla_annotations)
+        file_path = path if self.trace_enabled else None
+        if file_path is None and self.flight is None:
+            return
+        self.tracer = TraceWriter(
+            file_path, xla_annotations=self.xla_annotations, ring=self.flight
+        )
         set_tracer(self.tracer)
 
     def attach_run_dir(self, log_dir: str) -> None:
-        """Called once the versioned run directory exists (logger layer)."""
-        if not log_dir:
-            return
-        try:
-            import jax
+        """Called once the versioned run directory exists (logger layer).
 
-            if jax.process_index() != 0:
-                return
-        except Exception:
-            pass
+        Rank 0 owns the summary, the live exporter, and ``trace.jsonl``;
+        other ranks write per-rank trace files (``trace_rank<k>.jsonl``,
+        merged by ``tools/trace_view.py``) and dump their histograms at
+        finalize for rank 0's cross-rank percentile merge."""
+        if not log_dir or self.run_dir is not None:
+            return
+        self.run_dir = log_dir
+        self._rank = _process_index()
+        tel_dir = os.path.join(log_dir, "telemetry")
+        if self.flight is not None:
+            self.flight.attach_dir(
+                tel_dir, tag="" if self._rank == 0 else f"_r{self._rank}"
+            )
+        if self._rank != 0:
+            self._open_tracer(os.path.join(tel_dir, f"trace_rank{self._rank}.jsonl"))
+            return
         if self.summary_path is None:
             self.summary_path = os.path.join(log_dir, "telemetry.json")
-        self._open_tracer(os.path.join(log_dir, "telemetry", "trace.jsonl"))
+        self._open_tracer(os.path.join(tel_dir, "trace.jsonl"))
+        if self.live_interval_s > 0 or self.serve_port:
+            self.live = LiveExporter(
+                self._live_snapshot,
+                os.path.join(tel_dir, "live.json"),
+                interval_s=self.live_interval_s,
+                window_s=self.live_window_s,
+            )
+            self.live.start()
+            if self.serve_port is not None:
+                try:
+                    self.prom = PromServer(self.live, self.serve_port)
+                    self.prom.start()
+                except OSError as exc:
+                    import warnings
+
+                    warnings.warn(
+                        f"telemetry: cannot serve metrics on port "
+                        f"{self.serve_port}: {exc}"
+                    )
 
     def watchdog(self, **kwargs) -> StallWatchdog:
         """A stall watchdog wired to this run's counters and timeout config.
 
         The telemetry stops it at finalize; callers still stop it eagerly
-        when their threads exit so a finished run is not flagged."""
+        when their threads exit so a finished run is not flagged. A stall
+        additionally fires the flight recorder, so the evidence ring is
+        dumped while the wedged thread is still wedged."""
         kwargs.setdefault("timeout_s", self.stall_timeout_s)
+        user_on_stall = kwargs.pop("on_stall", None)
+        flight = self.flight
+        if flight is not None:
+
+            def _on_stall(role: str, age_s: float) -> None:
+                flight.trigger("stall", {"role": role, "age_s": round(age_s, 1)})
+                if user_on_stall is not None:
+                    user_on_stall(role, age_s)
+
+            kwargs["on_stall"] = _on_stall
+        elif user_on_stall is not None:
+            kwargs["on_stall"] = user_on_stall
         dog = StallWatchdog(counters=self.counters, **kwargs)
         self._watchdogs.append(dog)
         return dog
+
+    # -- flight-recorder triggers -------------------------------------------
+
+    def _flight_context(self) -> Dict[str, Any]:
+        return {
+            "counters": self.counters.as_dict(),
+            "phase_percentiles": self.hists.percentiles() if self.hists is not None else {},
+        }
+
+    def _on_slow_span(self, name: str, seconds: float, p50: float) -> None:
+        self.flight.trigger(
+            "slow_span",
+            {
+                "span": name,
+                "duration_ms": round(seconds * 1e3, 3),
+                "running_p50_ms": round(p50 * 1e3, 3),
+            },
+        )
+
+    def _on_compile(self, duration_s: float) -> None:
+        # cold-start compiles are expected; only a POST-warmup recompile (a
+        # shape/dtype leaking into a jitted signature mid-run) is an anomaly
+        if time.perf_counter() - self._t_start < self._recompile_warmup_s:
+            return
+        self.flight.trigger("recompile", {"compile_s": round(duration_s, 3)})
+
+    def _on_nonfinite(self, name: str, value: float) -> None:
+        self.flight.trigger("nonfinite", {"metric": name, "value": str(value)})
+
+    def _live_snapshot(self) -> Dict[str, Any]:
+        snap = self.summary()
+        snap["watchdog_beat_age_s"] = {
+            role: info
+            for dog in self._watchdogs
+            for role, info in dog.beat_ages().items()
+        }
+        return snap
 
     # -- run accounting -----------------------------------------------------
 
@@ -214,28 +359,71 @@ class Telemetry:
             if self.poller is not None
             else {"peak_hbm_bytes": 0, "hbm_bytes_limit": 0, "hbm_samples": 0}
         )
-        if self.tracer is not None:
+        out["phase_percentiles"] = (
+            self.hists.percentiles() if self.hists is not None else {}
+        )
+        out["flight_dumps"] = self.flight.dumps if self.flight is not None else 0
+        out["flight_suppressed"] = self.flight.suppressed if self.flight is not None else 0
+        if self.tracer is not None and self.tracer.path:
             out["trace_file"] = self.tracer.path
         return out
 
-    def finalize(self, print_summary: bool = True) -> Optional[Dict[str, Any]]:
+    def _sync_rank_hists(self) -> None:
+        """Cross-rank percentile merge over the shared run dir: ranks > 0
+        dump their histograms at finalize, rank 0 merges whatever dumps have
+        landed (best-effort — a rank finalizing after rank 0 is missed, the
+        dumps stay on disk for offline merging via ``obs.hist``)."""
+        if self.hists is None or not self.run_dir:
+            return
+        tel_dir = os.path.join(self.run_dir, "telemetry")
+        if self._rank != 0:
+            try:
+                atomic_write_json(
+                    os.path.join(tel_dir, f"hist_rank{self._rank}.json"),
+                    self.hists.to_dict(),
+                )
+            except OSError:
+                pass
+            return
+        import glob
+
+        for path in sorted(glob.glob(os.path.join(tel_dir, "hist_rank*.json"))):
+            try:
+                with open(path) as f:
+                    self.hists.merge_dict(json.load(f))
+            except Exception:
+                pass  # a torn/foreign dump must not break finalize
+
+    def finalize(
+        self, print_summary: bool = True, error: Optional[BaseException] = None
+    ) -> Optional[Dict[str, Any]]:
         if self._finalized:
             return None
         self._finalized = True
         for dog in self._watchdogs:
             dog.stop()
+        if self.prom is not None:
+            self.prom.stop()
+        if self.live is not None:
+            self.live.stop()  # writes the final live.json
         if self.poller is not None:
             self.poller.stop()
         if self.guard is not None:
             from sheeprl_tpu.utils.metric import set_value_guard
 
             set_value_guard(None)
+        _counters.set_compile_hook(None)
+        self._sync_rank_hists()
         summary = self.summary()
+        summary["crashed"] = error is not None
+        if error is not None:
+            summary["exception"] = f"{type(error).__name__}: {error}"[:300]
         if self.tracer is not None:
             set_tracer(None)
             self.tracer.close()
         _counters.install(None)
-        if self.summary_enabled and self.summary_path:
+        _hist.install(None)
+        if self.summary_enabled and self.summary_path and self._rank == 0:
             os.makedirs(os.path.dirname(os.path.abspath(self.summary_path)), exist_ok=True)
             with open(self.summary_path, "w") as f:
                 json.dump(summary, f, indent=2, sort_keys=True)
@@ -287,6 +475,21 @@ class Telemetry:
                 f"{s['ckpt_write_ms']:.0f} ms write time"
                 + (f" · {s['ckpt_failures']} FAILED" if s["ckpt_failures"] else "")
             )
+        tails = []
+        for name, label in (
+            ("Time/train_time", "train"),
+            ("Time/env_interaction_time", "env"),
+            ("Time/stage_h2d_time", "stage"),
+        ):
+            pct = s.get("phase_percentiles", {}).get(name)
+            if pct and pct.get("p95_ms") is not None:
+                tails.append(f"{label} p50/p95 {pct['p50_ms']:.0f}/{pct['p95_ms']:.0f} ms")
+        if tails:
+            lines.append("  tails: " + " · ".join(tails))
+        if s.get("crashed"):
+            lines.append(f"  CRASHED: {s.get('exception', '?')}")
+        if s.get("flight_dumps"):
+            lines.append(f"  flight recorder fired {s['flight_dumps']} time(s)")
         if self.summary_enabled and self.summary_path:
             lines.append(f"  written to {self.summary_path}")
         if "trace_file" in s:
@@ -313,10 +516,15 @@ def setup_telemetry(cfg) -> Optional[Telemetry]:
     return telemetry
 
 
-def finalize_telemetry(print_summary: bool = True) -> Optional[Dict[str, Any]]:
-    """Finalize and deactivate the run telemetry (idempotent)."""
+def finalize_telemetry(
+    print_summary: bool = True, error: Optional[BaseException] = None
+) -> Optional[Dict[str, Any]]:
+    """Finalize and deactivate the run telemetry (idempotent). ``error`` is
+    the exception that ended the run (if any) — the summary then records
+    ``"crashed": true`` plus the exception type alongside the partial
+    counters, so a dead run's last telemetry is still evidence."""
     global _ACTIVE
     telemetry, _ACTIVE = _ACTIVE, None
     if telemetry is None:
         return None
-    return telemetry.finalize(print_summary=print_summary)
+    return telemetry.finalize(print_summary=print_summary, error=error)

@@ -20,4 +20,9 @@ def _reset_telemetry_globals():
         tracer.close()
     set_tracer(None)
     obs_counters.install(None)
+    obs_counters.set_compile_hook(None)
     set_value_guard(None)
+
+    from sheeprl_tpu.obs import hist as obs_hist
+
+    obs_hist.install(None)

@@ -131,6 +131,48 @@ def test_stall_watchdog_unregister_silences_finished_role():
     assert watchdog.stall_events == []
 
 
+def test_stall_watchdog_backpressure_never_blames_the_healthy_role():
+    """Regression for the backpressure contract: a role blocked on its
+    peer's exchange (paused) must stay suppressed through MANY watchdog
+    passes while the peer is merely slow, and the suppression must not leak
+    to the unpaused role — the wedged side is always the unpaused one."""
+    counters = Counters()
+    watchdog = StallWatchdog(timeout_s=0.05, poll_s=10, warmup_factor=1.0, counters=counters)
+    watchdog.register("player")
+    watchdog.register("trainer")
+    watchdog.pause("player")  # queue full: waiting on the trainer
+    time.sleep(0.08)
+    with pytest.warns(RuntimeWarning, match="trainer"):
+        watchdog.check()  # the trainer IS wedged and must still be flagged
+    for _ in range(4):  # repeated passes: pause is a state, not a one-shot
+        watchdog.check()
+    assert [role for role, _ in watchdog.stall_events] == ["trainer"]
+    assert counters.stalls == 1  # flagged once per episode, 5 passes or not
+    ages = watchdog.beat_ages()
+    assert ages["player"]["paused"] is True and ages["trainer"]["paused"] is False
+    assert ages["trainer"]["age_s"] >= 0.0
+    # the player hands back the exchange and beats: monitoring re-arms
+    watchdog.beat("player")
+    assert watchdog.beat_ages()["player"]["paused"] is False
+    time.sleep(0.08)
+    with pytest.warns(RuntimeWarning, match="player"):
+        watchdog.check()
+    assert [role for role, _ in watchdog.stall_events] == ["trainer", "player"]
+
+
+def test_stall_watchdog_beat_ages_reports_all_roles():
+    watchdog = StallWatchdog(timeout_s=10, poll_s=10)
+    watchdog.register("player")
+    watchdog.beat("player")
+    watchdog.register("trainer")
+    watchdog.pause("trainer")
+    ages = watchdog.beat_ages()
+    assert set(ages) == {"player", "trainer"}
+    assert ages["player"]["beats"] == 1 and not ages["player"]["paused"]
+    assert ages["trainer"]["paused"] is True
+    assert all(a["age_s"] >= 0.0 for a in ages.values())
+
+
 def test_stall_watchdog_pause_suspends_monitoring():
     """A role blocked on the player<->trainer exchange pauses itself; waiting
     for the peer is idleness, not a stall. beat()/resume() re-arm it."""

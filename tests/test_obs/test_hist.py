@@ -1,0 +1,153 @@
+"""Streaming-histogram unit tests: log-bucket determinism, percentile
+accuracy within bucket resolution, exact merge across thread splits (the
+property the cross-rank/role percentile merge relies on), serialization
+round trips, and the slow-span trigger the flight recorder hooks."""
+
+import json
+import random
+import threading
+
+import pytest
+
+from sheeprl_tpu.obs import hist as hist_mod
+from sheeprl_tpu.obs.hist import (
+    BUCKETS_PER_OCTAVE,
+    HistogramSet,
+    StreamingHist,
+    bucket_bounds,
+    bucket_index,
+)
+
+
+def test_bucket_index_is_log_spaced_and_deterministic():
+    # one bucket per 2**(1/8): indices step by BUCKETS_PER_OCTAVE per octave
+    assert bucket_index(2.0) - bucket_index(1.0) == BUCKETS_PER_OCTAVE
+    assert bucket_index(0.004) == bucket_index(0.004)
+    lo, hi = bucket_bounds(bucket_index(0.0123))
+    assert lo <= 0.0123 < hi
+    # relative bucket width ~9% — the percentile error bound
+    assert hi / lo == pytest.approx(2 ** (1 / BUCKETS_PER_OCTAVE))
+
+
+def test_percentiles_within_bucket_resolution():
+    rng = random.Random(0)
+    values = [rng.lognormvariate(-3.0, 0.7) for _ in range(20_000)]
+    h = StreamingHist()
+    for v in values:
+        h.record(v)
+    values.sort()
+    tol = 2 ** (1 / BUCKETS_PER_OCTAVE)  # one bucket of relative error
+    for q in (0.50, 0.95, 0.99):
+        true = values[int(q * len(values))]
+        est = h.quantile(q)
+        assert true / tol <= est <= true * tol, (q, true, est)
+    pct = h.percentiles()
+    assert pct["count"] == 20_000
+    assert pct["p50_ms"] < pct["p95_ms"] < pct["p99_ms"]
+    assert pct["max_ms"] == pytest.approx(max(values) * 1e3, rel=1e-6)
+
+
+def test_zero_and_negative_values_count_but_sort_first():
+    h = StreamingHist()
+    for _ in range(90):
+        h.record(0.0)
+    for _ in range(10):
+        h.record(1.0)
+    assert h.n == 100
+    assert h.quantile(0.5) == 0.0
+    assert h.quantile(0.99) > 0.0
+
+
+def test_merge_is_exact_across_any_thread_split():
+    """The same observations, recorded serially vs split over 4 threads into
+    4 histograms and merged, produce bit-identical bucket maps."""
+    rng = random.Random(7)
+    values = [rng.lognormvariate(-4.0, 1.0) for _ in range(8_000)]
+
+    serial = StreamingHist()
+    for v in values:
+        serial.record(v)
+
+    parts = [StreamingHist() for _ in range(4)]
+
+    def worker(i):
+        for v in values[i::4]:
+            parts[i].record(v)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    merged = StreamingHist()
+    for p in parts:
+        merged.merge(p)
+    assert merged.counts == serial.counts
+    assert merged.n == serial.n and merged.zero == serial.zero
+    assert merged.percentiles() == serial.percentiles()
+
+
+def test_serialization_round_trip_and_cross_set_merge():
+    rng = random.Random(3)
+    a, b = HistogramSet(), HistogramSet()
+    for _ in range(500):
+        a.observe("Time/train_time", rng.lognormvariate(-3, 0.5))
+        b.observe("Time/train_time", rng.lognormvariate(-3, 0.5))
+        b.observe("Time/env_interaction_time", rng.lognormvariate(-5, 0.5))
+
+    dump = json.loads(json.dumps(b.to_dict()))  # through-JSON like hist_rank files
+    a.merge_dict(dump)
+    assert a.get("Time/train_time").n == 1000
+    assert a.get("Time/env_interaction_time").n == 500
+    # a dump with a different bucket base must be rejected, not mis-merged
+    bad = {"Time/train_time": {**dump["Time/train_time"], "buckets_per_octave": 4}}
+    with pytest.raises(ValueError):
+        HistogramSet().merge_dict(bad)
+
+
+def test_slow_span_trigger_arms_after_warmup():
+    fired = []
+    hs = HistogramSet(slow_factor=5.0, slow_warmup=10, on_slow=lambda *a: fired.append(a))
+    for _ in range(9):
+        hs.observe("Time/train_time", 0.010)
+    hs.observe("Time/train_time", 1.0)  # 100x p50, but inside warmup
+    assert fired == []
+    for _ in range(5):
+        hs.observe("Time/train_time", 0.010)
+    hs.observe("Time/train_time", 0.012)  # normal jitter: no trigger
+    assert fired == []
+    hs.observe("Time/train_time", 0.200)  # 20x the running p50
+    assert len(fired) == 1
+    name, seconds, p50 = fired[0]
+    assert name == "Time/train_time" and seconds == 0.200
+    assert 0.005 < p50 < 0.05
+
+
+def test_slow_span_absolute_floor_suppresses_micro_jitter():
+    """A 10x outlier on a sub-ms phase is GC noise, not an anomaly: below
+    the absolute floor the trigger must stay quiet, above it fire."""
+    fired = []
+    hs = HistogramSet(
+        slow_factor=5.0, slow_warmup=5, slow_min_s=0.1, on_slow=lambda *a: fired.append(a)
+    )
+    for _ in range(20):
+        hs.observe("Time/env_interaction_time", 0.0004)
+    hs.observe("Time/env_interaction_time", 0.004)  # 10x p50, under the floor
+    assert fired == []
+    for _ in range(20):
+        hs.observe("Time/train_time", 0.030)
+    hs.observe("Time/train_time", 0.300)  # 10x p50 AND above the floor
+    assert [f[0] for f in fired] == ["Time/train_time"]
+
+
+def test_module_observe_is_noop_until_installed():
+    assert hist_mod.installed() is None
+    hist_mod.observe("Time/train_time", 0.5)  # must not allocate or raise
+    hs = HistogramSet()
+    hist_mod.install(hs)
+    try:
+        hist_mod.observe("Time/train_time", 0.5)
+        assert hs.get("Time/train_time").n == 1
+    finally:
+        hist_mod.install(None)

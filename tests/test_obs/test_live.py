@@ -1,0 +1,321 @@
+"""Live-plane tests: the periodic atomic live.json exporter and its rolling
+rates, the Prometheus text endpoint, the flight recorder's triggers/rate
+limits, and the zero-cost invariant when telemetry is disabled."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from sheeprl_tpu.obs.live import (
+    FlightRecorder,
+    LiveExporter,
+    PromServer,
+    prometheus_text,
+)
+from sheeprl_tpu.obs.spans import get_tracer, set_tracer, span
+from sheeprl_tpu.obs.telemetry import Telemetry
+
+
+def _telemetry(tmp_path, **overrides):
+    """An active Telemetry with fast cadences, attached to a tmp run dir."""
+    tcfg = {
+        "enabled": True,
+        "trace": True,
+        "xla_annotations": False,
+        "poll_interval_s": 0,  # no device poller thread in unit tests
+        "stall_timeout_s": 0,
+        "summary": False,
+        "live_interval_s": 0.05,
+        "live_window_s": 10.0,
+        "flight": {
+            "enabled": True,
+            "ring_events": 64,
+            "slow_span_factor": 4.0,
+            "slow_span_warmup": 8,
+            "min_interval_s": 0.0,
+            "max_dumps": 4,
+        },
+    }
+    for key, value in overrides.items():
+        if isinstance(value, dict) and isinstance(tcfg.get(key), dict):
+            tcfg[key].update(value)
+        else:
+            tcfg[key] = value
+    telemetry = Telemetry(tcfg)
+    telemetry.start()
+    telemetry.attach_run_dir(str(tmp_path))
+    return telemetry
+
+
+# -- exporter -----------------------------------------------------------------
+
+
+def test_live_exporter_writes_snapshot_with_rolling_rates(tmp_path):
+    counters = {"policy_steps": 0, "bytes_staged_h2d": 0}
+    clock = {"t": 0.0}
+
+    def snapshot_fn():
+        return dict(counters, train_steps=0)
+
+    exporter = LiveExporter(
+        snapshot_fn, str(tmp_path / "live.json"), interval_s=0, window_s=60.0
+    )
+    exporter.write_once()
+    first = json.load(open(tmp_path / "live.json"))
+    assert first["rolling"]["sps"] is None  # one sample: no rate yet
+    time.sleep(0.05)
+    counters["policy_steps"] = 500
+    counters["bytes_staged_h2d"] = 1 << 20
+    exporter.write_once()
+    snap = json.load(open(tmp_path / "live.json"))
+    assert snap["ts_unix"] > 0
+    assert snap["rolling"]["window_s"] > 0
+    assert snap["rolling"]["sps"] > 0
+    assert snap["rolling"]["bytes_staged_h2d_per_s"] > 0
+    assert exporter.writes == 2
+
+
+def test_live_exporter_thread_writes_initial_and_final_snapshot(tmp_path):
+    exporter = LiveExporter(
+        lambda: {"policy_steps": 1}, str(tmp_path / "live.json"), interval_s=30.0
+    )
+    exporter.start()
+    try:
+        deadline = time.monotonic() + 5
+        while exporter.writes == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # the interval is 30s but one snapshot lands immediately at start —
+        # even a run shorter than one interval leaves a live.json
+        assert exporter.writes >= 1
+    finally:
+        exporter.stop()
+    assert exporter.writes >= 2  # stop wrote the final state
+    assert json.load(open(tmp_path / "live.json"))["policy_steps"] == 1
+    assert not any(t.name == "obs-live-exporter" for t in threading.enumerate())
+
+
+# -- prometheus endpoint ------------------------------------------------------
+
+
+def test_prometheus_text_renders_scalars_percentiles_and_labels():
+    text = prometheus_text(
+        {
+            "sps": 123.4,
+            "bytes_staged_h2d": 1024,
+            "run_wall_s": None,  # null metrics are skipped, not rendered
+            "phase_percentiles": {
+                "Time/train_time": {"count": 10, "p50_ms": 5.0, "p95_ms": 9.0, "p99_ms": 9.9}
+            },
+            "rolling": {"sps": 7.5, "window_s": 60.0},
+            "watchdog_beat_age_s": {"player": {"age_s": 1.5, "paused": False}},
+        }
+    )
+    assert "sheeprl_sps 123.4" in text
+    assert "sheeprl_bytes_staged_h2d 1024" in text
+    assert "run_wall_s" not in text
+    assert 'sheeprl_phase_duration_ms{phase="Time/train_time",quantile="0.95"} 9' in text
+    assert "sheeprl_rolling_sps 7.5" in text
+    assert 'sheeprl_watchdog_beat_age_seconds{role="player"} 1.5' in text
+
+
+def test_prom_server_serves_metrics_and_json(tmp_path):
+    state = {"policy_steps": 42}
+    exporter = LiveExporter(
+        lambda: {**state, "phase_percentiles": {}, "rolling": {}},
+        str(tmp_path / "live.json"),
+        interval_s=0,  # serve-only mode: no exporter thread refreshes
+    )
+    server = PromServer(exporter, port=0)  # ephemeral port
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = urllib.request.urlopen(f"{base}/metrics", timeout=5).read().decode()
+        assert "sheeprl_policy_steps 42" in body
+        doc = json.loads(urllib.request.urlopen(f"{base}/", timeout=5).read())
+        assert doc["policy_steps"] == 42
+        # serve-only must not freeze at the first scrape: past the staleness
+        # cap a later scrape sees the run's progress
+        state["policy_steps"] = 99
+        time.sleep(1.1)
+        doc = json.loads(urllib.request.urlopen(f"{base}/", timeout=5).read())
+        assert doc["policy_steps"] == 99
+    finally:
+        server.stop()
+    assert not any(t.name == "obs-prom-endpoint" for t in threading.enumerate())
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_recorder_ring_is_bounded_and_dump_has_evidence(tmp_path):
+    recorder = FlightRecorder(
+        capacity=8, min_interval_s=0.0, max_dumps=4, out_dir=str(tmp_path),
+        step_source=lambda: 1234, context_fn=lambda: {"counters": {"stalls": 1}},
+    )
+    for i in range(50):
+        recorder.record({"name": f"e{i}", "ph": "X"})
+    path = recorder.trigger("slow_span", {"span": "Time/train_time"})
+    assert os.path.basename(path) == "flight_slow_span_1234.json"
+    dump = json.load(open(path))
+    assert dump["reason"] == "slow_span"
+    assert dump["step"] == 1234
+    assert dump["context"]["counters"]["stalls"] == 1
+    assert [e["name"] for e in dump["events"]] == [f"e{i}" for i in range(42, 50)]
+
+
+def test_flight_recorder_rate_limit_and_max_dumps(tmp_path):
+    recorder = FlightRecorder(
+        capacity=4, min_interval_s=30.0, max_dumps=2, out_dir=str(tmp_path)
+    )
+    first = recorder.trigger("stall", {})
+    assert first is not None
+    assert recorder.trigger("stall", {}) is None  # inside min_interval_s
+    assert recorder.suppressed == 1
+    recorder._last_dump_t -= 100  # age the last dump past the interval
+    second = recorder.trigger("stall", {})
+    assert second is not None
+    recorder._last_dump_t -= 100
+    assert recorder.trigger("stall", {}) is None  # max_dumps reached
+    assert recorder.dumps == 2
+    # one dump of a storm shows the storm's size since the previous dump
+    assert json.load(open(first))["suppressed_before"] == 0
+    assert json.load(open(second))["suppressed_before"] == 1
+
+
+def test_flight_recorder_failed_write_returns_budget(tmp_path, monkeypatch):
+    recorder = FlightRecorder(
+        capacity=4, min_interval_s=0.0, max_dumps=1, out_dir=str(tmp_path / "gone")
+    )
+    monkeypatch.setattr(
+        "sheeprl_tpu.obs.live.atomic_write_json",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")),
+    )
+    assert recorder.trigger("stall", {}) is None
+    assert recorder.dumps == 0  # nothing landed: the budget came back
+    monkeypatch.undo()
+    assert recorder.trigger("stall", {}) is not None  # retried and landed
+    assert recorder.dumps == 1
+
+
+def test_flight_recorder_without_dir_suppresses(tmp_path):
+    recorder = FlightRecorder(min_interval_s=0.0)
+    assert recorder.trigger("stall", {}) is None
+    assert recorder.suppressed == 1
+
+
+# -- telemetry-level wiring ---------------------------------------------------
+
+
+def test_slow_span_fires_flight_dump_through_real_spans(tmp_path):
+    telemetry = _telemetry(tmp_path)
+    try:
+        for _ in range(12):
+            with span("Time/train_time", phase="train"):
+                time.sleep(0.002)
+        with span("Time/train_time", phase="train"):
+            time.sleep(0.2)  # ~100x the running p50: the anomaly
+    finally:
+        summary = telemetry.finalize(print_summary=False)
+    dumps = list((tmp_path / "telemetry").glob("flight_slow_span_*.json"))
+    assert len(dumps) == 1
+    dump = json.load(open(dumps[0]))
+    assert dump["detail"]["span"] == "Time/train_time"
+    assert dump["detail"]["duration_ms"] > dump["detail"]["running_p50_ms"] * 4
+    assert any(e.get("name") == "Time/train_time" for e in dump["events"])
+    assert summary["flight_dumps"] == 1
+    assert summary["phase_percentiles"]["Time/train_time"]["count"] == 13
+
+
+def test_flight_ring_armed_with_trace_file_disabled(tmp_path):
+    """bench runs use trace=false; the flight recorder must still see span
+    events (file-less TraceWriter) and dump on a trigger."""
+    telemetry = _telemetry(tmp_path, trace=False)
+    try:
+        assert get_tracer() is not None and get_tracer().path is None
+        for _ in range(12):
+            with span("Time/train_time", phase="train"):
+                time.sleep(0.002)
+        with span("Time/train_time", phase="train"):
+            time.sleep(0.15)
+    finally:
+        summary = telemetry.finalize(print_summary=False)
+    assert not (tmp_path / "telemetry" / "trace.jsonl").exists()
+    assert "trace_file" not in summary
+    dumps = list((tmp_path / "telemetry").glob("flight_slow_span_*.json"))
+    assert len(dumps) == 1
+    assert any(
+        e.get("name") == "Time/train_time" for e in json.load(open(dumps[0]))["events"]
+    )
+
+
+def test_watchdog_stall_fires_flight_dump(tmp_path):
+    telemetry = _telemetry(tmp_path)
+    try:
+        dog = telemetry.watchdog(timeout_s=0.02, poll_s=10, warmup_factor=1.0)
+        dog.register("player")
+        time.sleep(0.05)
+        with pytest.warns(RuntimeWarning, match="player"):
+            dog.check()
+    finally:
+        telemetry.finalize(print_summary=False)
+    dumps = list((tmp_path / "telemetry").glob("flight_stall_*.json"))
+    assert len(dumps) == 1
+    assert json.load(open(dumps[0]))["detail"]["role"] == "player"
+
+
+def test_nonfinite_loss_fires_flight_dump(tmp_path):
+    telemetry = _telemetry(tmp_path)
+    try:
+        with pytest.warns(RuntimeWarning, match="non-finite"):
+            telemetry.guard("Loss/value_loss", float("nan"))
+    finally:
+        telemetry.finalize(print_summary=False)
+    dumps = list((tmp_path / "telemetry").glob("flight_nonfinite_*.json"))
+    assert len(dumps) == 1
+    assert json.load(open(dumps[0]))["detail"]["metric"] == "Loss/value_loss"
+
+
+def test_live_json_written_during_run_and_at_finalize(tmp_path):
+    telemetry = _telemetry(tmp_path)
+    try:
+        telemetry.record_window(policy_steps=100, train_steps=10)
+        live_path = tmp_path / "telemetry" / "live.json"
+        deadline = time.monotonic() + 5
+        while not live_path.exists() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert live_path.exists()
+        telemetry.record_window(policy_steps=100)
+        with span("Time/train_time", phase="train"):
+            time.sleep(0.002)
+    finally:
+        telemetry.finalize(print_summary=False)
+    snap = json.load(open(tmp_path / "telemetry" / "live.json"))
+    # the final stop() write sees everything accounted so far
+    assert snap["policy_steps"] == 200
+    assert "rolling" in snap and "watchdog_beat_age_s" in snap
+    assert snap["phase_percentiles"]["Time/train_time"]["count"] == 1
+    assert snap["flight_dumps"] == 0
+
+
+def test_disabled_telemetry_has_no_threads_histograms_or_ring():
+    """The PR-1 invariant extended to the live plane: with telemetry off, a
+    span is a plain timer — no exporter/server threads, no histogram set, no
+    flight ring, no tracer."""
+    from sheeprl_tpu.obs import hist as hist_mod
+    from sheeprl_tpu.obs.telemetry import get_telemetry
+
+    assert get_telemetry() is None and get_tracer() is None
+    assert hist_mod.installed() is None
+    before = {t.name for t in threading.enumerate()}
+    scope = span("Time/train_time", phase="train")
+    with scope:
+        pass
+    assert scope._t0 is None  # never read a clock beyond the plain timer
+    after = {t.name for t in threading.enumerate()}
+    assert before == after
+    for name in after:
+        assert not name.startswith(("obs-live", "obs-prom", "obs-flight"))

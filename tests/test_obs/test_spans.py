@@ -54,8 +54,12 @@ def test_trace_jsonl_schema_round_trip(tmp_path):
     assert env["dur"] >= 10_000 * 0.5  # slept 10ms, µs scale
     assert any(e["ph"] == "C" and e["args"] == {"0": 123.0} for e in events)
     assert any(e["ph"] == "i" and e["name"] == "stall" for e in events)
-    # thread-name metadata emitted once per thread
-    assert sum(e["ph"] == "M" for e in events) == 1
+    # thread-name metadata emitted once per thread, plus the one clock_sync
+    # wall-clock anchor tools/trace_view.py aligns per-rank files on
+    metas = [e for e in events if e["ph"] == "M"]
+    assert sum(e["name"] == "thread_name" for e in metas) == 1
+    syncs = [e for e in metas if e["name"] == "clock_sync"]
+    assert len(syncs) == 1 and syncs[0]["args"]["unix_ts"] > 0
 
 
 def test_span_accumulates_into_timer_registry(tmp_path):

@@ -1,11 +1,15 @@
 """End-to-end telemetry: a short PPO run with ``metric.telemetry.enabled=true``
-must produce a valid Chrome trace-event JSONL and a ``telemetry.json`` with
-the headline keys (the ISSUE's acceptance criterion), and the config group
-must compose."""
+must produce a valid Chrome trace-event JSONL, at least one live snapshot
+(``telemetry/live.json`` with rolling rates and per-phase percentiles), and a
+``telemetry.json`` with the headline keys (the ISSUE's acceptance criteria);
+a crashing entrypoint must still leave a ``telemetry.json`` recording the
+crash; and the config group must compose."""
 
 import glob
 import json
 import os
+
+import pytest
 
 from sheeprl_tpu import cli
 from sheeprl_tpu.config.engine import compose
@@ -15,6 +19,12 @@ def test_metric_telemetry_group_composes():
     cfg = compose("config", overrides=["exp=ppo", "env=dummy", "metric=telemetry"])
     assert cfg.metric.telemetry.enabled is True
     assert cfg.metric.telemetry.health.nan_guard is True
+    # live-plane knobs ride the same group
+    assert cfg.metric.telemetry.live_interval_s == 30.0
+    assert cfg.metric.telemetry.serve_port == 0
+    assert cfg.metric.telemetry.histograms is True
+    assert cfg.metric.telemetry.flight.enabled is True
+    assert cfg.metric.telemetry.flight.slow_span_factor == 8.0
     # and the default stays off
     cfg = compose("config", overrides=["exp=ppo", "env=dummy"])
     assert cfg.metric.telemetry.enabled is False
@@ -43,6 +53,7 @@ def test_ppo_run_with_telemetry_writes_trace_and_summary(tmp_path, monkeypatch):
             "metric.log_every=32",
             "metric.telemetry.enabled=true",
             "metric.telemetry.poll_interval_s=0.2",
+            "metric.telemetry.live_interval_s=0.2",
             f"root_dir={tmp_path}/logs",
             "run_name=telemetry_e2e",
         ]
@@ -60,6 +71,24 @@ def test_ppo_run_with_telemetry_writes_trace_and_summary(tmp_path, monkeypatch):
     assert summary["bytes_staged_h2d"] > 0  # the PPO batch staging was counted
     assert summary["recompiles"] >= 1  # at least the update program compiled
     assert summary["flops_per_train_step"]  # cost-analysis MFU plumbing ran
+    assert summary["crashed"] is False
+    # per-phase percentiles from the streaming histograms
+    for phase in ("Time/train_time", "Time/env_interaction_time"):
+        pct = summary["phase_percentiles"][phase]
+        assert pct["count"] >= 1
+        assert pct["p50_ms"] is not None and pct["p50_ms"] <= pct["p99_ms"]
+
+    # the live plane produced at least one atomic snapshot with rolling
+    # rates, percentiles, and watchdog beat ages (the acceptance criterion)
+    (live_path,) = glob.glob(
+        os.path.join(os.path.dirname(summary_path), "telemetry", "live.json")
+    )
+    live = json.load(open(live_path))
+    assert live["policy_steps"] == 128
+    assert "sps" in live["rolling"] and "window_s" in live["rolling"]
+    assert live["phase_percentiles"]["Time/train_time"]["count"] >= 1
+    assert "watchdog_beat_age_s" in live
+    assert not glob.glob(os.path.join(os.path.dirname(live_path), "live.json.tmp*"))
 
     (trace_path,) = glob.glob(
         os.path.join(os.path.dirname(summary_path), "telemetry", "trace.jsonl")
@@ -77,6 +106,43 @@ def test_ppo_run_with_telemetry_writes_trace_and_summary(tmp_path, monkeypatch):
 
     assert get_telemetry() is None
     assert get_tracer() is None
+
+
+def test_crash_path_records_exception_in_telemetry_json(tmp_path, monkeypatch):
+    """When the entrypoint raises, the finally-path finalize must still write
+    telemetry.json, with ``crashed: true`` and the exception type next to the
+    partial counters (the summary path is passed explicitly because the
+    crash may happen before the run dir exists)."""
+    monkeypatch.chdir(tmp_path)
+    summary_path = tmp_path / "crash_telemetry.json"
+    with pytest.raises(Exception) as excinfo:
+        cli.run(
+            [
+                "exp=ppo",
+                "env=gym",
+                "env.id=DefinitelyNotAGymEnv-v0",  # raises at env creation
+                "env.capture_video=False",
+                "fabric.devices=1",
+                "fabric.accelerator=cpu",
+                "buffer.memmap=False",
+                "metric.telemetry.enabled=true",
+                "metric.telemetry.poll_interval_s=0",
+                f"metric.telemetry.summary_path={summary_path}",
+                f"root_dir={tmp_path}/logs",
+                "run_name=crash_e2e",
+            ]
+        )
+    summary = json.load(open(summary_path))
+    assert summary["crashed"] is True
+    assert type(excinfo.value).__name__ in summary["exception"]
+    # partial counters are still present and well-formed
+    assert summary["run_wall_s"] > 0
+    assert "bytes_staged_h2d" in summary
+
+    # and the telemetry was torn down despite the crash
+    from sheeprl_tpu.obs.telemetry import get_telemetry
+
+    assert get_telemetry() is None
 
 
 def test_run_without_telemetry_writes_nothing(tmp_path, monkeypatch):
@@ -105,3 +171,5 @@ def test_run_without_telemetry_writes_nothing(tmp_path, monkeypatch):
     )
     assert not glob.glob(os.path.join("logs", "runs", "**", "telemetry.json"), recursive=True)
     assert not glob.glob(os.path.join("logs", "runs", "**", "trace.jsonl"), recursive=True)
+    assert not glob.glob(os.path.join("logs", "runs", "**", "live.json"), recursive=True)
+    assert not glob.glob(os.path.join("logs", "runs", "**", "flight_*.json"), recursive=True)

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Diff the two newest bench rounds and flag performance regressions.
+
+Every driver round records a ``BENCH_r<k>.json`` at the repo root:
+``{"n": round, "cmd": ..., "rc": ..., "tail": <truncated bench.py stdout>}``
+where the tail holds one JSON evidence line per workload (``bench.py``
+prints each line as it exists and re-prints the matrix last, so the tail's
+LAST occurrence of a metric is authoritative). This tool parses the two
+newest rounds, compares each metric's ``value``, and prints a regression
+report — a metric whose *goodness* dropped by more than the threshold
+(default 10%) is flagged. Direction comes from the evidence line's ``unit``:
+seconds are lower-better, rates (``steps/s``) higher-better.
+
+Wired into CI as a non-blocking step (exit code 1 on regression so the
+step shows red, ``continue-on-error`` keeps the lane green — bench numbers
+on shared runners are evidence, not a gate).
+
+Usage::
+
+    python tools/bench_compare.py [--dir REPO] [--threshold 0.10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: units where a larger value is a better result
+HIGHER_BETTER_UNITS = ("steps/s", "it/s", "fps")
+
+
+def find_rounds(repo: str) -> List[str]:
+    """BENCH_r*.json sorted by round number (ascending)."""
+
+    def round_no(path: str) -> int:
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
+    return sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")), key=round_no)
+
+
+def parse_round(path: str) -> Dict[str, Dict[str, Any]]:
+    """Metric -> evidence line (the tail's last occurrence wins)."""
+    with open(path) as f:
+        doc = json.load(f)
+    lines: Dict[str, Dict[str, Any]] = {}
+    for raw in str(doc.get("tail", "")).splitlines():
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            line = json.loads(raw)
+        except json.JSONDecodeError:
+            continue  # the tail is a truncation — its first line may be torn
+        if isinstance(line, dict) and "metric" in line:
+            lines[str(line["metric"])] = line
+    return lines
+
+
+def goodness_change(old: Dict[str, Any], new: Dict[str, Any]) -> Optional[float]:
+    """Relative goodness change new-vs-old (+0.1 = 10% better), or None.
+
+    Both directions are measured relative to the OLD value, so "-0.1" means
+    exactly a 10% slowdown (for seconds: ``new = 1.1 × old``) — the
+    threshold semantics the CI step documents."""
+    ov, nv = old.get("value"), new.get("value")
+    if not isinstance(ov, (int, float)) or not isinstance(nv, (int, float)) or ov <= 0:
+        return None
+    unit = str(new.get("unit", old.get("unit", "")))
+    if unit in HIGHER_BETTER_UNITS:
+        return nv / ov - 1.0
+    return 1.0 - nv / ov
+
+
+def compare(
+    old_lines: Dict[str, Dict[str, Any]],
+    new_lines: Dict[str, Dict[str, Any]],
+    threshold: float,
+) -> Tuple[List[str], List[str]]:
+    """(report lines, regression messages)."""
+    report: List[str] = []
+    regressions: List[str] = []
+    for metric in sorted(set(old_lines) | set(new_lines)):
+        old, new = old_lines.get(metric), new_lines.get(metric)
+        if old is None or new is None:
+            report.append(f"  {metric}: only in {'new' if old is None else 'old'} round")
+            continue
+        if old.get("skipped") or new.get("skipped"):
+            report.append(f"  {metric}: skipped ({new.get('skipped') or old.get('skipped')})")
+            continue
+        change = goodness_change(old, new)
+        if change is None:
+            report.append(f"  {metric}: not comparable ({old.get('value')} -> {new.get('value')})")
+            continue
+        unit = new.get("unit", "")
+        arrow = f"{old['value']} -> {new['value']} {unit}".strip()
+        if change < -threshold:
+            msg = f"{metric}: {arrow} ({-change * 100.0:.1f}% SLOWER)"
+            report.append(f"  REGRESSION {msg}")
+            regressions.append(msg)
+        else:
+            word = "better" if change > 0 else "worse"
+            report.append(f"  {metric}: {arrow} ({abs(change) * 100.0:.1f}% {word})")
+    return report, regressions
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--dir",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding the BENCH_r*.json rounds (default: repo root)",
+    )
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=0.10,
+        help="relative slowdown that counts as a regression (default 0.10)",
+    )
+    args = parser.parse_args(argv)
+
+    rounds = find_rounds(args.dir)
+    if len(rounds) < 2:
+        print(f"bench-compare: need two BENCH_r*.json rounds, found {len(rounds)} — nothing to diff")
+        return 0
+    old_path, new_path = rounds[-2], rounds[-1]
+    old_lines, new_lines = parse_round(old_path), parse_round(new_path)
+    print(
+        f"bench-compare: {os.path.basename(old_path)} -> {os.path.basename(new_path)} "
+        f"(threshold {args.threshold * 100.0:.0f}%)"
+    )
+    report, regressions = compare(old_lines, new_lines, args.threshold)
+    for line in report:
+        print(line)
+    if regressions:
+        print(f"\n{len(regressions)} regression(s) over {args.threshold * 100.0:.0f}%:")
+        for msg in regressions:
+            print(f"  {msg}")
+        return 1
+    print("\nno regressions over threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

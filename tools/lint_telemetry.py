@@ -11,7 +11,12 @@ lint fails when a file under ``sheeprl_tpu/algos/`` re-grows its own copy:
 - a ``timer.compute()`` / ``timer.reset()`` call (private registry drain —
   the shared helper owns the read-and-reset cycle);
 - a ``with timer(...)`` scope (use ``obs.span`` so the phase also reaches the
-  trace timeline and XLA profiles).
+  trace timeline and XLA profiles);
+- an ad-hoc wall-clock read (``time.time()`` / ``time.perf_counter()`` /
+  ``time.monotonic()``, under any import alias) — the span phases already
+  time the hot loops and feed the streaming histograms/flight recorder;
+  private deltas measure the same thing invisibly. For the env-gated
+  loop-latency printout use ``obs.LoopProbe``.
 
 AST-based, so comments and docstrings mentioning the metric names are fine.
 
@@ -30,6 +35,7 @@ ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
 
 FORBIDDEN_LITERAL_PREFIXES = ("Time/sps_", "Perf/mfu")
 FORBIDDEN_TIMER_CALLS = ("compute", "reset")
+FORBIDDEN_CLOCK_ATTRS = ("time", "perf_counter", "monotonic")
 
 
 def _docstring_nodes(tree: ast.AST) -> set:
@@ -43,10 +49,27 @@ def _docstring_nodes(tree: ast.AST) -> set:
     return allowed
 
 
+def _clock_aliases(tree: ast.AST) -> tuple:
+    """(module aliases of ``time``, names bound to its clock functions)."""
+    modules = set()
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    modules.add(alias.asname or alias.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in FORBIDDEN_CLOCK_ATTRS:
+                    names.add(alias.asname or alias.name)
+    return modules, names
+
+
 def lint_file(path: str) -> list:
     src = open(path).read()
     tree = ast.parse(src, filename=path)
     docstrings = _docstring_nodes(tree)
+    clock_modules, clock_names = _clock_aliases(tree)
     findings = []
     for node in ast.walk(tree):
         if (
@@ -78,6 +101,20 @@ def lint_file(path: str) -> list:
                     (node.lineno,
                      "raw timer(...) scope — use sheeprl_tpu.obs.span so the "
                      "phase reaches the trace timeline and XLA profiles")
+                )
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in clock_modules
+                and fn.attr in FORBIDDEN_CLOCK_ATTRS
+            ) or (isinstance(fn, ast.Name) and fn.id in clock_names):
+                clock = fn.attr if isinstance(fn, ast.Attribute) else fn.id
+                findings.append(
+                    (node.lineno,
+                     f"ad-hoc {clock}() wall-clock read — the span phases "
+                     "already time this loop (and feed the histograms/flight "
+                     "recorder); for the env-gated loop-latency printout use "
+                     "sheeprl_tpu.obs.LoopProbe")
                 )
     return findings
 

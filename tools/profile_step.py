@@ -67,11 +67,14 @@ def main(out_dir: str = "/tmp/dv3_trace") -> None:
     )
     state, m = train_fn(state, batch, jax.random.PRNGKey(99), jnp.float32(1.0))
     float(np.asarray(m["Loss/world_model_loss"]))  # finish compile+warmup
-    jax.profiler.start_trace(out_dir)
-    for i in range(5):
-        state, m = train_fn(state, batch, jax.random.PRNGKey(i), jnp.float32(0.02))
-    float(np.asarray(m["Loss/world_model_loss"]))
-    jax.profiler.stop_trace()
+    # the same capture scope the flight recorder opens on an anomaly
+    # (sheeprl_tpu/obs/live.py) — one implementation of start/stop_trace
+    from sheeprl_tpu.obs.live import profiler_capture
+
+    with profiler_capture(out_dir):
+        for i in range(5):
+            state, m = train_fn(state, batch, jax.random.PRNGKey(i), jnp.float32(0.02))
+        float(np.asarray(m["Loss/world_model_loss"]))
     print(f"trace written to {out_dir}; parse with tools/parse_xplane.py")
 
 
