@@ -212,7 +212,13 @@ def _phase_tails(tel) -> dict:
     env_p95_ms}`, absent keys skipped."""
     out = {}
     pct = tel.get("phase_percentiles") or {}
-    for phase, prefix in (("Time/train_time", "train"), ("Time/env_interaction_time", "env")):
+    for phase, prefix in (
+        ("Time/train_time", "train"),
+        ("Time/env_interaction_time", "env"),
+        # async env pool only: the parent's collective wait for worker
+        # results — the *exposed* env latency when stepping overlaps train
+        ("Time/env_wait_time", "env_wait"),
+    ):
         p = pct.get(phase) or {}
         if p.get("p95_ms") is not None:
             if prefix == "train":
@@ -292,6 +298,74 @@ def _ppo_line() -> str:
         # p95 (a periodic stall, a recompile storm) is invisible in the
         # wall-clock median this line is judged on
         data["telemetry"].update(_phase_tails(tel))
+        line = json.dumps(data)
+    except Exception:
+        pass  # a skipped/failed stage has no summary; keep the line as-is
+    return line
+
+
+def _ppo_async_line(sync_line: str) -> str:
+    # The same PPO protocol with env.vectorization=async (the shared-memory
+    # worker pool, envs/vector/): ONE measured run after warm-up — this line
+    # is overlap evidence next to the sync headline, not a de-noised
+    # headline itself. Carries env_p95_ms (step span), env_wait_p95_ms (the
+    # parent's exposed wait for workers), the pool counters, and sps with
+    # the delta vs the sync headline. On trivial CartPole the pool's IPC can
+    # honestly LOSE to serial stepping — the deltas are evidence either way;
+    # the pool pays off as simulator cost grows (howto/async_envs.md).
+    import tempfile
+
+    tel_path = os.path.join(tempfile.mkdtemp(prefix="bench_ppo_async_tel_"), "telemetry.json")
+    args = [
+        "exp=ppo",
+        "env=gym",
+        "env.id=CartPole-v1",
+        "env.num_envs=64",
+        "env.sync_env=null",
+        "env.vectorization=async",
+        "total_steps=65536",
+        "algo.rollout_steps=128",
+        "per_rank_batch_size=64",
+        "exp_name=bench_ppo_async",
+        "metric.telemetry.enabled=true",
+        "metric.telemetry.trace=false",
+        f"metric.telemetry.summary_path={tel_path}",
+        *_QUIET,
+    ]
+    line = _repeat_line(
+        "ppo_cartpole_65536_steps_async_envs",
+        lambda: _timed_subprocess_run(args, timeout=600),
+        PPO_BASELINE_SECONDS,
+        "headline PPO protocol with env.vectorization=async (64 env worker "
+        "processes, shared-memory step results); single measured run after "
+        "one warm-up — read next to ppo_cartpole_65536_steps for the "
+        "sync vs async delta",
+        repeats=1,
+        min_stage_s=60.0,
+    )
+    try:
+        with open(tel_path) as f:
+            tel = json.load(f)
+        data = json.loads(line)
+        data["telemetry"] = {
+            k: tel.get(k)
+            for k in (
+                "env_steps_async",
+                "env_worker_restarts",
+                "env_degraded_to_sync",
+                "bytes_staged_h2d",
+                "recompiles",
+            )
+        }
+        data["telemetry"].update(_phase_tails(tel))
+        if data.get("value"):
+            data["sps"] = round(65536 / data["value"], 1)
+            try:
+                sync_median = json.loads(sync_line).get("value")
+                if sync_median:
+                    data["sps_vs_sync"] = round(sync_median / data["value"], 3)
+            except Exception:
+                pass
         line = json.dumps(data)
     except Exception:
         pass  # a skipped/failed stage has no summary; keep the line as-is
@@ -416,6 +490,9 @@ def main() -> None:
 
     ppo_line = _ppo_line()  # headline: first in, printed again last
     print(ppo_line, flush=True)
+    # async-envs evidence line right after the headline it is compared to
+    # (env_p95/env_wait_p95 + pool counters + sps delta vs sync)
+    emit(_ppo_async_line(ppo_line))
     emit(_dreamer_line("dv3", min_stage_s=180.0, extra=("bench.profile=1",)))
     # DV2/DV1 device-step lines (grad-steps/s + scan-corrected MFU vs wall
     # rate; no xplane pass — keeps each under ~3 min warm). Their e2e

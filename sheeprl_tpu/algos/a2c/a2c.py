@@ -31,7 +31,7 @@ from sheeprl_tpu.algos.ppo.agent import (
     evaluate_actions,
     sample_actions,
 )
-from sheeprl_tpu.algos.ppo.ppo import make_vector_env
+from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
@@ -126,7 +126,7 @@ def main(fabric, cfg: Dict[str, Any]):
         save_configs(cfg, log_dir)
 
     n_envs = int(cfg.env.num_envs) * world_size
-    envs = make_vector_env(cfg, fabric, log_dir, n_envs)
+    envs = make_vector_env(cfg, fabric, log_dir)
     observation_space = envs.single_observation_space
 
     if not isinstance(observation_space, gym.spaces.Dict):

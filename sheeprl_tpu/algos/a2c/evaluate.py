@@ -10,7 +10,7 @@ import numpy as np
 
 from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.utils import test
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.envs.vector import make_eval_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.registry import register_evaluation
 from sheeprl_tpu.utils.utils import params_on_device
@@ -23,7 +23,7 @@ def evaluate_a2c(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
 
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    env = make_eval_env(cfg, log_dir)
     observation_space = env.observation_space
     action_space = env.action_space
     if not isinstance(observation_space, gym.spaces.Dict):

@@ -60,7 +60,7 @@ from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.distributions import MSEDistribution, SymlogDistribution, TwoHotEncodingDistribution
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -525,31 +525,12 @@ def main(fabric, cfg: Dict[str, Any]):
     # env holds num_envs × world_size environments, each fault-tolerant via
     # RestartOnException (reference main :408-423).
     n_envs = int(cfg.env.num_envs) * world_size
-    from functools import partial
-
-    from sheeprl_tpu.envs.wrappers import RestartOnException
-    from sheeprl_tpu.utils.env import vectorize_envs
-
-    thunks = [
-        partial(
-            RestartOnException,
-            make_env(
-                cfg,
-                cfg.seed + i,
-                0,
-                log_dir if fabric.is_global_zero else None,
-                "train",
-                vector_env_idx=i,
-            ),
-        )
-        for i in range(n_envs)
-    ]
-    # env.sync_env=False (default, like every other algo here and the
-    # reference's AsyncVectorEnv at dreamer_v3.py:407): worker processes keep
-    # simulator CPU burn out of this process, which matters doubly on a
-    # remote-attached device — the accelerator client's IO threads live here
-    # and starve behind a CPU-bound env loop
-    envs = vectorize_envs(thunks, cfg)
+    # each env fault-tolerant via RestartOnException; vector backend picked
+    # by env.vectorization — env.vectorization=async keeps simulator CPU burn
+    # in worker processes (the shared-memory pool, howto/async_envs.md),
+    # which matters doubly on a remote-attached device: the accelerator
+    # client's IO threads live here and starve behind a CPU-bound env loop
+    envs = make_vector_env(cfg, fabric, log_dir, restart_on_exception=True)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 

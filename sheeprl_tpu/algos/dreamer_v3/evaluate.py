@@ -10,7 +10,7 @@ import numpy as np
 
 from sheeprl_tpu.algos.dreamer_v3.agent import build_agent, build_player_fns
 from sheeprl_tpu.algos.dreamer_v3.utils import test
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.envs.vector import make_eval_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.registry import register_evaluation
 
@@ -22,7 +22,7 @@ def evaluate_dreamer_v3(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
 
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    env = make_eval_env(cfg, log_dir)
     observation_space = env.observation_space
     action_space = env.action_space
 

@@ -139,14 +139,14 @@ def test(
     """
     import gymnasium as gym  # noqa: F401
 
-    from sheeprl_tpu.utils.env import make_env
+    from sheeprl_tpu.envs.vector import make_eval_env
 
     if normalize_fn is None:
         normalize_fn = normalize_obs_jnp
 
-    env = make_env(
-        cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else "")
-    )()
+    env = make_eval_env(
+        cfg, log_dir, prefix="test" + (f"_{test_name}" if test_name else "")
+    )
     cnn_keys = list(cfg.cnn_keys.encoder)
     mlp_keys = list(cfg.mlp_keys.encoder)
     done = False

@@ -61,7 +61,7 @@ from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.distributions import MSEDistribution, SymlogDistribution, TwoHotEncodingDistribution
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -525,24 +525,9 @@ def main(fabric, cfg: Dict[str, Any]):
         save_configs(cfg, log_dir)
 
     n_envs = int(cfg.env.num_envs) * world_size
-    from functools import partial
-
-    from gymnasium.vector import AutoresetMode, SyncVectorEnv
-
-    from sheeprl_tpu.envs.wrappers import RestartOnException
-
-    thunks = [
-        partial(
-            RestartOnException,
-            make_env(
-                cfg, cfg.seed + i, 0,
-                log_dir if fabric.is_global_zero else None,
-                "train", vector_env_idx=i,
-            ),
-        )
-        for i in range(n_envs)
-    ]
-    envs = SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    # each env fault-tolerant via RestartOnException; vector backend
+    # picked by env.vectorization (envs/vector/factory.py)
+    envs = make_vector_env(cfg, fabric, log_dir, restart_on_exception=True)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 

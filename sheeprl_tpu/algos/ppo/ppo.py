@@ -55,32 +55,13 @@ from sheeprl_tpu.obs import (
     shape_specs,
     span,
 )
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import set_lr
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_tensor, polynomial_decay, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
-
-
-def make_vector_env(cfg, fabric, log_dir: str, n_envs: int):
-    """SAME_STEP autoreset restores the reference's gym-0.29 vector semantics
-    (final_obs / final_info emitted on the terminal step)."""
-    from sheeprl_tpu.utils.env import vectorize_envs
-
-    thunks = [
-        make_env(
-            cfg,
-            cfg.seed + i,
-            0,
-            log_dir if fabric.is_global_zero else None,
-            "train",
-            vector_env_idx=i,
-        )
-        for i in range(n_envs)
-    ]
-    return vectorize_envs(thunks, cfg)
 
 
 def build_update_fn(
@@ -208,7 +189,7 @@ def main(fabric, cfg: Dict[str, Any]):
     # Environment setup: the reference runs `env.num_envs` per DDP rank; here
     # one process drives all devices, so the vector env holds the whole batch.
     n_envs = int(cfg.env.num_envs) * world_size
-    envs = make_vector_env(cfg, fabric, log_dir, n_envs)
+    envs = make_vector_env(cfg, fabric, log_dir)
     observation_space = envs.single_observation_space
 
     if not isinstance(observation_space, gym.spaces.Dict):

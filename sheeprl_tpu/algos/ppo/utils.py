@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.envs.vector import make_eval_env
 
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
@@ -49,7 +49,7 @@ def test(agent, params, fabric, cfg, log_dir: str) -> None:
     """Greedy single-env evaluation episode (reference utils.py:12-56)."""
     from sheeprl_tpu.algos.ppo.agent import greedy_actions
 
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    env = make_eval_env(cfg, log_dir)
     obs_keys = list(cfg.mlp_keys.encoder) + list(cfg.cnn_keys.encoder)
     cnn_keys = list(cfg.cnn_keys.encoder)
 

@@ -30,7 +30,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
-from sheeprl_tpu.algos.ppo.ppo import make_vector_env
+from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
 from sheeprl_tpu.algos.ppo_recurrent.agent import (
     RecurrentPPOAgent,
@@ -168,7 +168,7 @@ def main(fabric, cfg: Dict[str, Any]):
         save_configs(cfg, log_dir)
 
     n_envs = int(cfg.env.num_envs) * world_size
-    envs = make_vector_env(cfg, fabric, log_dir, n_envs)
+    envs = make_vector_env(cfg, fabric, log_dir)
     observation_space = envs.single_observation_space
 
     if not isinstance(observation_space, gym.spaces.Dict):

@@ -8,7 +8,7 @@ import numpy as np
 
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
 from sheeprl_tpu.algos.ppo_recurrent.agent import greedy_actions
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.envs.vector import make_eval_env
 
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
@@ -22,7 +22,7 @@ AGGREGATOR_KEYS = {
 def test(agent, params, fabric, cfg, log_dir: str) -> None:
     """Greedy single-env episode carrying the LSTM state
     (reference utils.py:14-63)."""
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    env = make_eval_env(cfg, log_dir)
     cnn_keys = list(cfg.cnn_keys.encoder)
     mlp_keys = list(cfg.mlp_keys.encoder)
     obs_keys = mlp_keys + cnn_keys

@@ -11,7 +11,7 @@ import numpy as np
 
 from sheeprl_tpu.algos.sac.agent import SACActor, action_bounds
 from sheeprl_tpu.algos.sac.utils import test
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.envs.vector import make_eval_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.registry import register_evaluation
 from sheeprl_tpu.utils.utils import params_on_device
@@ -24,7 +24,7 @@ def evaluate_sac(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
 
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    env = make_eval_env(cfg, log_dir)
     action_space = env.action_space
     observation_space = env.observation_space
     if not isinstance(action_space, gym.spaces.Box):

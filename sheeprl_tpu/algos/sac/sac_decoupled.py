@@ -40,7 +40,7 @@ from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.staging import make_replay_staging
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -75,15 +75,8 @@ def main(fabric, cfg: Dict[str, Any]):
         save_configs(cfg, log_dir)
 
     n_envs = int(cfg.env.num_envs) * world_size
-    from sheeprl_tpu.utils.env import vectorize_envs
-
-    envs = vectorize_envs(
-        [
-            make_env(cfg, cfg.seed + i, 0, log_dir if fabric.is_global_zero else None, "train", vector_env_idx=i)
-            for i in range(n_envs)
-        ],
-        cfg,
-    )
+    # vector backend picked by env.vectorization (envs/vector/factory.py)
+    envs = make_vector_env(cfg, fabric, log_dir)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
     if not isinstance(action_space, gym.spaces.Box):

@@ -7,7 +7,7 @@ from typing import Dict
 import jax
 import numpy as np
 
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.envs.vector import make_eval_env
 
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
@@ -29,7 +29,7 @@ def test(actor, actor_params, action_scale, action_bias, fabric, cfg, log_dir: s
     """Greedy single-env evaluation episode (reference utils.py:19-46)."""
     from sheeprl_tpu.algos.sac.agent import greedy_action
 
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    env = make_eval_env(cfg, log_dir)
 
     @jax.jit
     def act(params, obs):

@@ -12,7 +12,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401  (same dict-obs p
     normalize_obs_jnp,
     prepare_obs,
 )
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.envs.vector import make_eval_env
 
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
@@ -26,7 +26,7 @@ AGGREGATOR_KEYS = {
 
 def test(encoder, actor_trunk, params, action_scale, action_bias, fabric, cfg, log_dir: str) -> None:
     """Greedy single-env evaluation episode (reference utils.py:23-50)."""
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    env = make_eval_env(cfg, log_dir)
     cnn_keys = list(cfg.cnn_keys.encoder)
     mlp_keys = list(cfg.mlp_keys.encoder)
 

@@ -11,6 +11,12 @@ teardown drains the in-flight async save before the process exits cleanly.
 A second signal means "actually stop": the original disposition is restored
 and the default behavior re-raised, so a hung drain can still be killed
 interactively.
+
+Async env workers (envs/vector) cooperate with this path from both sides:
+workers ignore SIGTERM/SIGINT so a process-group signal cannot kill an env
+mid-drain, and the pool's ``close()`` consults :func:`preemption_requested`
+to shrink its worker-join budget — the grace window is spent writing the
+final checkpoint, not tearing down simulators.
 """
 
 from __future__ import annotations

@@ -169,7 +169,14 @@ class ReplayBuffer:
             last_key, last_batch_shape = k, v.shape[:2]
 
     def add(self, data: Union["ReplayBuffer", Dict[str, np.ndarray]], validate_args: bool = False) -> None:
-        """Insert ``[T, n_envs, ...]`` steps with ring wrap-around."""
+        """Insert ``[T, n_envs, ...]`` steps with ring wrap-around.
+
+        Zero-copy contract with the async env plane (envs/vector): the slab
+        views ``AsyncSharedMemVectorEnv.step`` returns are ``[n_envs, ...]``
+        shared-memory blocks in exactly this layout — callers pass them
+        (``data[k][np.newaxis]``) without an intermediate copy, and the
+        indexed assignment below is the one copy of the whole env→ring path.
+        """
         if isinstance(data, ReplayBuffer):
             data = {k: _as_np(v) for k, v in (data.buffer or {}).items()}
         if validate_args:

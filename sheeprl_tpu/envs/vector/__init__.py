@@ -1,0 +1,29 @@
+"""Async environment execution plane.
+
+One factory (:func:`make_vector_env` / :func:`make_eval_env`) for every
+algorithm entrypoint, one seeding formula (:func:`env_seeds`), and the
+shared-memory fault-tolerant worker pool
+(:class:`AsyncSharedMemVectorEnv`) behind ``env.vectorization=async``.
+See ``howto/async_envs.md``.
+"""
+
+from sheeprl_tpu.envs.vector.async_env import AsyncSharedMemVectorEnv
+from sheeprl_tpu.envs.vector.factory import (
+    env_seeds,
+    make_eval_env,
+    make_vector_env,
+    resolve_vectorization,
+    vectorize_thunks,
+)
+from sheeprl_tpu.envs.vector.shmem import N_SLOTS, SharedStepSlabs
+
+__all__ = [
+    "AsyncSharedMemVectorEnv",
+    "N_SLOTS",
+    "SharedStepSlabs",
+    "env_seeds",
+    "make_eval_env",
+    "make_vector_env",
+    "resolve_vectorization",
+    "vectorize_thunks",
+]

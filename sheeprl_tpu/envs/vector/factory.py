@@ -1,0 +1,202 @@
+"""The one vector-env factory every algorithm entrypoint builds envs through.
+
+Before this module existed, the ``SyncVectorEnv(thunks, ...)`` block (and its
+seeding arithmetic) was copy-pasted across all 17 entrypoints and had already
+drifted; the per-algo ``evaluate.py`` files hand-rolled yet another
+``make_env(...)()`` single-env path. Now:
+
+- :func:`make_vector_env` is the single train-time constructor — it computes
+  the canonical per-env seeds, builds the wrapped thunks via
+  :func:`sheeprl_tpu.utils.env.make_env`, and picks the vectorization backend
+  from ``env.vectorization``:
+
+  ========== ==============================================================
+  ``sync``   (default) gymnasium ``SyncVectorEnv``, SAME_STEP autoreset —
+             serial, deterministic, zero processes.
+  ``async``  :class:`~sheeprl_tpu.envs.vector.async_env.
+             AsyncSharedMemVectorEnv` — one worker process per env writing
+             step results into shared memory, per-step timeouts, bounded
+             worker restarts, degrade-to-sync (``howto/async_envs.md``).
+  ``gym_async`` gymnasium ``AsyncVectorEnv`` (no shared memory, no fault
+             tolerance) — kept for envs whose observations the shm layout
+             cannot hold.
+  ========== ==============================================================
+
+  The legacy ``env.sync_env`` boolean keeps its exact meaning (``True`` →
+  sync, ``False`` → gym_async) while ``vectorization`` is unset; an
+  explicitly set ``vectorization`` wins.
+
+- :func:`make_eval_env` is the single test-time constructor: one fully
+  wrapped env on the same seeding path (seed = ``env_seeds(...)[0]``), so
+  evaluation sees bitwise the wrappers/seeding training saw.
+
+- :func:`env_seeds` owns the seeding formula — ``seed + rank * n_envs +
+  idx`` — in one place, asserting the per-env seeds are distinct (several
+  entrypoints used to compute this inline with slight variations).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import gymnasium as gym
+
+from sheeprl_tpu.utils.env import make_env
+
+__all__ = [
+    "env_seeds",
+    "make_eval_env",
+    "make_vector_env",
+    "resolve_vectorization",
+    "vectorize_thunks",
+]
+
+_BACKENDS = ("sync", "async", "gym_async")
+
+
+def env_seeds(seed: int, rank: int, n_envs: int) -> List[int]:
+    """Canonical per-env seeds: ``seed + rank * n_envs + idx``.
+
+    ``rank`` is the *process* index (``fabric.global_rank``) and ``n_envs``
+    the per-process env count, so ranks never overlap and rank 0 reproduces
+    the historical single-process ``seed + idx`` bitwise.
+    """
+    seeds = [int(seed) + int(rank) * int(n_envs) + idx for idx in range(int(n_envs))]
+    assert len(set(seeds)) == len(seeds), f"per-env seeds must be distinct, got {seeds}"
+    return seeds
+
+
+def resolve_vectorization(cfg) -> str:
+    """The backend for this run.
+
+    An explicitly set ``env.vectorization`` (non-null — the shipped default
+    is null) always wins, ``sync`` included: ``env=diambra
+    env.vectorization=sync`` must get the serial backend even though that
+    recipe ships ``sync_env: False``, and ``vectorization=async`` must
+    reach the shared-memory pool. When unset, the legacy ``env.sync_env``
+    keeps its exact historical meaning (``True`` → sync, ``False`` →
+    gym_async); with neither set, sync (determinism)."""
+    mode = cfg.env.get("vectorization", None)
+    legacy = cfg.env.get("sync_env", None)
+    if mode is not None:
+        mode = str(mode).lower()
+        if mode not in _BACKENDS:
+            raise ValueError(
+                f"env.vectorization must be one of {_BACKENDS}, got {mode!r}"
+            )
+        if legacy is not None and bool(legacy) != (mode == "sync"):
+            import warnings
+
+            warnings.warn(
+                f"env.vectorization={mode} overrides legacy env.sync_env={bool(legacy)}"
+            )
+        return mode
+    if legacy is not None:
+        return "sync" if legacy else "gym_async"
+    return "sync"
+
+
+def _build_thunks(
+    cfg,
+    rank: int,
+    n_envs: int,
+    log_dir: Optional[str],
+    prefix: str,
+    restart_on_exception: bool,
+) -> List[Callable[[], gym.Env]]:
+    seeds = env_seeds(cfg.seed, rank, n_envs)
+    thunks: List[Callable[[], gym.Env]] = []
+    for idx in range(n_envs):
+        # vector_env_idx carries the global env index (rank-offset) so the
+        # wrapper `rank` kwarg and the capture-video gate (env 0 of rank 0,
+        # the only rank handed a log_dir) keep their historical meaning
+        thunk = make_env(
+            cfg,
+            seeds[idx],
+            0,
+            log_dir,
+            prefix,
+            vector_env_idx=rank * n_envs + idx,
+        )
+        if restart_on_exception:
+            from functools import partial
+
+            from sheeprl_tpu.envs.wrappers import RestartOnException
+
+            thunk = partial(RestartOnException, thunk)
+        thunks.append(thunk)
+    return thunks
+
+
+def vectorize_thunks(thunks: Sequence[Callable[[], gym.Env]], cfg, env_seeds_list=None):
+    """Wrap prebuilt thunks in the configured vector backend (the factory's
+    lower half — diagnostics/tools that need custom thunks enter here)."""
+    mode = resolve_vectorization(cfg)
+    if mode == "sync":
+        from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+        return SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    # worker processes use a NON-fork start method (default ``forkserver``,
+    # override via ``env.mp_context``): this process is multithreaded the
+    # moment jax initializes its backends, and os.fork() of a multithreaded
+    # parent can deadlock the child
+    context = str(cfg.env.get("mp_context", "forkserver") or "forkserver")
+    if mode == "async":
+        from sheeprl_tpu.envs.vector.async_env import AsyncSharedMemVectorEnv
+
+        return AsyncSharedMemVectorEnv(
+            thunks,
+            env_seeds=env_seeds_list,
+            context=context,
+            worker_timeout_s=float(cfg.env.get("worker_timeout_s", 60.0) or 0.0),
+            max_worker_restarts=int(cfg.env.get("max_worker_restarts", 3)),
+            restart_window_s=float(cfg.env.get("restart_window_s", 300.0) or 0.0),
+        )
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode
+
+    return AsyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP, context=context)
+
+
+def make_vector_env(
+    cfg,
+    fabric=None,
+    log_dir: Optional[str] = None,
+    prefix: str = "train",
+    restart_on_exception: bool = False,
+    n_envs: Optional[int] = None,
+):
+    """Build the train-time vector env for one process.
+
+    ``n_envs`` defaults to ``env.num_envs * fabric.world_size`` (the
+    per-process env count every entrypoint uses — world_size is the device
+    count, and one process drives the whole mesh). ``log_dir`` is only handed
+    to the envs on global rank zero, preserving the video/logging gate the
+    entrypoints used to spell out inline.
+    """
+    rank = int(fabric.global_rank) if fabric is not None else 0
+    if n_envs is None:
+        world_size = int(fabric.world_size) if fabric is not None else 1
+        n_envs = int(cfg.env.num_envs) * world_size
+    is_zero = fabric.is_global_zero if fabric is not None else rank == 0
+    thunks = _build_thunks(
+        cfg,
+        rank,
+        n_envs,
+        log_dir if is_zero else None,
+        prefix,
+        restart_on_exception,
+    )
+    return vectorize_thunks(thunks, cfg, env_seeds_list=env_seeds(cfg.seed, rank, n_envs))
+
+
+def make_eval_env(
+    cfg,
+    log_dir: Optional[str],
+    prefix: str = "test",
+    rank: int = 0,
+) -> gym.Env:
+    """One fully wrapped single env for evaluation/test episodes — the same
+    wrapper pipeline and the same canonical seed (env 0 of ``rank``) the
+    train-time factory would produce."""
+    seed = env_seeds(cfg.seed, rank, 1)[0]
+    return make_env(cfg, seed, 0, log_dir, prefix, vector_env_idx=0)()

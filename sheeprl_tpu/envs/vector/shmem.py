@@ -1,0 +1,106 @@
+"""Double-buffered shared-memory slabs for the async vector env.
+
+One anonymous shared block (``multiprocessing.RawArray``, inherited by worker
+processes at spawn — no named /dev/shm segments to leak or unlink) per
+observation key plus one each for reward / terminated / truncated, laid out as
+
+    ``[n_slots, num_envs, *single_shape]``
+
+with ``n_slots=2``: workers write step *k* into slot ``k % 2`` while the
+arrays the caller received for step *k-1* (views into the other slot) stay
+valid. That is what makes the zero-copy contract safe for the standard RL
+loop — ``obs`` from the previous step and ``real_next_obs`` from the current
+one never alias the same buffer, and the one copy on the whole path is
+``ReplayBuffer.add`` writing into its ring storage.
+
+Only ``Dict`` observation spaces with array-typed leaves (``Box`` /
+``Discrete`` / ``MultiDiscrete`` / ``MultiBinary``) are supported — exactly
+what :func:`sheeprl_tpu.utils.env.make_env` produces for every configured
+environment.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+__all__ = ["SharedStepSlabs", "N_SLOTS"]
+
+#: two slots: the previous step's views survive the current step's writes
+N_SLOTS = 2
+
+_SUPPORTED_LEAVES = (
+    gym.spaces.Box,
+    gym.spaces.Discrete,
+    gym.spaces.MultiDiscrete,
+    gym.spaces.MultiBinary,
+)
+
+
+def _leaf_spec(space: gym.Space) -> Tuple[Tuple[int, ...], np.dtype]:
+    if not isinstance(space, _SUPPORTED_LEAVES):
+        raise TypeError(
+            f"AsyncSharedMemVectorEnv supports array-typed observation leaves, "
+            f"got {type(space).__name__}; use env.vectorization=sync for this env"
+        )
+    return tuple(space.shape), np.dtype(space.dtype)
+
+
+def _alloc(ctx, shape: Tuple[int, ...], dtype: np.dtype):
+    """One RawArray sized in bytes; viewed through np.frombuffer on each side."""
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    return ctx.RawArray("b", max(nbytes, 1))
+
+
+def _view(raw, shape: Tuple[int, ...], dtype: np.dtype) -> np.ndarray:
+    return np.frombuffer(raw, dtype=dtype, count=int(np.prod(shape, dtype=np.int64))).reshape(shape)
+
+
+class SharedStepSlabs:
+    """The step-result blocks shared between the parent and every worker.
+
+    Picklable by construction (holds only RawArrays and plain metadata), so
+    the whole object is passed to each worker as a ``Process`` arg; both
+    sides call :meth:`views` once and index ``[slot, env_idx]`` thereafter.
+    """
+
+    def __init__(self, ctx, single_observation_space: gym.spaces.Dict, num_envs: int):
+        if not isinstance(single_observation_space, gym.spaces.Dict):
+            raise TypeError(
+                "AsyncSharedMemVectorEnv requires a Dict observation space "
+                f"(make_env always produces one), got {type(single_observation_space).__name__}"
+            )
+        self.num_envs = int(num_envs)
+        self._specs: Dict[str, Tuple[Tuple[int, ...], np.dtype]] = {
+            key: _leaf_spec(space) for key, space in single_observation_space.spaces.items()
+        }
+        self._obs_raw = {
+            key: _alloc(ctx, (N_SLOTS, num_envs, *shape), dtype)
+            for key, (shape, dtype) in self._specs.items()
+        }
+        # float64 rewards and bool flags: bitwise-identical to SyncVectorEnv's
+        # step buffers (np.zeros(num_envs) / dtype=np.bool_)
+        self._rew_raw = _alloc(ctx, (N_SLOTS, num_envs), np.dtype(np.float64))
+        self._term_raw = _alloc(ctx, (N_SLOTS, num_envs), np.dtype(np.bool_))
+        self._trunc_raw = _alloc(ctx, (N_SLOTS, num_envs), np.dtype(np.bool_))
+
+    def views(self) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray, np.ndarray]:
+        """Numpy views over the shared blocks: ``(obs, rewards, terminated,
+        truncated)``, each leading with ``[n_slots, num_envs]``."""
+        n = self.num_envs
+        obs = {
+            key: _view(raw, (N_SLOTS, n, *self._specs[key][0]), self._specs[key][1])
+            for key, raw in self._obs_raw.items()
+        }
+        rewards = _view(self._rew_raw, (N_SLOTS, n), np.dtype(np.float64))
+        terminated = _view(self._term_raw, (N_SLOTS, n), np.dtype(np.bool_))
+        truncated = _view(self._trunc_raw, (N_SLOTS, n), np.dtype(np.bool_))
+        return obs, rewards, terminated, truncated
+
+    def raw_nbytes(self) -> int:
+        """Allocated shared bytes (telemetry/debug)."""
+        total = len(self._rew_raw) + len(self._term_raw) + len(self._trunc_raw)
+        total += sum(len(raw) for raw in self._obs_raw.values())
+        return total

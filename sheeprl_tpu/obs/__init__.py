@@ -30,6 +30,9 @@ from sheeprl_tpu.obs.counters import (
     DevicePoller,
     add_ckpt_blocked_ms,
     add_ckpt_write,
+    add_env_async_steps,
+    add_env_degraded,
+    add_env_worker_restart,
     add_h2d_bytes,
     add_prefetch,
     add_ring_gather,
@@ -80,6 +83,9 @@ __all__ = [
     "TraceWriter",
     "add_ckpt_blocked_ms",
     "add_ckpt_write",
+    "add_env_async_steps",
+    "add_env_degraded",
+    "add_env_worker_restart",
     "add_h2d_bytes",
     "add_prefetch",
     "add_ring_gather",

@@ -35,6 +35,9 @@ __all__ = [
     "Counters",
     "add_ckpt_blocked_ms",
     "add_ckpt_write",
+    "add_env_async_steps",
+    "add_env_degraded",
+    "add_env_worker_restart",
     "add_h2d_bytes",
     "add_prefetch",
     "add_ring_gather",
@@ -88,6 +91,12 @@ class Counters:
         self.prefetch_hits = 0
         self.prefetch_misses = 0
         self.prefetch_wait_ms = 0.0
+        # async env execution plane (envs/vector): steps served by the
+        # shared-memory worker pool, worker crash/hang restarts, and whether
+        # the pool gave up and degraded to in-process sync stepping
+        self.env_steps_async = 0
+        self.env_worker_restarts = 0
+        self.env_degraded_to_sync = 0
 
     def add(self, field: str, amount) -> None:
         with self._lock:
@@ -112,6 +121,9 @@ class Counters:
                 "prefetch_hits": self.prefetch_hits,
                 "prefetch_misses": self.prefetch_misses,
                 "prefetch_wait_ms": round(self.prefetch_wait_ms, 1),
+                "env_steps_async": self.env_steps_async,
+                "env_worker_restarts": self.env_worker_restarts,
+                "env_degraded_to_sync": self.env_degraded_to_sync,
             }
 
 
@@ -210,6 +222,34 @@ def add_prefetch(hit: bool, wait_ms: float = 0.0) -> None:
             else:
                 c.prefetch_misses += 1
             c.prefetch_wait_ms += float(wait_ms)
+
+
+# -- async env execution accounting ------------------------------------------
+
+
+def add_env_async_steps(n: int) -> None:
+    """Record ``n`` env steps served by the async shared-memory worker pool."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.env_steps_async += int(n)
+
+
+def add_env_worker_restart(n: int = 1) -> None:
+    """Record ``n`` env-worker restarts (crash or hang past the timeout)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.env_worker_restarts += int(n)
+
+
+def add_env_degraded(n: int = 1) -> None:
+    """Record the async env pool exhausting its restart budget and degrading
+    to in-process sync stepping."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.env_degraded_to_sync += int(n)
 
 
 # -- checkpoint accounting --------------------------------------------------

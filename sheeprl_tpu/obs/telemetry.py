@@ -38,6 +38,10 @@ key                       meaning
 ``ckpt_bytes``            checkpoint bytes landed on disk
 ``ckpt_saves``            completed checkpoint writes
 ``ckpt_failures``         writes that exhausted their retry budget
+``env_steps_async``       env steps served by the async shared-memory pool
+``env_worker_restarts``   env workers restarted after a crash/hang
+``env_degraded_to_sync``  1 when the pool exhausted its restart budget and
+                          fell back to in-process sync stepping
 ``phase_percentiles``     per-phase ``p50/p95/p99`` span durations (ms) from
                           the streaming histograms (``obs/hist.py``)
 ``flight_dumps``          flight-recorder evidence files written
@@ -468,6 +472,12 @@ class Telemetry:
             + (f" · MFU {s['mfu']}%" if s["mfu"] is not None else "")
             + f" · non-finite {s['nonfinite_metrics']} · stalls {s['stalls']}",
         ]
+        if s.get("env_steps_async") or s.get("env_worker_restarts"):
+            lines.append(
+                f"  async envs: {s['env_steps_async']} steps · "
+                f"{s['env_worker_restarts']} worker restart(s)"
+                + (" · DEGRADED TO SYNC" if s.get("env_degraded_to_sync") else "")
+            )
         if s["ckpt_saves"] or s["ckpt_failures"]:
             lines.append(
                 f"  ckpt {s['ckpt_saves']} saves ({fmt_bytes(s['ckpt_bytes'])}), "

@@ -186,27 +186,17 @@ def make_env(
 
 
 def vectorize_envs(thunks, cfg):
-    """Build the train-time vector env with SAME_STEP autoreset (the
-    reference's gym-0.29 semantics: final_obs/final_info on the terminal
-    step).
+    """Legacy shim: wrap prebuilt thunks in the configured vector backend.
 
-    Async workers use a NON-fork multiprocessing context (default
-    ``forkserver``, override via ``env.mp_context``): this process is
-    multithreaded the moment jax initializes its backends, and a plain
-    ``os.fork()`` of a multithreaded parent can deadlock in the child — every
-    round-4 walker segment logged that exact RuntimeWarning from
-    ``AsyncVectorEnv``'s fork-based workers. gymnasium cloudpickles the env
-    thunks, so closures survive the spawn-style start; workers pay a
-    one-time module re-import instead of inheriting COW pages.
+    The backend decision (``env.vectorization`` / legacy ``env.sync_env``)
+    and every backend implementation live in ``sheeprl_tpu/envs/vector``
+    now; algorithm entrypoints must use ``make_vector_env`` (enforced by
+    ``tools/lint_vecenv.py``) — this wrapper remains for diagnostics/tools
+    that build custom thunks.
     """
-    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+    from sheeprl_tpu.envs.vector.factory import vectorize_thunks
 
-    if cfg.env.sync_env:
-        return SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
-    context = str(cfg.env.get("mp_context", "forkserver") or "forkserver")
-    return AsyncVectorEnv(
-        thunks, autoreset_mode=AutoresetMode.SAME_STEP, context=context
-    )
+    return vectorize_thunks(thunks, cfg)
 
 
 def get_dummy_env(id: str) -> gym.Env:  # noqa: A002 — kwarg name fixed by env/dummy.yaml

@@ -1,0 +1,517 @@
+"""Async environment execution plane tests (``sheeprl_tpu/envs/vector``).
+
+- seeded **bitwise** sync↔async parity on the deterministic dummy envs
+  (obs/reward/termination and the SAME_STEP final_obs/final_info infos);
+- the shared-memory layout contract: async ``step`` returns ``[num_envs,
+  ...]`` numpy *views* into the slabs (zero-copy), the previous step's views
+  survive the next step (double buffering), and ``ReplayBuffer.add``
+  consumes them directly;
+- fault tolerance: a crashed worker restarts (bounded) and the run
+  continues; a hung worker past ``worker_timeout_s`` degrades the pool to
+  in-process sync stepping once the restart budget is spent;
+- a forced worker crash mid-run lands ``env_worker_restarts > 0`` in
+  telemetry.json;
+- SIGTERM mid-run (PR-2 preemption path) drains the worker pool cleanly and
+  leaves a resumable run;
+- one SAC end-to-end CPU run with ``env.vectorization=async``.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config.engine import compose
+from sheeprl_tpu.envs.vector import (
+    AsyncSharedMemVectorEnv,
+    env_seeds,
+    make_vector_env,
+    resolve_vectorization,
+    vectorize_thunks,
+)
+
+
+def _dummy_cfg(num_envs=2, vectorization="sync", **env_over):
+    overrides = [
+        "exp=ppo",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.capture_video=False",
+        "metric.log_level=0",
+        f"env.num_envs={num_envs}",
+        "cnn_keys.encoder=[rgb]",
+        "mlp_keys.encoder=[]",
+    ]
+    cfg = compose("config", overrides=overrides)
+    cfg.env.sync_env = None
+    cfg.env.vectorization = vectorization
+    for k, v in env_over.items():
+        cfg.env[k] = v
+    return cfg
+
+
+# -- seeding / backend resolution -------------------------------------------
+
+
+def test_env_seeds_formula_and_distinct():
+    assert env_seeds(42, 0, 4) == [42, 43, 44, 45]
+    # ranks never overlap: rank r starts where rank r-1 ended
+    assert env_seeds(42, 1, 4) == [46, 47, 48, 49]
+    assert env_seeds(7, 3, 2) == [7 + 6, 7 + 7]
+
+
+def test_resolve_vectorization_backcompat():
+    cfg = _dummy_cfg(vectorization="async")
+    assert resolve_vectorization(cfg) == "async"
+    # an explicitly set vectorization beats the legacy boolean (a recipe
+    # shipping sync_env must make neither `async` nor explicit `sync`
+    # unreachable) — with a warning when the two genuinely conflict
+    cfg.env.sync_env = True
+    with pytest.warns(UserWarning, match="overrides legacy env.sync_env"):
+        assert resolve_vectorization(cfg) == "async"
+    cfg.env.sync_env = False
+    cfg.env.vectorization = "sync"
+    with pytest.warns(UserWarning, match="overrides legacy env.sync_env"):
+        assert resolve_vectorization(cfg) == "sync"
+    # with vectorization unset, the legacy boolean keeps its exact
+    # historical meaning for every existing override
+    cfg.env.vectorization = None
+    cfg.env.sync_env = True
+    assert resolve_vectorization(cfg) == "sync"
+    cfg.env.sync_env = False
+    assert resolve_vectorization(cfg) == "gym_async"
+    cfg.env.sync_env = None
+    cfg.env.vectorization = "bogus"
+    with pytest.raises(ValueError):
+        resolve_vectorization(cfg)
+
+
+def test_default_is_sync():
+    cfg = _dummy_cfg()
+    cfg.env.pop("vectorization")
+    assert resolve_vectorization(cfg) == "sync"
+
+
+# -- bitwise sync <-> async parity ------------------------------------------
+
+
+def test_sync_async_bitwise_parity():
+    """Same seeds, same thunks: the shared-memory pool must reproduce
+    SyncVectorEnv(SAME_STEP) bit for bit, including the autoreset step."""
+    cfg = _dummy_cfg(num_envs=3)
+    envs_sync = make_vector_env(cfg, None, None)
+    cfg_async = _dummy_cfg(num_envs=3, vectorization="async", worker_timeout_s=60.0)
+    envs_async = make_vector_env(cfg_async, None, None)
+    try:
+        obs_s, _ = envs_sync.reset(seed=cfg.seed)
+        obs_a, _ = envs_async.reset(seed=cfg.seed)
+        for k in obs_s:
+            assert np.array_equal(obs_s[k], obs_a[k]), k
+
+        rng = np.random.default_rng(0)
+        saw_autoreset = False
+        # the discrete dummy episode is 5 steps: 8 steps cross an autoreset
+        for t in range(8):
+            acts = rng.integers(0, 2, size=3)
+            o_s, r_s, te_s, tr_s, i_s = envs_sync.step(acts)
+            o_a, r_a, te_a, tr_a, i_a = envs_async.step(acts)
+            for k in o_s:
+                assert np.array_equal(o_s[k], o_a[k]), (t, k)
+            assert np.array_equal(r_s, r_a) and r_a.dtype == r_s.dtype, t
+            assert np.array_equal(te_s, te_a) and np.array_equal(tr_s, tr_a), t
+            assert sorted(i_s.keys()) == sorted(i_a.keys()), t
+            if "final_obs" in i_s:
+                saw_autoreset = True
+                assert np.array_equal(i_s["_final_obs"], i_a["_final_obs"])
+                for idx in np.nonzero(i_s["_final_obs"])[0]:
+                    for k in i_s["final_obs"][idx]:
+                        assert np.array_equal(
+                            i_s["final_obs"][idx][k], i_a["final_obs"][idx][k]
+                        ), (t, idx, k)
+        assert saw_autoreset, "the parity window never crossed an autoreset"
+    finally:
+        envs_sync.close()
+        envs_async.close()
+
+
+# -- zero-copy shared-memory layout -----------------------------------------
+
+
+def test_shared_memory_layout_zero_copy_and_buffer_add():
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    cfg = _dummy_cfg(num_envs=2, vectorization="async")
+    envs = make_vector_env(cfg, None, None)
+    try:
+        obs, _ = envs.reset(seed=cfg.seed)
+        slab_obs, _rew, _term, _trunc = envs._slabs.views()
+        for k, arr in obs.items():
+            # [num_envs, ...] single-copy contract: what step() hands back IS
+            # the shared block the worker wrote, not a copy of it
+            assert arr.shape[0] == envs.num_envs
+            assert np.shares_memory(arr, slab_obs[k]), k
+
+        acts = np.zeros(2, dtype=np.int64)
+        obs1 = envs.step(acts)[0]
+        obs1_snapshot = {k: v.copy() for k, v in obs1.items()}
+        obs2 = envs.step(acts)[0]
+        for k in obs1:
+            # double buffering: the previous step's views still hold their
+            # values after the next step lands (obs vs real_next_obs pattern)
+            assert np.array_equal(obs1[k], obs1_snapshot[k]), k
+            assert not np.shares_memory(obs1[k], obs2[k]), k
+
+        # the replay layer consumes the views directly: add() performs the
+        # one copy of the whole path into its ring storage
+        rb = ReplayBuffer(buffer_size=8, n_envs=2)
+        rb.add({"rgb": obs2["rgb"][np.newaxis]})
+        assert np.array_equal(rb["rgb"][0], obs2["rgb"])
+    finally:
+        envs.close()
+
+
+# -- fault tolerance ---------------------------------------------------------
+
+
+def _crashing_thunks(n_envs, crash_index, crash_at_step, sentinel):
+    """Thunks for envs where env `crash_index` raises at step `crash_at_step`
+    while the sentinel file exists (removed just before raising, so the
+    revived worker's fresh env instance does not crash again). Classes are
+    created inside this function so cloudpickle ships them by value."""
+    import gymnasium as gym
+
+    class CrashOnceEnv(gym.Env):
+        def __init__(self, index):
+            self.observation_space = gym.spaces.Dict(
+                {"state": gym.spaces.Box(-np.inf, np.inf, (3,), np.float32)}
+            )
+            self.action_space = gym.spaces.Discrete(2)
+            self._index = index
+            self._step = 0
+
+        def _obs(self):
+            return {"state": np.full(3, self._step, dtype=np.float32)}
+
+        def reset(self, seed=None, options=None):
+            self._step = 0
+            return self._obs(), {}
+
+        def step(self, action):
+            self._step += 1
+            if (
+                self._index == crash_index
+                and self._step == crash_at_step
+                and os.path.exists(sentinel)
+            ):
+                os.unlink(sentinel)
+                raise RuntimeError("simulated env crash")
+            return self._obs(), 1.0, self._step >= 6, False, {}
+
+    return [lambda i=i: CrashOnceEnv(i) for i in range(n_envs)]
+
+
+def test_worker_crash_restarts_and_run_continues(tmp_path):
+    sentinel = str(tmp_path / "crash_armed")
+    open(sentinel, "w").close()
+    cfg = _dummy_cfg(num_envs=2, vectorization="async")
+    envs = vectorize_thunks(
+        _crashing_thunks(2, crash_index=1, crash_at_step=2, sentinel=sentinel),
+        cfg,
+        env_seeds_list=env_seeds(cfg.seed, 0, 2),
+    )
+    assert isinstance(envs, AsyncSharedMemVectorEnv)
+    try:
+        envs.reset(seed=cfg.seed)
+        acts = np.zeros(2, dtype=np.int64)
+        envs.step(acts)  # step 1: fine
+        obs, rew, term, trunc, infos = envs.step(acts)  # step 2: env 1 dies
+        assert envs.worker_restarts == 1
+        assert not envs.degraded_to_sync
+        # the lost step is replaced by an auto-reset: reward 0, no
+        # termination, restart flagged (the RestartOnException contract)
+        assert rew[1] == 0.0 and not term[1] and not trunc[1]
+        assert infos["env_worker_restart"][1] and not infos["env_worker_restart"][0]
+        assert np.array_equal(obs["state"][1], np.zeros(3, dtype=np.float32))
+        # env 0 was untouched
+        assert rew[0] == 1.0 and np.array_equal(obs["state"][0], np.full(3, 2, np.float32))
+        # and the pool keeps serving steps afterwards
+        for _ in range(4):
+            obs, rew, term, trunc, _ = envs.step(acts)
+        assert rew[0] == 1.0 and rew[1] == 1.0
+    finally:
+        envs.close()
+
+
+def test_restart_budget_forgiven_outside_window(tmp_path):
+    """Sparse transient failures don't accumulate into a degrade: a restart
+    older than restart_window_s resets the budget."""
+    sentinel = str(tmp_path / "crash_armed")
+    open(sentinel, "w").close()
+    cfg = _dummy_cfg(
+        num_envs=2, vectorization="async", max_worker_restarts=1, restart_window_s=5.0
+    )
+    envs = vectorize_thunks(
+        _crashing_thunks(2, crash_index=1, crash_at_step=1, sentinel=sentinel),
+        cfg,
+        env_seeds_list=env_seeds(cfg.seed, 0, 2),
+    )
+    try:
+        envs.reset(seed=cfg.seed)
+        acts = np.zeros(2, dtype=np.int64)
+        envs.step(acts)  # crash 1 -> restart 1/1 in window
+        assert envs.worker_restarts == 1 and not envs.degraded_to_sync
+        # age the first restart out of the window, then force a second
+        # crash: the sliding window forgets it instead of degrading
+        envs._restart_times[0] -= 10.0
+        open(sentinel, "w").close()
+        envs.step(acts)  # revived env is at step 1 again -> crash 2
+        assert envs.worker_restarts == 2  # lifetime total, for telemetry
+        assert len(envs._restart_times) == 1, "window did not slide"
+        assert not envs.degraded_to_sync
+        envs.step(acts)
+    finally:
+        if os.path.exists(sentinel):
+            os.unlink(sentinel)
+        envs.close()
+
+
+def _hanging_thunks(n_envs, hang_index, sentinel):
+    """Env `hang_index` blocks inside step while the sentinel file exists."""
+    import gymnasium as gym
+
+    class HangingEnv(gym.Env):
+        def __init__(self, index):
+            self.observation_space = gym.spaces.Dict(
+                {"state": gym.spaces.Box(-np.inf, np.inf, (2,), np.float32)}
+            )
+            self.action_space = gym.spaces.Discrete(2)
+            self._index = index
+            self._step = 0
+
+        def reset(self, seed=None, options=None):
+            self._step = 0
+            return {"state": np.zeros(2, np.float32)}, {}
+
+        def step(self, action):
+            self._step += 1
+            if self._index == hang_index:
+                while os.path.exists(sentinel):
+                    time.sleep(0.05)
+            return {"state": np.full(2, self._step, np.float32)}, 1.0, False, False, {}
+
+    return [lambda i=i: HangingEnv(i) for i in range(n_envs)]
+
+
+def test_hung_worker_times_out_and_degrades_to_sync(tmp_path):
+    sentinel = str(tmp_path / "hang")
+    open(sentinel, "w").close()
+    cfg = _dummy_cfg(
+        num_envs=2,
+        vectorization="async",
+        worker_timeout_s=1.5,
+        max_worker_restarts=0,
+    )
+    envs = vectorize_thunks(
+        _hanging_thunks(2, hang_index=0, sentinel=sentinel),
+        cfg,
+        env_seeds_list=env_seeds(cfg.seed, 0, 2),
+    )
+    try:
+        envs.reset(seed=cfg.seed)
+        acts = np.zeros(2, dtype=np.int64)
+        with pytest.warns(UserWarning, match="degrading to in-process sync"):
+            obs, rew, term, trunc, infos = envs.step(acts)
+        assert envs.degraded_to_sync
+        # every env was auto-reset in place of the lost step
+        assert np.all(rew == 0.0) and not term.any() and not trunc.any()
+        assert infos["env_worker_restart"].all()
+        # slow beats dead: the pool keeps serving steps in-process (the
+        # sentinel is gone, so the rebuilt env no longer hangs)
+        os.unlink(sentinel)
+        obs, rew, term, trunc, _ = envs.step(acts)
+        assert np.all(rew == 1.0)
+        assert np.array_equal(obs["state"], np.full((2, 2), 1, np.float32))
+    finally:
+        if os.path.exists(sentinel):
+            os.unlink(sentinel)
+        envs.close()
+
+
+def test_crashed_run_exits_instead_of_hanging_at_atexit(tmp_path):
+    """A run that raises without closing the pool must still exit: the
+    workers ignore SIGTERM, so without the pool's atexit hook
+    multiprocessing's own exit handler would join() them forever."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "crash_run.py"
+    script.write_text(
+        """
+import numpy as np
+import gymnasium as gym
+
+def main():
+    from sheeprl_tpu.envs.vector import AsyncSharedMemVectorEnv
+
+    def thunk():
+        import gymnasium as gym
+        import numpy as np
+
+        class E(gym.Env):
+            observation_space = gym.spaces.Dict(
+                {"state": gym.spaces.Box(-np.inf, np.inf, (2,), np.float32)}
+            )
+            action_space = gym.spaces.Discrete(2)
+
+            def reset(self, seed=None, options=None):
+                return {"state": np.zeros(2, np.float32)}, {}
+
+            def step(self, action):
+                return {"state": np.zeros(2, np.float32)}, 0.0, False, False, {}
+
+        return E()
+
+    envs = AsyncSharedMemVectorEnv([thunk, thunk])
+    envs.reset(seed=0)
+    raise RuntimeError("simulated training crash with the pool still open")
+
+if __name__ == "__main__":
+    main()
+"""
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True,
+        text=True,
+        timeout=120,  # the bug mode is an indefinite hang, not a slow exit
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": os.getcwd()},
+    )
+    assert proc.returncode != 0
+    assert "simulated training crash" in proc.stderr
+
+
+# -- telemetry acceptance -----------------------------------------------------
+
+
+def test_forced_crash_lands_env_worker_restarts_in_telemetry(tmp_path):
+    """Acceptance: a forced worker crash mid-run restarts the worker, the
+    run completes, and telemetry.json records env_worker_restarts > 0 (plus
+    the async step counter proving the pool served the steps)."""
+    from sheeprl_tpu.obs.telemetry import finalize_telemetry, setup_telemetry
+
+    cfg = _dummy_cfg(num_envs=2, vectorization="async")
+    cfg.metric.telemetry = {
+        "enabled": True,
+        "trace": False,
+        "poll_interval_s": 0,
+        "live_interval_s": 0,
+        "summary_path": str(tmp_path / "telemetry.json"),
+    }
+    sentinel = str(tmp_path / "crash_armed")
+    open(sentinel, "w").close()
+    telemetry = setup_telemetry(cfg)
+    assert telemetry is not None
+    try:
+        envs = vectorize_thunks(
+            _crashing_thunks(2, crash_index=0, crash_at_step=2, sentinel=sentinel),
+            cfg,
+            env_seeds_list=env_seeds(cfg.seed, 0, 2),
+        )
+        try:
+            envs.reset(seed=cfg.seed)
+            acts = np.zeros(2, dtype=np.int64)
+            for _ in range(4):
+                envs.step(acts)
+            assert envs.worker_restarts == 1
+        finally:
+            envs.close()
+    finally:
+        summary = finalize_telemetry(print_summary=False)
+    assert summary["env_worker_restarts"] == 1
+    assert summary["env_steps_async"] == 4 * 2
+    assert summary["env_degraded_to_sync"] == 0
+    on_disk = json.loads((tmp_path / "telemetry.json").read_text())
+    assert on_disk["env_worker_restarts"] == 1
+    # the collective worker wait is a first-class phase histogram
+    assert "Time/env_wait_time" in on_disk["phase_percentiles"]
+
+
+# -- preemption drain + e2e ---------------------------------------------------
+
+
+def _base_cli_args(tmp_path):
+    return [
+        "env=dummy",
+        "env.vectorization=async",
+        "env.worker_timeout_s=120.0",
+        "metric.log_every=1000000",
+        "metric.log_level=0",
+        "env.capture_video=False",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+        f"root_dir={tmp_path}/logs",
+        "run_name=test",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+    ]
+
+
+def test_sigterm_drain_leaves_resumable_run_async(tmp_path, monkeypatch):
+    """PR-2 preemption path with the worker pool live: SIGTERM mid-run
+    checkpoints, drains the workers cleanly, and the run dir resolves as
+    resumable via `latest`."""
+    from sheeprl_tpu import cli
+    from sheeprl_tpu.ckpt.preemption import reset_preemption
+    from sheeprl_tpu.ckpt.resume import read_checkpoint, resolve_latest
+
+    monkeypatch.chdir(tmp_path)
+    timer = threading.Timer(3.0, os.kill, (os.getpid(), signal.SIGTERM))
+    timer.start()
+    try:
+        cli.run(_base_cli_args(tmp_path) + [
+            "exp=ppo",
+            "algo.rollout_steps=4",
+            "per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "cnn_keys.encoder=[rgb]",
+            "mlp_keys.encoder=[]",
+            "algo.encoder.cnn_features_dim=16",
+            "env.id=discrete_dummy",
+            "algo.run_test=False",
+            "total_steps=40000",  # far more than ~3 s of work
+            "checkpoint.every=1000000",
+            "checkpoint.save_last=True",
+        ])
+    finally:
+        timer.cancel()
+        reset_preemption()
+    latest = resolve_latest(f"{tmp_path}/logs")
+    assert latest is not None, "preemption left no resumable checkpoint"
+    state = read_checkpoint(latest)
+    assert 0 < int(np.asarray(state["update"])) < 40000 // 8, "run was not cut short"
+
+
+def test_sac_e2e_async(tmp_path, monkeypatch):
+    """SAC end-to-end on CPU with env.vectorization=async (the satellite's
+    acceptance run): trains, tests, and tears the pool down cleanly."""
+    from sheeprl_tpu import cli
+
+    monkeypatch.chdir(tmp_path)
+    cli.run(_base_cli_args(tmp_path) + [
+        "exp=sac",
+        "dry_run=True",
+        "per_rank_batch_size=4",
+        "algo.learning_starts=2",
+        "algo.hidden_size=8",
+        "env=gym",
+        "env.id=Pendulum-v1",
+        "env.vectorization=async",
+        "env.capture_video=False",
+        "buffer.size=64",
+        "checkpoint.every=1000000",
+        "mlp_keys.encoder=[state]",
+    ])

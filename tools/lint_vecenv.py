@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Vector-env-uniformity lint: every algo builds envs through the factory.
+
+The environment-construction decision lives exactly once, in
+``sheeprl_tpu/envs/vector/factory.py`` (``make_vector_env`` /
+``make_eval_env``): canonical per-env seeding (``seed + rank * n_envs +
+idx``), the capture-video/log-dir gate, and the vector backend selection
+(``env.vectorization``: sync / shared-memory async pool / gym_async). Before
+the factory existed the same ``SyncVectorEnv(thunks, ...)`` block was
+copy-pasted across all 17 entrypoints and the per-algo ``evaluate.py`` files
+hand-rolled their own ``make_env(...)()`` single-env paths — with the seeding
+arithmetic already drifting between them. This lint fails when a file under
+``sheeprl_tpu/algos/`` re-grows inline construction:
+
+- a direct ``SyncVectorEnv(...)`` / ``AsyncVectorEnv(...)`` call (or an
+  import of either from ``gymnasium.vector``) — backend choice belongs to
+  the factory;
+- a ``vectorize_envs(...)`` call — the legacy shim is for diagnostics/tools
+  with custom thunks, not algorithms;
+- a ``make_env(...)`` call — train loops use ``make_vector_env``, test
+  episodes use ``make_eval_env``, so every env gets the same
+  wrappers/seeding path.
+
+AST-based, so comments and docstrings are fine. Usage:
+``python tools/lint_vecenv.py`` — exits non-zero with a findings list on
+violation. Wired into the CI tier-1 lane (.github/workflows/tests.yml).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
+
+#: constructing either by hand bypasses the factory's backend decision
+FORBIDDEN_VECTOR_CLASSES = {"SyncVectorEnv", "AsyncVectorEnv"}
+
+#: callables whose direct use in algos/ re-inlines env construction
+FORBIDDEN_CALLS = {
+    "vectorize_envs": "wrap thunks via make_vector_env (envs/vector/factory.py)",
+    "make_env": "use make_vector_env for training, make_eval_env for test episodes",
+}
+
+
+def _call_name(fn: ast.AST) -> str:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def lint_file(path: str) -> list:
+    src = open(path).read()
+    tree = ast.parse(src, filename=path)
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and "gymnasium" in node.module:
+            for alias in node.names:
+                if alias.name in FORBIDDEN_VECTOR_CLASSES:
+                    findings.append(
+                        (node.lineno,
+                         f"direct import of gymnasium `{alias.name}` — the vector "
+                         "backend is chosen by make_vector_env (env.vectorization)")
+                    )
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name in FORBIDDEN_VECTOR_CLASSES:
+            findings.append(
+                (node.lineno,
+                 f"inline vector-env construction `{name}(...)` — build envs "
+                 "through make_vector_env (envs/vector/factory.py)")
+            )
+        elif name in FORBIDDEN_CALLS:
+            findings.append(
+                (node.lineno, f"direct `{name}(...)` call — {FORBIDDEN_CALLS[name]}")
+            )
+    return findings
+
+
+def main() -> int:
+    failures = []
+    for root, _dirs, files in os.walk(ALGOS_DIR):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            for lineno, msg in lint_file(path):
+                failures.append(f"{os.path.relpath(path, REPO)}:{lineno}: {msg}")
+    if failures:
+        print("vector-env-uniformity lint FAILED:")
+        for f in failures:
+            print(f"  {f}")
+        print(
+            "\nAll env construction in sheeprl_tpu/algos/ must go through "
+            "sheeprl_tpu/envs/vector (make_vector_env / make_eval_env)."
+        )
+        return 1
+    print("vector-env-uniformity lint passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
