@@ -46,6 +46,12 @@ Workloads:
    rows 2921.38 s / 1148.1 s cannot be step-matched; each line carries the
    exact workload we ran and `vs_baseline` is the raw wall-clock ratio with
    that caveat recorded in `protocol`.
+5. Rollout-engine evidence (round 6, howto/rollout_engine.md): a
+   `jax_cartpole_rollout_sps` line — jitted-scan collection on the pure-JAX
+   CartPole vs the per-step sync Python loop (tools/bench_rollout.py) — and
+   a `sac_lunarlander_8192_steps_act_burst16` line with the
+   act_dispatches/rollout_bursts counters and the sps delta vs the
+   per-step SAC stage.
 
 Wall-clock protocol (round-4 de-noising): repeated lines run one warm-up
 (compile/cache fill, disclosed) plus up to 3 measured repeats — trimmed to
@@ -218,6 +224,11 @@ def _phase_tails(tel) -> dict:
         # async env pool only: the parent's collective wait for worker
         # results — the *exposed* env latency when stepping overlaps train
         ("Time/env_wait_time", "env_wait"),
+        # rollout engine (envs/rollout): one span per collection burst —
+        # policy dispatch + env stepping + buffer add; env_p95 above is the
+        # pure env.step slice inside it, so rollout_p95 - env-time is the
+        # dispatch/bookkeeping residue (the RTT decomposition)
+        ("Time/rollout_time", "rollout"),
     ):
         p = pct.get(phase) or {}
         if p.get("p95_ms") is not None:
@@ -372,6 +383,34 @@ def _ppo_async_line(sync_line: str) -> str:
     return line
 
 
+def _rollout_jax_line(min_stage_s: float = 60.0) -> str:
+    """Tier-a evidence: jitted-scan collection on the pure-JAX CartPole vs
+    the per-step sync Python loop (tools/bench_rollout.py, apples-to-apples
+    MLP policy + replay add on both sides). ISSUE-6 acceptance: >= 10x."""
+    metric = "jax_cartpole_rollout_sps"
+    if _remaining() < min_stage_s:
+        return _skip_line(metric, min_stage_s)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_rollout.py")],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=max(60.0, _remaining()),
+        )
+        line = next(
+            (l for l in reversed(proc.stdout.splitlines()) if l.startswith("{")), None
+        )
+        if proc.returncode == 0 and line:
+            return line
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+        return json.dumps(
+            {"metric": metric, "value": None, "error": " | ".join(tail)[-400:]}
+        )
+    except Exception as exc:
+        return json.dumps({"metric": metric, "value": None, "error": repr(exc)[:400]})
+
+
 def _sac_line() -> str:
     # reference protocol (benchmark_sb3.py:21-29): LunarLanderContinuous,
     # 4 envs, 65536 steps. SAC is one policy+one train dispatch per env step,
@@ -450,6 +489,76 @@ def _sac_line() -> str:
     return line
 
 
+def _sac_burst_line(per_step_line: str) -> str:
+    # Tier-b evidence: the same disclosed 1/8 SAC protocol with
+    # env.act_burst=16 — one device dispatch per 16 env steps for acting and
+    # one train dispatch covering 16 updates' gradient steps, instead of one
+    # of each per step. The line carries act_dispatches/rollout_bursts from
+    # telemetry (the dispatch amortization, ~total_steps/16 bursts) and the
+    # sps delta vs the per-step SAC line; the folded phase tails
+    # (rollout_p95 vs env_p95 vs train_p50) are the RTT decomposition when
+    # vs_baseline stays < 1 through the tunnel.
+    import tempfile
+
+    tel_path = os.path.join(tempfile.mkdtemp(prefix="bench_sac_burst_tel_"), "telemetry.json")
+    steps = 8192
+    args = [
+        "exp=sac",
+        "env.num_envs=4",
+        "env.sync_env=True",
+        "env.act_burst=16",
+        f"total_steps={steps}",
+        "exp_name=bench_sac_burst",
+        "buffer.device_ring=True",
+        "metric.telemetry.enabled=true",
+        "metric.telemetry.trace=false",
+        f"metric.telemetry.summary_path={tel_path}",
+        *_QUIET,
+    ]
+    line = _repeat_line(
+        "sac_lunarlander_8192_steps_act_burst16",
+        lambda: _timed_subprocess_run(args, timeout=1800),
+        SAC_BASELINE_SECONDS / 8.0,
+        "1/8 of reference benchmark_sb3.py:21-29 with env.act_burst=16 "
+        "(burst acting, envs/rollout: 16 env steps per acting dispatch, one "
+        "train burst per 16 updates); single measured run after one warm-up "
+        "— read next to the per-step SAC line for the dispatch-amortization "
+        "delta",
+        repeats=1,
+        min_stage_s=200.0,
+    )
+    try:
+        with open(tel_path) as f:
+            tel = json.load(f)
+        data = json.loads(line)
+        data["telemetry"] = {
+            k: tel.get(k)
+            for k in (
+                "act_dispatches",
+                "rollout_bursts",
+                "ring_gathers",
+                "bytes_staged_h2d",
+                "recompiles",
+            )
+        }
+        data["telemetry"].update(_phase_tails(tel))
+        if data.get("value"):
+            data["sps"] = round(steps / data["value"], 1)
+            try:
+                ps = json.loads(per_step_line)
+                ps_steps = int(ps["metric"].split("_")[2])  # sac_lunarlander_<N>_steps
+                if ps.get("value"):
+                    data["sps_vs_per_step"] = round(
+                        data["sps"] / (ps_steps / ps["value"]), 3
+                    )
+            except Exception:
+                pass
+        line = json.dumps(data)
+    except Exception:
+        pass  # a skipped/failed stage has no summary; keep the line as-is
+    return line
+
+
 def _dreamer_e2e_line(family, baseline, total_steps, min_stage_s, extra=()) -> str:
     args = [
         f"exp={family}",  # defaults to the 64x64-pixel dummy env
@@ -493,6 +602,9 @@ def main() -> None:
     # async-envs evidence line right after the headline it is compared to
     # (env_p95/env_wait_p95 + pool counters + sps delta vs sync)
     emit(_ppo_async_line(ppo_line))
+    # rollout-engine tier-a evidence: jitted-scan collection sps vs the sync
+    # Python loop (cheap, ~1 min; ISSUE-6 acceptance >= 10x)
+    emit(_rollout_jax_line())
     emit(_dreamer_line("dv3", min_stage_s=180.0, extra=("bench.profile=1",)))
     # DV2/DV1 device-step lines (grad-steps/s + scan-corrected MFU vs wall
     # rate; no xplane pass — keeps each under ~3 min warm). Their e2e
@@ -505,7 +617,11 @@ def main() -> None:
     emit(_dreamer_line("dv1", min_stage_s=170.0, extra=("bench.steps=10",)))
     # SAC last: the only stage that can overrun its estimate by minutes
     # (per-step dispatch); anything it loses is only its own line
-    emit(_sac_line())
+    sac_line = _sac_line()
+    emit(sac_line)
+    # burst-acting evidence right after the per-step SAC line it is compared
+    # to (act_dispatches/rollout_bursts counters + sps delta + phase tails)
+    emit(_sac_burst_line(sac_line))
     # e2e rows fit only a generous budget (>15 min per run: ~12 MB host
     # batch per burst through the tunnel); their min_stage_s gates emit
     # disclosed skip lines under the default budget

@@ -66,6 +66,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import (
     LoopProbe,
+    add_act_dispatches,
     cost_flops_of,
     get_telemetry,
     log_sps_metrics,
@@ -835,6 +836,11 @@ def main(fabric, cfg: Dict[str, Any]):
                     real_actions = np.stack(
                         [np.argmax(np.asarray(a), axis=-1) for a in actions_j], axis=-1
                     )
+                # recurrent players pay one inference dispatch per env step —
+                # the counter makes that cost visible next to the burst-acting
+                # algos' rollout_bursts (envs/rollout; burst acting for
+                # stateful players is future work)
+                add_act_dispatches(1)
 
             probe.lap("act")
             step_data["actions"] = actions.reshape(1, n_envs, -1).astype(np.float32)

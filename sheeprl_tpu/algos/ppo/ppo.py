@@ -25,6 +25,12 @@ TPU-native design (NOT a translation):
   same shard_map with the data replicated instead of sharded.
 - Annealing (lr / clip / entropy) is host-side state threaded into the jitted
   step as dynamic scalars — no recompilation.
+- Rollout collection goes through the burst actor (``envs/rollout``,
+  ``howto/rollout_engine.md``): the per-step loop body (policy → env step →
+  buffer add → episode bookkeeping) runs as a host callback scanned
+  ``env.act_burst`` times per device dispatch; truncation V(s') bootstraps
+  are patched into the stored rewards after the burst (the acting params
+  are frozen across the rollout, so the values are identical).
 """
 
 from __future__ import annotations
@@ -55,6 +61,7 @@ from sheeprl_tpu.obs import (
     shape_specs,
     span,
 )
+from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -281,16 +288,17 @@ def main(fabric, cfg: Dict[str, Any]):
     # (SURVEY §5.8 — players pinned to CPU hosts feeding the trainer mesh).
     to_host = HostParamMirror.from_cfg(params, fabric, cfg)
 
-    @jax.jit
-    def policy_step_fn(params, obs, key):
-        # the key advances INSIDE the jitted call: the host rollout then costs
-        # exactly one dispatch per env step (a host-side jax.random.split per
-        # step would be a second one — over a remote TPU, a second round trip)
+    def _act_fn(params, obs, key):
+        # the key advances INSIDE the jitted burst: the rollout costs one
+        # dispatch per env.act_burst env steps (a host-side jax.random.split
+        # per step would be a second one — over a remote TPU, a second round
+        # trip); the body is the old per-step policy_step_fn verbatim, so
+        # act_burst=1 reproduces the per-step path bitwise
         key, sub = jax.random.split(key)
         norm = normalize_obs(obs, cnn_keys, obs_keys)
         pre_dist, values = agent.apply({"params": params}, norm)
         actions, real_actions, logprob = sample_actions(pre_dist, is_continuous, sub)
-        return actions, real_actions, logprob, values, key
+        return (actions, real_actions, logprob, values), key
 
     @jax.jit
     def value_fn(params, obs):
@@ -337,6 +345,69 @@ def main(fabric, cfg: Dict[str, Any]):
     root_key, play_key = jax.random.split(root_key)
     play_key = to_host.put_key(play_key)
 
+    # Burst acting (envs/rollout, howto/rollout_engine.md): the acting loop
+    # body below is the old per-step block moved into a host callback; the
+    # BurstActor scans it env.act_burst times per device dispatch.
+    act_burst = max(int(cfg.env.get("act_burst", 1) or 1), 1)
+    state_box = {"obs": next_obs, "policy_step": policy_step}
+    #: (ring row, truncated env ids, prepared final obs) per truncation —
+    #: the V(s') bootstrap is patched into the stored rewards after the
+    #: burst returns (the jitted burst cannot re-enter the device)
+    trunc_events = []
+
+    def _host_env_step(actions, real_actions, logprobs, values):
+        state_box["policy_step"] += n_envs
+        with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
+            real_actions = np.asarray(real_actions)
+            obs, rewards, terminated, truncated, info = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+
+        truncated_envs = np.nonzero(truncated)[0]
+        if len(truncated_envs) > 0:
+            # bootstrap V(s') into the reward on truncation (ppo.py:291-310),
+            # deferred to the end of the burst
+            final_obs = info["final_obs"]
+            t_obs = {
+                k: np.stack([np.asarray(final_obs[te][k]) for te in truncated_envs])
+                for k in obs_keys
+            }
+            t_obs = prepare_obs(t_obs, cnn_keys, len(truncated_envs))
+            trunc_events.append((int(rb._pos), truncated_envs, t_obs))
+
+        dones = np.logical_or(terminated, truncated).astype(np.float32)
+        rewards = np.asarray(rewards, dtype=np.float32)
+
+        step_data = {
+            **{k: np.asarray(state_box["obs"][k])[None] for k in obs_keys},
+            "dones": dones.reshape(1, n_envs, 1),
+            "values": np.asarray(values).reshape(1, n_envs, 1),
+            "actions": np.asarray(actions).reshape(1, n_envs, -1),
+            "logprobs": np.asarray(logprobs).reshape(1, n_envs, 1),
+            "rewards": rewards.reshape(1, n_envs, 1),
+        }
+        rb.add(step_data)
+
+        state_box["obs"] = prepare_obs(obs, cnn_keys, n_envs)
+
+        if cfg.metric.log_level > 0 and "final_info" in info:
+            fi = info["final_info"]
+            if isinstance(fi, dict) and "episode" in fi:
+                mask = np.asarray(fi.get("_episode", []), dtype=bool)
+                for i in np.nonzero(mask)[0]:
+                    ep_rew = float(fi["episode"]["r"][i])
+                    ep_len = float(fi["episode"]["l"][i])
+                    if aggregator and "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if aggregator and "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                    fabric.print(
+                        f"Rank-0: policy_step={state_box['policy_step']}, reward_env_{i}={ep_rew}"
+                    )
+        return state_box["obs"]
+
+    burst_actor = BurstActor(_act_fn, _host_env_step, next_obs)
+
     for update in range(start_step, num_updates + 1):
         if cfg.algo.anneal_lr:
             lr = polynomial_decay(
@@ -350,60 +421,25 @@ def main(fabric, cfg: Dict[str, Any]):
         else:
             lr = cfg.algo.optimizer.lr
 
-        for _ in range(cfg.algo.rollout_steps):
-            policy_step += n_envs
-
-            with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
-                actions_j, real_actions_j, logprob_j, values_j, play_key = policy_step_fn(
-                    play_params, next_obs, play_key
+        remaining = int(cfg.algo.rollout_steps)
+        while remaining > 0:
+            n_act = min(act_burst, remaining)
+            with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+                _, play_key = burst_actor.rollout(
+                    play_params, state_box["obs"], play_key, n_act
                 )
-                real_actions = np.asarray(real_actions_j)
-                obs, rewards, terminated, truncated, info = envs.step(
-                    real_actions.reshape(envs.action_space.shape)
-                )
+            remaining -= n_act
+        policy_step = state_box["policy_step"]
 
-                truncated_envs = np.nonzero(truncated)[0]
-                if len(truncated_envs) > 0:
-                    # bootstrap V(s') into the reward on truncation (ppo.py:291-310)
-                    final_obs = info["final_obs"]
-                    t_obs = {
-                        k: np.stack([np.asarray(final_obs[te][k]) for te in truncated_envs])
-                        for k in obs_keys
-                    }
-                    t_obs = prepare_obs(t_obs, cnn_keys, len(truncated_envs))
-                    vals = np.asarray(value_fn(play_params, t_obs)).reshape(-1)
-                    rewards = np.asarray(rewards, dtype=np.float32)
-                    rewards[truncated_envs] += vals
-
-                dones = np.logical_or(terminated, truncated).astype(np.float32)
-                rewards = np.asarray(rewards, dtype=np.float32)
-
-            step_data = {
-                **{k: np.asarray(next_obs[k])[None] for k in obs_keys},
-                "dones": dones.reshape(1, n_envs, 1),
-                "values": np.asarray(values_j).reshape(1, n_envs, 1),
-                "actions": np.asarray(actions_j).reshape(1, n_envs, -1),
-                "logprobs": np.asarray(logprob_j).reshape(1, n_envs, 1),
-                "rewards": rewards.reshape(1, n_envs, 1),
-            }
-            rb.add(step_data)
-
-            next_obs = prepare_obs(obs, cnn_keys, n_envs)
-
-            if cfg.metric.log_level > 0 and "final_info" in info:
-                fi = info["final_info"]
-                if isinstance(fi, dict) and "episode" in fi:
-                    mask = np.asarray(fi.get("_episode", []), dtype=bool)
-                    for i in np.nonzero(mask)[0]:
-                        ep_rew = float(fi["episode"]["r"][i])
-                        ep_len = float(fi["episode"]["l"][i])
-                        if aggregator and "Rewards/rew_avg" in aggregator:
-                            aggregator.update("Rewards/rew_avg", ep_rew)
-                        if aggregator and "Game/ep_len_avg" in aggregator:
-                            aggregator.update("Game/ep_len_avg", ep_len)
-                        fabric.print(
-                            f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}"
-                        )
+        # patch the deferred V(s') truncation bootstraps into the stored
+        # rewards (play_params were frozen for the whole rollout, so the
+        # values match what the per-step path computed inline)
+        for row, tr_envs, t_obs in trunc_events:
+            vals = np.asarray(value_fn(play_params, t_obs)).reshape(-1)
+            rewards_buf = rb["rewards"]
+            rewards_buf[row, tr_envs, 0] = rewards_buf[row, tr_envs, 0] + vals
+        trunc_events.clear()
+        next_obs = state_box["obs"]
 
         # GAE over the whole rollout (ppo.py:350-368), one fused scan on device
         next_values = value_fn(play_params, next_obs)

@@ -45,6 +45,7 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.obs import (
+    add_act_dispatches,
     count_h2d,
     cost_flops_of,
     get_telemetry,
@@ -219,6 +220,7 @@ def main(fabric, cfg: Dict[str, Any]):
                         actions_j, real_actions_j, logprob_j, values_j = policy_step_fn(
                             snapshot, next_obs, nonlocal_key
                         )
+                        add_act_dispatches(1)
                         real_actions = np.asarray(real_actions_j)
                         obs, rewards, terminated, truncated, info = envs.step(
                             real_actions.reshape(envs.action_space.shape)

@@ -15,6 +15,13 @@ TPU-native design:
   them sharded over the mesh, and the device scans over G gradient steps —
   critic, actor, and alpha updates each with ``pmean``-ed grads, plus the
   conditional target-EMA folded in as a ``jnp.where`` on the parameter trees.
+- Collection goes through the rollout engine (``envs/rollout``,
+  ``howto/rollout_engine.md``): with ``env.backend=jax`` the whole burst —
+  act, env step, auto-reset, device-ring add — is one ``lax.scan`` under
+  jit (zero host involvement); on the Python backend the acting loop body
+  lives in a host callback that a ``BurstActor`` scans ``env.act_burst``
+  times per device dispatch (K=1 = the exact per-step reference path), and
+  one train program covers the burst's gradient steps.
 - The critic ensemble is vmapped stacked params (see ``agent.py``) — the
   twin-Q min and per-critic MSE sum are single batched ops.
 - The whole agent state (actor/critics/targets/log_alpha + 3 optimizer
@@ -50,8 +57,11 @@ from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_check
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.data.staging import make_replay_staging
+from sheeprl_tpu.data.device_ring import DeviceRingTransitions
+from sheeprl_tpu.data.staging import RingStaging, make_replay_staging
+from sheeprl_tpu.envs.rollout import BurstActor, JaxRolloutEngine, make_jax_env
 from sheeprl_tpu.envs.vector import make_vector_env
+from sheeprl_tpu.envs.vector.factory import resolve_backend
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -197,10 +207,27 @@ def main(fabric, cfg: Dict[str, Any]):
         save_configs(cfg, log_dir)
 
     n_envs = int(cfg.env.num_envs) * world_size
-    # vector backend picked by env.vectorization (envs/vector/factory.py)
-    envs = make_vector_env(cfg, fabric, log_dir)
-    action_space = envs.single_action_space
-    observation_space = envs.single_observation_space
+    # execution plane picked by env.backend (envs/vector/factory.py): the
+    # Python vector-env plane, or the pure-JAX rollout engine (tier a) where
+    # whole collection bursts run on device (howto/rollout_engine.md)
+    backend = resolve_backend(cfg)
+    envs = None
+    jax_env = None
+    if backend == "jax":
+        if world_size > 1:
+            raise ValueError(
+                "env.backend=jax currently supports single-device SAC runs "
+                "(the jitted-scan collection owns one device's ring shard); "
+                f"got fabric world_size={world_size}"
+            )
+        jax_env = make_jax_env(cfg.env.id, cfg.env.max_episode_steps)
+        action_space = jax_env.action_space
+        observation_space = jax_env.observation_space
+    else:
+        # vector backend picked by env.vectorization (envs/vector/factory.py)
+        envs = make_vector_env(cfg, fabric, log_dir)
+        action_space = envs.single_action_space
+        observation_space = envs.single_observation_space
     if not isinstance(action_space, gym.spaces.Box):
         raise ValueError("Only continuous action space is supported for the SAC agent")
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -273,14 +300,6 @@ def main(fabric, cfg: Dict[str, Any]):
 
     scale_j, bias_j = jnp.asarray(action_scale), jnp.asarray(action_bias)
 
-    @jax.jit
-    def policy_fn(actor_params, obs, key):
-        # key advances inside the jitted call: one host dispatch per env step
-        key, sub = jax.random.split(key)
-        mean, std = actor.apply({"params": actor_params}, obs)
-        actions, _ = squash_sample(mean, std, sub, scale_j, bias_j)
-        return actions, key
-
     actor_mirror = HostParamMirror.from_cfg(agent_state["actor"], fabric, cfg)
     play_actor = actor_mirror(agent_state["actor"])
 
@@ -288,12 +307,44 @@ def main(fabric, cfg: Dict[str, Any]):
         actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric, action_scale, action_bias, target_entropy
     )
     batch_sharding = fabric.sharding(None, fabric.data_axis)
-    # TPU-first replay staging (data/staging.py): device-ring gathers when
-    # buffer.device_ring=True, double-buffered host prefetch otherwise
-    staging = make_replay_staging(
-        cfg, fabric, rb, batch_sharding=batch_sharding, seed=cfg.seed
-    )
-    rb = staging.rb
+    if backend == "jax":
+        # the jitted-scan collection writes straight into the device ring —
+        # the ring IS the collection target on this backend, so it is always
+        # on regardless of buffer.device_ring
+        if not cfg.buffer.get("device_ring", False):
+            warnings.warn(
+                "env.backend=jax collects straight into the device ring; "
+                "enabling it (buffer.device_ring was off)"
+            )
+        ring = DeviceRingTransitions(
+            rb, device=getattr(fabric, "device", None), seed=cfg.seed
+        )
+        staging = RingStaging(ring)
+        rb = ring
+    else:
+        # TPU-first replay staging (data/staging.py): device-ring gathers when
+        # buffer.device_ring=True, double-buffered host prefetch otherwise
+        staging = make_replay_staging(
+            cfg, fabric, rb, batch_sharding=batch_sharding, seed=cfg.seed
+        )
+        rb = staging.rb
+
+    if backend == "jax":
+        # tier (a): act -> step -> ring-add inside one lax.scan under jit
+        def engine_policy(actor_params, e_obs, key):
+            mean, std = actor.apply({"params": actor_params}, e_obs)
+            actions, _ = squash_sample(mean, std, key, scale_j, bias_j)
+            return actions
+
+        root_key, engine_key = jax.random.split(root_key)
+        engine = JaxRolloutEngine(
+            jax_env,
+            n_envs,
+            engine_key,
+            policy=engine_policy,
+            ring=rb,
+            store_next_obs=not cfg.buffer.sample_next_obs,
+        )
 
     # Global counters (reference sac.py:206-215)
     last_train = 0
@@ -317,63 +368,132 @@ def main(fabric, cfg: Dict[str, Any]):
         )
     warn_checkpoint_rounding(cfg, policy_steps_per_update)
 
-    o = envs.reset(seed=cfg.seed)[0]
-    obs = concat_obs(o, cfg.mlp_keys.encoder, n_envs)
-    root_key, play_key = jax.random.split(root_key)
-    play_key = actor_mirror.put_key(play_key)
+    if backend == "python":
+        o = envs.reset(seed=cfg.seed)[0]
+        obs = concat_obs(o, cfg.mlp_keys.encoder, n_envs)
+        root_key, play_key = jax.random.split(root_key)
+        play_key = actor_mirror.put_key(play_key)
 
     per_rank_gradient_steps = int(cfg.algo.per_rank_gradient_steps)
     ema_every = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_update + 1
+    # burst acting (tier b, howto/rollout_engine.md): K env steps per device
+    # dispatch; 1 reproduces the per-step path exactly
+    act_burst = max(int(cfg.env.get("act_burst", 1) or 1), 1)
 
-    for update in range(start_step, num_updates + 1):
-        policy_step += n_envs
+    if backend == "python":
+        # The acting loop body as one host function: env step (against the
+        # PR-5 vector plane), SAME_STEP final_obs fixup, episode logging,
+        # buffer add — the old per-step block verbatim. The BurstActor scans
+        # it K times per dispatch through an ordered io_callback; the random
+        # prefill phase calls it directly (no policy, no dispatch at all).
+        state_box = {"obs": obs, "policy_step": policy_step}
 
-        with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
-            if update <= learning_starts:
-                actions = envs.action_space.sample()
-            else:
-                actions_j, play_key = policy_fn(play_actor, obs, play_key)
-                actions = np.asarray(actions_j)
-            next_o, rewards, terminated, truncated, infos = envs.step(
-                actions.reshape(envs.action_space.shape)
-            )
+        def _host_env_step(actions):
+            actions = np.asarray(actions)
+            state_box["policy_step"] += n_envs
+            with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
+                next_o, rewards, terminated, truncated, infos = envs.step(
+                    actions.reshape(envs.action_space.shape)
+                )
             dones = np.logical_or(terminated, truncated)
 
-        if cfg.metric.log_level > 0 and "final_info" in infos:
-            fi = infos["final_info"]
-            if isinstance(fi, dict) and "episode" in fi:
-                mask = np.asarray(fi.get("_episode", []), dtype=bool)
-                for i in np.nonzero(mask)[0]:
-                    ep_rew = float(fi["episode"]["r"][i])
-                    ep_len = float(fi["episode"]["l"][i])
+            if cfg.metric.log_level > 0 and "final_info" in infos:
+                fi = infos["final_info"]
+                if isinstance(fi, dict) and "episode" in fi:
+                    mask = np.asarray(fi.get("_episode", []), dtype=bool)
+                    for i in np.nonzero(mask)[0]:
+                        ep_rew = float(fi["episode"]["r"][i])
+                        ep_len = float(fi["episode"]["l"][i])
+                        if aggregator and not aggregator.disabled:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        fabric.print(
+                            f"Rank-0: policy_step={state_box['policy_step']}, reward_env_{i}={ep_rew}"
+                        )
+
+            # Real next obs: under SAME_STEP autoreset the terminal obs lands
+            # in final_obs while next_o holds the reset obs (reference
+            # sac.py:268-274)
+            next_obs = concat_obs(next_o, cfg.mlp_keys.encoder, n_envs)
+            real_next_obs = next_obs.copy()
+            if "final_obs" in infos:
+                for idx, final_obs in enumerate(infos["final_obs"]):
+                    if final_obs is not None:
+                        real_next_obs[idx] = concat_obs(final_obs, cfg.mlp_keys.encoder, 1)[0]
+
+            step_data = {
+                "observations": state_box["obs"][None],
+                "actions": np.asarray(actions, np.float32).reshape(1, n_envs, -1),
+                "rewards": np.asarray(rewards, np.float32).reshape(1, n_envs, 1),
+                "dones": np.asarray(dones, np.float32).reshape(1, n_envs, 1),
+            }
+            if not cfg.buffer.sample_next_obs:
+                step_data["next_observations"] = real_next_obs[None]
+            rb.add(step_data)
+            state_box["obs"] = next_obs
+            return next_obs
+
+        def _act_fn(actor_params, a_obs, key):
+            # key advances inside the jitted burst: same discipline as the
+            # old per-step policy_fn, so K=1 is bitwise the per-step path
+            key, sub = jax.random.split(key)
+            mean, std = actor.apply({"params": actor_params}, a_obs)
+            actions, _ = squash_sample(mean, std, sub, scale_j, bias_j)
+            return (actions,), key
+
+        burst_actor = BurstActor(_act_fn, _host_env_step, obs)
+
+    update = start_step
+    while update <= num_updates:
+        if backend == "jax":
+            # tier (a): the whole burst (act, step, auto-reset, ring add)
+            # is ONE device program; random bursts clamp at the
+            # learning-starts boundary so the catch-up train runs on time
+            # (and at num_updates, so learning_starts > num_updates can't
+            # collect past total_steps or skip the final log/ckpt gates)
+            random_phase = update <= learning_starts
+            boundary = min(learning_starts, num_updates) if random_phase else num_updates
+            n_act = max(min(act_burst, boundary - update + 1), 1)
+            with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+                stats = engine.collect(
+                    agent_state["actor"], n_act, random_actions=random_phase
+                )
+            if cfg.metric.log_level > 0:
+                _, done_b, ep_ret_b, ep_len_b = (np.asarray(s) for s in stats)
+                for t_i, env_i in zip(*np.nonzero(done_b)):
+                    ep_rew = float(ep_ret_b[t_i, env_i])
                     if aggregator and not aggregator.disabled:
                         aggregator.update("Rewards/rew_avg", ep_rew)
-                        aggregator.update("Game/ep_len_avg", ep_len)
-                    fabric.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+                        aggregator.update("Game/ep_len_avg", float(ep_len_b[t_i, env_i]))
+                    fabric.print(
+                        f"Rank-0: policy_step={policy_step + (int(t_i) + 1) * n_envs}, "
+                        f"reward_env_{int(env_i)}={ep_rew}"
+                    )
+            policy_step += n_envs * n_act
+        elif update <= learning_starts:
+            n_act = 1
+            _host_env_step(envs.action_space.sample())
+            policy_step = state_box["policy_step"]
+        else:
+            n_act = max(min(act_burst, num_updates - update + 1), 1)
+            with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+                _, play_key = burst_actor.rollout(
+                    play_actor, state_box["obs"], play_key, n_act
+                )
+            policy_step = state_box["policy_step"]
 
-        # Real next obs: under SAME_STEP autoreset the terminal obs arrives in
-        # final_obs while next_o holds the reset obs (reference sac.py:268-274)
-        next_obs = concat_obs(next_o, cfg.mlp_keys.encoder, n_envs)
-        real_next_obs = next_obs.copy()
-        if "final_obs" in infos:
-            for idx, final_obs in enumerate(infos["final_obs"]):
-                if final_obs is not None:
-                    real_next_obs[idx] = concat_obs(final_obs, cfg.mlp_keys.encoder, 1)[0]
+        first = update
+        update += n_act
+        last = update - 1
 
-        step_data = {
-            "observations": obs[None],
-            "actions": np.asarray(actions, np.float32).reshape(1, n_envs, -1),
-            "rewards": np.asarray(rewards, np.float32).reshape(1, n_envs, 1),
-            "dones": np.asarray(dones, np.float32).reshape(1, n_envs, 1),
-        }
-        if not cfg.buffer.sample_next_obs:
-            step_data["next_observations"] = real_next_obs[None]
-        rb.add(step_data)
-
-        obs = next_obs
-
-        if update >= learning_starts:
-            training_steps = learning_starts if update == learning_starts else 1
+        if last >= learning_starts and per_rank_gradient_steps > 0:
+            # one gradient burst covering every update index this burst
+            # collected (the reference per-step cadence for K=1; K>1 trades
+            # interleaving granularity for one dispatch per K steps)
+            training_steps = last - max(first, learning_starts) + 1
+            if first <= learning_starts <= last:
+                # the catch-up burst the reference runs at learning_starts
+                training_steps += learning_starts - 1
             g_total = training_steps * per_rank_gradient_steps
             # [G, B*world, ...] device arrays: ring-gathered from HBM, or
             # host-sampled + device_put overlapped with the previous burst
@@ -387,7 +507,11 @@ def main(fabric, cfg: Dict[str, Any]):
             train_specs = None
             with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 root_key, train_key = jax.random.split(root_key)
-                do_ema = jnp.bool_(update % ema_every == 0)
+                # EMA cadence: fires when any update index covered by this
+                # burst hits it (K=1 reduces to the reference per-update gate)
+                do_ema = jnp.bool_(
+                    any(u % ema_every == 0 for u in range(first, last + 1))
+                )
                 train_args = (agent_state, opt_states, batch, train_key, do_ema)
                 if telemetry is not None and telemetry.needs_train_flops():
                     # specs captured pre-call: the train step donates its state
@@ -399,7 +523,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 # dispatched program (which runs g_total gradient steps)
                 flops = cost_flops_of(train_fn, *train_specs)
                 telemetry.set_train_flops(flops / world_size if flops else None)
-            play_actor = actor_mirror(agent_state["actor"])
+            if backend == "python":
+                play_actor = actor_mirror(agent_state["actor"])
             train_step += world_size
 
             if aggregator and not aggregator.disabled:
@@ -408,7 +533,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.update("Loss/alpha_loss", losses[2])
 
         if cfg.metric.log_level > 0 and (
-            policy_step - last_log >= cfg.metric.log_every or update == num_updates
+            policy_step - last_log >= cfg.metric.log_every or last == num_updates
         ):
             if aggregator and not aggregator.disabled:
                 metrics_dict = aggregator.compute()
@@ -427,12 +552,12 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
+        if should_checkpoint(cfg, policy_step, last_checkpoint, last, num_updates):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": jax.device_get(agent_state),
                 "opt_states": jax.device_get(opt_states),
-                "update": update * world_size,
+                "update": last * world_size,
                 "batch_size": cfg.per_rank_batch_size * world_size,
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
@@ -451,6 +576,22 @@ def main(fabric, cfg: Dict[str, Any]):
                 break
 
     staging.close()
-    envs.close()
+    if envs is not None:
+        envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
-        test(actor, agent_state["actor"], scale_j, bias_j, fabric, cfg, log_dir)
+        if backend == "jax":
+            # evaluation runs the GYMNASIUM env of the same id (a dynamics
+            # parity statement for the native envs) — pure-JAX-only ids
+            # (brax/*) have no gymnasium counterpart, so a failed eval must
+            # not crash the completed training run
+            try:
+                test(actor, agent_state["actor"], scale_j, bias_j, fabric, cfg, log_dir)
+            except Exception as exc:
+                warnings.warn(
+                    f"run_test skipped for env.backend=jax: the evaluation "
+                    f"env {cfg.env.id!r} could not be built/run through the "
+                    f"gymnasium pipeline ({exc!r}); set algo.run_test=False "
+                    "for pure-JAX-only envs"
+                )
+        else:
+            test(actor, agent_state["actor"], scale_j, bias_j, fabric, cfg, log_dir)

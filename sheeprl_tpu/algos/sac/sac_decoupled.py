@@ -45,6 +45,7 @@ from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import (
+    add_act_dispatches,
     cost_flops_of,
     get_telemetry,
     log_sps_metrics,
@@ -234,6 +235,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     else:
                         step_key = jax.random.fold_in(player_key, update)
                         actions = np.asarray(policy_fn(param_cell["actor"], obs, step_key))
+                        add_act_dispatches(1)
                     next_o, rewards, terminated, truncated, infos = envs.step(
                         actions.reshape(envs.action_space.shape)
                     )

@@ -202,6 +202,17 @@ def check_configs(cfg) -> None:
             UserWarning,
         )
 
+    # burst acting (env.act_burst, envs/rollout) is consumed by the coupled
+    # SAC/PPO loops; elsewhere a >1 value would silently act per-step — the
+    # exact silent-ignore trap the resume-override accounting closes, so warn
+    if int(cfg.env.get("act_burst", 1) or 1) > 1 and algo_name not in ("sac", "ppo"):
+        warnings.warn(
+            f"env.act_burst={cfg.env.act_burst} is only consumed by the "
+            f"coupled SAC/PPO rollout paths; '{algo_name}' acts per-step "
+            "(howto/rollout_engine.md)",
+            UserWarning,
+        )
+
     # mixed precision is validated for everyone but currently consumed only by
     # the DreamerV3 model family — warn instead of silently training in f32
     from sheeprl_tpu.fabric import compute_dtype_from_precision

@@ -199,6 +199,27 @@ class ReplayBuffer:
                 self._full = True
             self._pos = next_pos
 
+    def advance_external(self, example_rows: Dict[str, np.ndarray], steps: int) -> None:
+        """Advance the ring counters for ``steps`` time rows written OUTSIDE
+        this buffer — the jitted-scan collection path writes straight into
+        the device ring, and the host copy learns about it here so planning
+        (``plan_transitions`` valid-window math) stays correct without the
+        rows ever crossing back.
+
+        ``example_rows`` leaves are ``[n_envs, ...]`` per-env example rows
+        used to allocate storage on the first call; the host *data* is NOT
+        written (the device ring owns the newest copy — see
+        ``DeviceRingTransitions.sync_host``).
+        """
+        if steps <= 0:
+            return
+        with self._write_lock or nullcontext():
+            if self._buf is None:
+                self._allocate({k: np.asarray(v)[None] for k, v in example_rows.items()})
+            if self._pos + steps >= self._buffer_size:
+                self._full = True
+            self._pos = (self._pos + steps) % self._buffer_size
+
     # -- sampling ---------------------------------------------------------
 
     def _valid_time_indices(self, sample_next_obs: bool) -> np.ndarray:

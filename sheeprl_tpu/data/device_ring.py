@@ -48,7 +48,36 @@ import numpy as np
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, ReplayBuffer, _as_np
 from sheeprl_tpu.obs.counters import staged_device_put
 
-__all__ = ["DeviceRingReplay", "DeviceRingTransitions"]
+__all__ = ["DeviceRingReplay", "DeviceRingTransitions", "scatter_append"]
+
+
+def scatter_append(bufs: Dict[str, Any], pos: Any, rows: Dict[str, Any], capacity: int) -> Dict[str, Any]:
+    """In-jit ring append: write ``rows`` (leaves ``[T, n_envs, ...]``) at
+    time slots ``(pos + t) % capacity`` of ``bufs`` (leaves ``[capacity,
+    n_envs, ...]``) and return the updated buffers.
+
+    This is the write half of the jitted-scan collection path
+    (:mod:`sheeprl_tpu.envs.rollout.engine`): traceable, so an entire
+    collection burst — act, env step, ring add — stays inside one XLA
+    program with zero host involvement. ``pos`` may be a traced int32
+    scalar; ``capacity`` must be static. ``T`` (static, from the row
+    shapes) must not exceed ``capacity``: a longer burst would land
+    duplicate slot indices in one scatter, whose winner XLA leaves
+    undefined (the host ``add`` keeps only the trailing window in that
+    case — split the burst instead).
+    """
+    import jax.numpy as jnp
+
+    first = next(iter(rows.values()))
+    t = int(first.shape[0])
+    if t > capacity:
+        raise ValueError(
+            f"scatter_append burst of {t} rows exceeds the ring capacity "
+            f"{capacity}; split the burst (duplicate slots in one scatter "
+            "are undefined)"
+        )
+    t_idx = (pos + jnp.arange(t, dtype=jnp.int32)) % capacity
+    return {k: v.at[t_idx].set(rows[k]) for k, v in bufs.items()}
 
 
 def _round_up(n: int, multiple: int) -> int:
@@ -702,6 +731,10 @@ class DeviceRingTransitions:
         self._scatter_fns: Dict[int, Any] = {}
         self._gather_fns: Dict[Tuple[int, int, bool], Any] = {}
         self._write_lock: Optional[Any] = None
+        # True while the DEVICE shard holds rows the host buffer never saw
+        # (jitted-scan collection writes via scatter_append/adopt_jit_state);
+        # host reads (checkpoint state_dict) sync first
+        self._host_stale = False
         # wrapping a buffer that already holds data (e.g. restored from a
         # checkpoint before the ring was constructed): mirror it now instead
         # of depending on wrap-then-load call order
@@ -760,6 +793,7 @@ class DeviceRingTransitions:
         self._write_lock = lock
 
     def state_dict(self) -> Dict[str, Any]:
+        self.sync_host()
         return self._rb.state_dict()
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
@@ -767,6 +801,78 @@ class DeviceRingTransitions:
         device shards as one contiguous block upload per key per shard."""
         self._rb.load_state_dict(state)
         self._remirror_from_host()
+
+    # -- in-jit write path (jitted-scan collection, envs/rollout) -----------
+
+    #: the in-jit append the rollout engine composes into its lax.scan
+    scatter_append = staticmethod(scatter_append)
+
+    def jit_state(self, example_rows: Optional[Dict[str, np.ndarray]] = None) -> Tuple[Dict[str, Any], Any]:
+        """Hand the ring's device storage to an in-jit writer.
+
+        Returns ``(bufs, pos)``: the device arrays (leaves ``[capacity,
+        n_envs, ...]``) and the int32 write head. The writer appends with
+        :func:`scatter_append` (typically inside a ``lax.scan``, donating
+        ``bufs``) and gives the result back via :meth:`adopt_jit_state`.
+        ``example_rows`` (leaves ``[n_envs, ...]``) allocates storage on the
+        first call of an empty ring. Single-shard rings only: an in-jit
+        writer owns exactly one device's storage.
+        """
+        if len(self._groups) != 1:
+            raise ValueError(
+                "jit_state requires a single-shard ring: the jitted-scan "
+                "collection path owns one device's storage (env-sharded "
+                "multi-device collection is not supported yet)"
+            )
+        with self._write_lock or nullcontext():
+            self._flush()
+            if self._shards is None:
+                if example_rows is None:
+                    raise ValueError(
+                        "jit_state on an empty ring needs example_rows to "
+                        "allocate storage"
+                    )
+                self._allocate({k: np.asarray(v) for k, v in example_rows.items()})
+        import jax.numpy as jnp
+
+        return self._shards[0], jnp.int32(self._rb._pos)
+
+    def adopt_jit_state(self, bufs: Dict[str, Any], steps: int, example_rows: Dict[str, np.ndarray]) -> None:
+        """Take back ring storage an in-jit writer advanced by ``steps`` time
+        rows: the device arrays become the ring's storage and the host
+        buffer's ring counters advance (``ReplayBuffer.advance_external``)
+        so index planning stays correct — the rows themselves stay on
+        device until a host read forces :meth:`sync_host`."""
+        if len(self._groups) != 1:
+            raise ValueError("adopt_jit_state requires a single-shard ring")
+        with self._write_lock or nullcontext():
+            self._shards = [bufs]
+            self._rb.advance_external(example_rows, int(steps))
+            self._host_stale = True
+
+    def sync_host(self) -> None:
+        """Download the device ring into the host buffer (one device_get per
+        key) if in-jit writes left it stale. Called before any host read of
+        the buffer data — checkpoint ``state_dict`` does it automatically.
+        Only the valid window (``capacity`` if full, else ``_pos`` rows,
+        padded to a power of two to bound slice-program compiles like
+        ``_pad_rows``) crosses the link — an early checkpoint of a large
+        HBM ring must not download gigabytes of unwritten zeros."""
+        if not self._host_stale:
+            return
+        import jax
+
+        with self._write_lock or nullcontext():
+            if self._shards is not None and self._rb.buffer is not None:
+                n_rows = self._capacity if self._rb.full else int(self._rb._pos)
+                n_get = min(self._capacity, _pad_rows(n_rows)) if n_rows else 0
+                if n_get:
+                    rows = jax.device_get(
+                        {k: v[:n_get] for k, v in self._shards[0].items()}
+                    )
+                    for k, v in rows.items():
+                        self._rb.buffer[k][:n_get] = v
+            self._host_stale = False
 
     def _remirror_from_host(self) -> None:
         """Rebuild the device shards from whatever the host buffer holds —
@@ -776,6 +882,7 @@ class DeviceRingTransitions:
 
         self._shards = None
         self._staged.clear()
+        self._host_stale = False
         if self._rb.buffer is None:
             return
         n_rows = self._capacity if self._rb.full else int(self._rb._pos)

@@ -1,0 +1,130 @@
+"""Burst acting for Python envs (rollout tier b).
+
+The per-step acting path pays one policy dispatch per env step:
+``policy_fn(...)`` → ``np.asarray(actions)`` → ``envs.step(...)`` — and on
+a remote-attached accelerator each dispatch is a network round trip.
+:class:`BurstActor` compiles K acting steps into ONE dispatched program: a
+``lax.scan`` whose body runs the policy on device and hands the actions to
+the host through an ordered :func:`jax.experimental.io_callback`. The host
+callback is the *whole* old loop body — ``envs.step`` (against the PR-5
+shared-memory obs slabs), episode bookkeeping, the replay-buffer ``add`` —
+and returns the prepared next observation for the following in-scan act.
+
+So the data still crosses the link every step (the envs are Python), but
+the per-step *dispatch* — trace-cache lookup, program launch, host sync on
+the action fetch — is paid once per burst: ``K = env.act_burst`` acts per
+dispatch. With ``K = 1`` this is the old per-step path, same key discipline
+and bitwise the same trajectories (asserted in
+``tests/test_envs/test_rollout.py``); larger K trades train/log/checkpoint
+*cadence granularity* (gates run per burst, not per step) for dispatch
+amortization — see ``howto/rollout_engine.md``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.obs.counters import add_rollout_burst
+
+__all__ = ["BurstActor"]
+
+
+class BurstActor:
+    """Dispatch K acting steps as one jitted program.
+
+    ``act_fn(params, obs, key) -> (callback_args, key)`` is the traced
+    policy body — ``callback_args`` a tuple of arrays handed to the host.
+    ``host_step(*np_args) -> next_obs`` is the Python loop body: it steps
+    the vector env, does every piece of host bookkeeping (buffer add,
+    episode logging, info stashing), and returns the prepared obs pytree
+    for the next act. ``obs_example`` fixes the obs spec (shapes/dtypes the
+    callback must return exactly).
+    """
+
+    def __init__(
+        self,
+        act_fn: Callable[[Any, Any, Any], Tuple[Tuple[Any, ...], Any]],
+        host_step: Callable[..., Any],
+        obs_example: Any,
+    ):
+        import jax
+
+        self._act_fn = act_fn
+        self._host_step = host_step
+        self._obs_spec = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.asarray(x).shape, np.asarray(x).dtype),
+            obs_example,
+        )
+        self._rollout_fns: Dict[int, Any] = {}
+        self._device: Any = None
+
+    @staticmethod
+    def _params_device(params):
+        """The device the acting params are committed to (first by id for
+        mesh-replicated trees); CPU when nothing is committed (numpy trees)."""
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(params):
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None:
+                devices = sorted(sharding.device_set, key=lambda d: d.id)
+                if devices:
+                    return devices[0]
+        try:
+            return jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            return jax.devices()[0]
+
+    def _build(self, burst_len: int):
+        import jax
+        from jax.experimental import io_callback
+
+        act_fn = self._act_fn
+        host_step = self._host_step
+        obs_spec = self._obs_spec
+
+        def rollout(params, obs, key):
+            def body(carry, _):
+                obs, key = carry
+                cb_args, key = act_fn(params, obs, key)
+                # ordered: env steps must run in sequence, and the next act
+                # consumes exactly this step's observation
+                next_obs = io_callback(host_step, obs_spec, *cb_args, ordered=True)
+                return (next_obs, key), ()
+
+            (obs, key), _ = jax.lax.scan(body, (obs, key), None, length=burst_len)
+            return obs, key
+
+        return jax.jit(rollout)
+
+    def rollout(self, params: Any, obs: Any, key: Any, burst_len: int) -> Tuple[Any, Any]:
+        """Run ``burst_len`` acting steps with one device dispatch; returns
+        ``(next_obs, key)`` after the burst. The host sees every step via
+        ``host_step`` exactly as the per-step loop would have."""
+        import jax
+
+        burst_len = int(burst_len)
+        fn = self._rollout_fns.get(burst_len)
+        if fn is None:
+            fn = self._build(burst_len)
+            self._rollout_fns[burst_len] = fn
+        # The burst program must be SINGLE-device: this jax version's SPMD
+        # sharding propagation CHECK-aborts on io_callback programs with
+        # multi-device (mesh-replicated) inputs. Pin to wherever the acting
+        # params already live — the CPU host mirror when player_on_host is
+        # on, the accelerator otherwise (algo.player_on_host=False keeps
+        # its meaning) — so the put is a no-op except for mesh-replicated
+        # params, which collapse to their first device's local shard.
+        if self._device is None:
+            self._device = self._params_device(params)
+        params, obs, key = jax.device_put((params, obs, key), self._device)
+        obs, key = fn(params, obs, key)
+        # FENCE: dispatch is async — the caller is about to read host state
+        # the callbacks mutate (replay buffer, episode stats). The returned
+        # obs is data-dependent on the LAST ordered callback, so readiness
+        # here proves every host_step of the burst has run.
+        jax.block_until_ready(obs)
+        add_rollout_burst(act_dispatches=1)
+        return obs, key

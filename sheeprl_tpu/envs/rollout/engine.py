@@ -1,0 +1,236 @@
+"""Jitted-scan collection for pure-JAX envs (rollout tier a).
+
+One :meth:`JaxRolloutEngine.collect` call runs an entire collection burst —
+policy inference, env dynamics, SAME_STEP auto-reset, and the replay-ring
+append — as ONE device program: a ``lax.scan`` over the burst length whose
+final step scatters every collected transition into the PR-3 device ring
+(:func:`sheeprl_tpu.data.device_ring.scatter_append`). Zero host
+involvement per burst: no per-step action download, no per-step obs upload,
+no per-step buffer add. This is the SEED-RL accelerator-side acting pattern
+with the env itself on the accelerator (EnvPool taken to its limit).
+
+The engine stores transitions in the flat SAC-style layout
+(``observations``/``actions``/``rewards``/``dones`` +
+``next_observations`` when ``store_next_obs``), bitwise what the host loop
+builds: ``next_observations`` is the PRE-reset obs of the step (the
+SAME_STEP ``final_obs`` contract) while the carried obs is the reset obs.
+
+Determinism: the key discipline is fixed (one action key per step for the
+whole batch — matching the host policy path — then per-env step and reset
+keys), so a jitted burst of T steps is bitwise a host loop of T single
+steps with the same key; asserted in ``tests/test_envs/test_rollout.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.data.device_ring import DeviceRingTransitions, scatter_append
+from sheeprl_tpu.envs.rollout.jax_envs import JaxVectorizableEnv
+from sheeprl_tpu.obs.counters import add_rollout_burst
+
+__all__ = ["JaxRolloutEngine"]
+
+
+def _where_done(done, val_if_done, val_else):
+    """Per-env select with broadcast over trailing dims."""
+    import jax.numpy as jnp
+
+    mask = done.reshape(done.shape + (1,) * (val_if_done.ndim - done.ndim))
+    return jnp.where(mask, val_if_done, val_else)
+
+
+class JaxRolloutEngine:
+    """Own the env batch state and the jitted collection programs.
+
+    ``policy(params, obs, key) -> actions`` acts on the whole ``[n_envs,
+    obs_dim]`` batch with one key (the same contract as the per-step policy
+    fns in the SAC/PPO entrypoints). ``ring`` is a single-shard
+    :class:`DeviceRingTransitions`; when ``None`` the burst returns the
+    stacked transition rows instead (tests / throughput probes).
+    """
+
+    def __init__(
+        self,
+        env: JaxVectorizableEnv,
+        n_envs: int,
+        key: Any,
+        policy: Optional[Callable[[Any, Any, Any], Any]] = None,
+        ring: Optional[DeviceRingTransitions] = None,
+        store_next_obs: bool = True,
+        obs_key: str = "observations",
+    ):
+        import jax
+
+        self.env = env
+        self.n_envs = int(n_envs)
+        self.ring = ring
+        self.store_next_obs = bool(store_next_obs)
+        self.obs_key = str(obs_key)
+        self._policy = policy
+        self._collect_fns: Dict[Tuple[int, bool, bool], Any] = {}
+        obs_space = env.observation_space["state"]
+        self._obs_dim = int(np.prod(obs_space.shape))
+        self._act_len = int(np.prod(env.action_space.shape)) if env.action_space.shape else 1
+        self._reset_all = jax.jit(
+            lambda k: jax.vmap(env.reset)(jax.random.split(k, self.n_envs))
+        )
+        self._carry = None
+        self._key = key
+        # fixed row shapes: built once, reused by every jit_state/adopt pair
+        self._example_rows = self._build_example_rows()
+
+    # -- surface the entrypoints build agents from --------------------------
+
+    @property
+    def single_observation_space(self):
+        return self.env.observation_space
+
+    @property
+    def single_action_space(self):
+        return self.env.action_space
+
+    def example_rows(self) -> Dict[str, np.ndarray]:
+        """Zero-valued ``[n_envs, ...]`` per-env rows in the stored layout —
+        what the ring allocates its device storage from."""
+        return self._example_rows
+
+    def _build_example_rows(self) -> Dict[str, np.ndarray]:
+        rows = {
+            "observations": np.zeros((self.n_envs, self._obs_dim), np.float32),
+            "actions": np.zeros((self.n_envs, self._act_len), np.float32),
+            "rewards": np.zeros((self.n_envs, 1), np.float32),
+            "dones": np.zeros((self.n_envs, 1), np.float32),
+        }
+        if self.store_next_obs:
+            rows["next_observations"] = rows["observations"].copy()
+        return rows
+
+    def reset(self) -> None:
+        """(Re)initialize the env batch: per-env reset keys derived from the
+        engine key, episode accumulators zeroed."""
+        import jax
+        import jax.numpy as jnp
+
+        self._key, sub = jax.random.split(self._key)
+        state, obs = self._reset_all(sub)
+        self._carry = (
+            state,
+            obs.reshape(self.n_envs, self._obs_dim).astype(jnp.float32),
+            self._key,
+            jnp.zeros(self.n_envs, jnp.float32),  # episode return
+            jnp.zeros(self.n_envs, jnp.int32),  # episode length
+        )
+
+    # -- the jitted burst ----------------------------------------------------
+
+    def _build_collect(self, burst_len: int, random_actions: bool, with_ring: bool):
+        import jax
+        import jax.numpy as jnp
+
+        env = self.env
+        n = self.n_envs
+        policy = self._policy
+        store_next = self.store_next_obs
+        obs_dim, act_len = self._obs_dim, self._act_len
+        capacity = int(self.ring.buffer_size) if with_ring else 0
+
+        def body(params, carry, _):
+            state, obs, key, ep_ret, ep_len = carry
+            key, akey = jax.random.split(key)
+            if random_actions:
+                actions = jax.vmap(env.sample_action)(jax.random.split(akey, n))
+            else:
+                actions = policy(params, obs, akey)
+            key, skey, rkey = jax.random.split(key, 3)
+            state2, nobs, rew, term, trunc = jax.vmap(env.step)(
+                state, actions, jax.random.split(skey, n)
+            )
+            nobs = nobs.reshape(n, obs_dim).astype(jnp.float32)
+            done = jnp.logical_or(term, trunc)
+            row = {
+                "observations": obs,
+                "actions": actions.reshape(n, act_len).astype(jnp.float32),
+                "rewards": rew.reshape(n, 1).astype(jnp.float32),
+                "dones": done.reshape(n, 1).astype(jnp.float32),
+            }
+            if store_next:
+                # PRE-reset obs: the SAME_STEP final_obs contract
+                row["next_observations"] = nobs
+            # auto-reset: done envs restart; live envs keep their state
+            reset_state, reset_obs = jax.vmap(env.reset)(jax.random.split(rkey, n))
+            state3 = jax.tree_util.tree_map(
+                lambda r, s: _where_done(done, r, s), reset_state, state2
+            )
+            obs_next = _where_done(done, reset_obs.reshape(n, obs_dim), nobs)
+            ep_ret = ep_ret + rew.reshape(n)
+            ep_len = ep_len + 1
+            stats = (rew.reshape(n), done.reshape(n), ep_ret, ep_len)
+            ep_ret = jnp.where(done.reshape(n), 0.0, ep_ret)
+            ep_len = jnp.where(done.reshape(n), 0, ep_len)
+            return (state3, obs_next, key, ep_ret, ep_len), (row, stats)
+
+        if with_ring:
+
+            def collect(params, carry, bufs, pos):
+                import functools
+
+                carry, (rows, stats) = jax.lax.scan(
+                    functools.partial(body, params), carry, None, length=burst_len
+                )
+                bufs = scatter_append(bufs, pos, rows, capacity)
+                pos = (pos + burst_len) % capacity
+                return carry, bufs, pos, stats
+
+            return jax.jit(collect, donate_argnums=(2,))
+
+        def collect_rows(params, carry):
+            import functools
+
+            carry, (rows, stats) = jax.lax.scan(
+                functools.partial(body, params), carry, None, length=burst_len
+            )
+            return carry, rows, stats
+
+        return jax.jit(collect_rows)
+
+    def collect(self, params: Any, burst_len: int, random_actions: bool = False):
+        """Run one jitted collection burst of ``burst_len`` steps.
+
+        With a ring: transitions land in the device ring (the host buffer's
+        counters advance via ``adopt_jit_state``) and the per-step
+        ``(rewards, dones, ep_returns, ep_lengths)`` device arrays — each
+        ``[burst_len, n_envs]`` — are returned for episode logging. Without
+        a ring: returns ``(rows, stats)`` with the stacked transition rows.
+        ``params`` is a jit argument, so a refreshed actor never recompiles
+        (pass ``0`` on random bursts).
+        """
+        if self._carry is None:
+            self.reset()
+        if not random_actions and self._policy is None:
+            raise ValueError(
+                "JaxRolloutEngine was built without a policy; pass "
+                "random_actions=True or construct it with policy=..."
+            )
+        burst_len = int(burst_len)
+        with_ring = self.ring is not None
+        fn_key = (burst_len, bool(random_actions), with_ring)
+        fn = self._collect_fns.get(fn_key)
+        if fn is None:
+            fn = self._build_collect(burst_len, bool(random_actions), with_ring)
+            self._collect_fns[fn_key] = fn
+        if random_actions:
+            params = 0  # unused traced placeholder: keeps one jit signature
+        if not with_ring:
+            carry, rows, stats = fn(params, self._carry)
+            self._carry = carry
+            add_rollout_burst(act_dispatches=1, jax_steps=burst_len * self.n_envs)
+            return rows, stats
+        bufs, pos = self.ring.jit_state(self.example_rows())
+        carry, bufs, pos, stats = fn(params, self._carry, bufs, pos)
+        self._carry = carry
+        self.ring.adopt_jit_state(bufs, burst_len, self.example_rows())
+        add_rollout_burst(act_dispatches=1, jax_steps=burst_len * self.n_envs)
+        return stats

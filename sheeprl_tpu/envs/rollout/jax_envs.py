@@ -1,0 +1,288 @@
+"""Pure-JAX environments: dynamics as jit-traceable step functions.
+
+The gymnax/brax contract — an env is a pair of pure functions over an
+explicit state pytree::
+
+    state, obs                                  = env.reset(key)
+    state, obs, reward, terminated, truncated   = env.step(state, action, key)
+
+Both are single-env; the rollout engine vmaps them over the env batch and
+scans them under jit, so an entire collection burst (act → step → ring add)
+is one device program. Time limits live inside the state (an ``elapsed``
+counter) so truncation — and therefore SAME_STEP-style auto-reset — is
+traceable too.
+
+Two native envs ship with the framework, bitwise ports of the gymnasium
+classic-control dynamics (asserted against gymnasium in
+``tests/test_envs/test_rollout.py``):
+
+- :class:`JaxCartPole` — ``CartPole-v1``: discrete actions, the benchmark
+  headline env (reference ``benchmark.py`` protocol).
+- :class:`JaxPendulum` — ``Pendulum-v1``: continuous actions, the SAC-family
+  recipe env.
+
+:class:`BraxEnvAdapter` wraps any Brax env into the same contract when brax
+is importable (the container does not bake it in; the adapter raises a
+pointed error otherwise instead of failing at import time).
+
+Observations are exposed as a single ``"state"`` vector (a Dict space with
+one MLP key), matching how the vector-obs algos (SAC, PPO-mlp) consume the
+gymnasium envs through the wrapper pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "BraxEnvAdapter",
+    "JaxCartPole",
+    "JaxPendulum",
+    "jax_env_ids",
+    "make_jax_env",
+]
+
+
+class JaxVectorizableEnv:
+    """Base contract: single-env pure functions + gym spaces for agent setup."""
+
+    #: single-env observation space, a Dict with one "state" MLP key so the
+    #: vector-obs algos see the same structure the gym wrapper pipeline builds
+    observation_space: gym.spaces.Dict
+    #: single-env action space
+    action_space: gym.Space
+    #: episode step limit baked into the state's `elapsed` counter
+    max_episode_steps: int
+
+    def reset(self, key: jax.Array) -> Tuple[Any, jax.Array]:
+        raise NotImplementedError
+
+    def step(
+        self, state: Any, action: jax.Array, key: jax.Array
+    ) -> Tuple[Any, jax.Array, jax.Array, jax.Array, jax.Array]:
+        raise NotImplementedError
+
+    def sample_action(self, key: jax.Array) -> jax.Array:
+        """Uniform action draw (the in-jit analog of ``action_space.sample()``
+        for prefill phases)."""
+        raise NotImplementedError
+
+
+class JaxCartPole(JaxVectorizableEnv):
+    """``CartPole-v1`` dynamics as pure jax (gymnasium classic_control port).
+
+    Euler integration at tau=0.02; termination at |x| > 2.4 or |theta| >
+    ~12deg; reward 1.0 every step (including the terminal one); truncation at
+    500 steps; reset state uniform in (-0.05, 0.05)^4.
+    """
+
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    TOTAL_MASS = MASSCART + MASSPOLE
+    LENGTH = 0.5  # half-pole length
+    POLEMASS_LENGTH = MASSPOLE * LENGTH
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_THRESHOLD = 12 * 2 * np.pi / 360
+    X_THRESHOLD = 2.4
+
+    def __init__(self, max_episode_steps: int = 500):
+        self.max_episode_steps = int(max_episode_steps)
+        high = np.array(
+            [self.X_THRESHOLD * 2, np.inf, self.THETA_THRESHOLD * 2, np.inf],
+            dtype=np.float32,
+        )
+        self.observation_space = gym.spaces.Dict(
+            {"state": gym.spaces.Box(-high, high, (4,), np.float32)}
+        )
+        self.action_space = gym.spaces.Discrete(2)
+
+    def reset(self, key: jax.Array):
+        phys = jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+        state = {"phys": phys, "elapsed": jnp.int32(0)}
+        return state, phys
+
+    def step(self, state, action, key):
+        x, x_dot, theta, theta_dot = (state["phys"][i] for i in range(4))
+        force = jnp.where(action.reshape(()) == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+        temp = (force + self.POLEMASS_LENGTH * theta_dot**2 * sintheta) / self.TOTAL_MASS
+        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.LENGTH * (4.0 / 3.0 - self.MASSPOLE * costheta**2 / self.TOTAL_MASS)
+        )
+        xacc = temp - self.POLEMASS_LENGTH * thetaacc * costheta / self.TOTAL_MASS
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * xacc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * thetaacc
+        phys = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
+        elapsed = state["elapsed"] + 1
+        terminated = (
+            (x < -self.X_THRESHOLD)
+            | (x > self.X_THRESHOLD)
+            | (theta < -self.THETA_THRESHOLD)
+            | (theta > self.THETA_THRESHOLD)
+        )
+        truncated = elapsed >= self.max_episode_steps
+        reward = jnp.float32(1.0)
+        return {"phys": phys, "elapsed": elapsed}, phys, reward, terminated, truncated
+
+    def sample_action(self, key: jax.Array) -> jax.Array:
+        return jax.random.randint(key, (), 0, 2, jnp.int32)
+
+
+class JaxPendulum(JaxVectorizableEnv):
+    """``Pendulum-v1`` dynamics as pure jax (gymnasium classic_control port).
+
+    Continuous torque in [-2, 2]; never terminates; truncation at 200 steps;
+    obs = [cos(theta), sin(theta), theta_dot]; reset theta uniform in
+    [-pi, pi], theta_dot uniform in [-1, 1].
+    """
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+
+    def __init__(self, max_episode_steps: int = 200):
+        self.max_episode_steps = int(max_episode_steps)
+        high = np.array([1.0, 1.0, self.MAX_SPEED], dtype=np.float32)
+        self.observation_space = gym.spaces.Dict(
+            {"state": gym.spaces.Box(-high, high, (3,), np.float32)}
+        )
+        self.action_space = gym.spaces.Box(
+            -self.MAX_TORQUE, self.MAX_TORQUE, (1,), np.float32
+        )
+
+    @staticmethod
+    def _obs(th, thdot):
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot]).astype(jnp.float32)
+
+    def reset(self, key: jax.Array):
+        hi = jnp.array([np.pi, 1.0], jnp.float32)
+        th, thdot = jax.random.uniform(key, (2,), jnp.float32, -hi, hi)
+        state = {"th": th, "thdot": thdot, "elapsed": jnp.int32(0)}
+        return state, self._obs(th, thdot)
+
+    def step(self, state, action, key):
+        th, thdot = state["th"], state["thdot"]
+        u = jnp.clip(action.reshape(()), -self.MAX_TORQUE, self.MAX_TORQUE)
+        norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        newthdot = thdot + (
+            3.0 * self.G / (2.0 * self.L) * jnp.sin(th)
+            + 3.0 / (self.M * self.L**2) * u
+        ) * self.DT
+        newthdot = jnp.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
+        newth = th + newthdot * self.DT
+        elapsed = state["elapsed"] + 1
+        truncated = elapsed >= self.max_episode_steps
+        new_state = {"th": newth, "thdot": newthdot, "elapsed": elapsed}
+        return (
+            new_state,
+            self._obs(newth, newthdot),
+            -cost.astype(jnp.float32),
+            jnp.bool_(False),
+            truncated,
+        )
+
+    def sample_action(self, key: jax.Array) -> jax.Array:
+        return jax.random.uniform(
+            key, (1,), jnp.float32, -self.MAX_TORQUE, self.MAX_TORQUE
+        )
+
+
+class BraxEnvAdapter(JaxVectorizableEnv):
+    """Adapt a Brax env (``brax.envs.get_environment``) to the contract.
+
+    Brax episodes carry no intrinsic time limit — the adapter adds the same
+    ``elapsed`` counter the native envs use. Gated on brax being importable:
+    the pinned container does not ship it, so construction (not import) is
+    the point of failure, with a message naming the extra dependency.
+    """
+
+    def __init__(self, env_name: str, max_episode_steps: int = 1000, **brax_kwargs):
+        try:
+            from brax import envs as brax_envs
+        except ImportError as exc:  # pragma: no cover - container has no brax
+            raise ImportError(
+                f"env.backend=jax with id 'brax/{env_name}' needs the optional "
+                "brax package, which this container does not bake in; use a "
+                f"native pure-JAX env ({sorted(_NATIVE)}) or install brax"
+            ) from exc
+        self._env = brax_envs.get_environment(env_name, **brax_kwargs)
+        self.max_episode_steps = int(max_episode_steps)
+        obs_size = int(self._env.observation_size)
+        act_size = int(self._env.action_size)
+        self.observation_space = gym.spaces.Dict(
+            {"state": gym.spaces.Box(-np.inf, np.inf, (obs_size,), np.float32)}
+        )
+        self.action_space = gym.spaces.Box(-1.0, 1.0, (act_size,), np.float32)
+
+    def reset(self, key: jax.Array):
+        brax_state = self._env.reset(key)
+        state = {"brax": brax_state, "elapsed": jnp.int32(0)}
+        return state, brax_state.obs.astype(jnp.float32)
+
+    def step(self, state, action, key):
+        del key  # brax dynamics are deterministic given the state
+        brax_state = self._env.step(state["brax"], action)
+        elapsed = state["elapsed"] + 1
+        terminated = brax_state.done.astype(bool).reshape(())
+        truncated = elapsed >= self.max_episode_steps
+        new_state = {"brax": brax_state, "elapsed": elapsed}
+        return (
+            new_state,
+            brax_state.obs.astype(jnp.float32),
+            brax_state.reward.astype(jnp.float32).reshape(()),
+            terminated,
+            truncated,
+        )
+
+    def sample_action(self, key: jax.Array) -> jax.Array:
+        return jax.random.uniform(
+            key, self.action_space.shape, jnp.float32, -1.0, 1.0
+        )
+
+
+_NATIVE: Dict[str, Callable[..., JaxVectorizableEnv]] = {
+    "CartPole-v1": JaxCartPole,
+    "Pendulum-v1": JaxPendulum,
+}
+
+
+def jax_env_ids() -> Tuple[str, ...]:
+    """Ids the pure-JAX backend can serve natively (brax ids are
+    ``brax/<name>`` and resolve dynamically)."""
+    return tuple(sorted(_NATIVE))
+
+
+def make_jax_env(
+    env_id: str, max_episode_steps: Optional[int] = None
+) -> JaxVectorizableEnv:
+    """Resolve ``env.id`` to a pure-JAX env for ``env.backend=jax``.
+
+    Native ids map to the built-in dynamics; ``brax/<name>`` goes through
+    :class:`BraxEnvAdapter`. Anything else fails with the supported list —
+    the python backend is the fallback for every other env.
+    """
+    kwargs = {} if max_episode_steps is None else {"max_episode_steps": int(max_episode_steps)}
+    if env_id in _NATIVE:
+        return _NATIVE[env_id](**kwargs)
+    if env_id.startswith("brax/"):
+        return BraxEnvAdapter(env_id.split("/", 1)[1], **kwargs)
+    raise ValueError(
+        f"env.backend=jax cannot serve env.id={env_id!r}: pure-JAX dynamics "
+        f"exist for {sorted(_NATIVE)} (and 'brax/<name>' with brax "
+        "installed); drop env.backend=jax to run it through the Python "
+        "vector-env plane"
+    )

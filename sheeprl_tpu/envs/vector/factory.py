@@ -47,11 +47,32 @@ __all__ = [
     "env_seeds",
     "make_eval_env",
     "make_vector_env",
+    "resolve_backend",
     "resolve_vectorization",
     "vectorize_thunks",
 ]
 
 _BACKENDS = ("sync", "async", "gym_async")
+_ENV_BACKENDS = ("python", "jax")
+
+
+def resolve_backend(cfg) -> str:
+    """``env.backend``: which execution plane serves the training envs.
+
+    ``python`` (default) is the vector-env plane below — gymnasium envs
+    stepped by this factory's sync/async backends. ``jax`` is the pure-JAX
+    rollout engine (:mod:`sheeprl_tpu.envs.rollout`): env dynamics are jax
+    step functions and whole collection bursts run inside one jitted
+    ``lax.scan``, writing straight into the device ring. Entrypoints that
+    support the jax tier branch on this BEFORE calling
+    :func:`make_vector_env`; for the rest, ``make_vector_env`` fails with a
+    pointed error rather than silently serving a Python env.
+    """
+    backend = cfg.env.get("backend", "python") or "python"
+    backend = str(backend).lower()
+    if backend not in _ENV_BACKENDS:
+        raise ValueError(f"env.backend must be one of {_ENV_BACKENDS}, got {backend!r}")
+    return backend
 
 
 def env_seeds(seed: int, rank: int, n_envs: int) -> List[int]:
@@ -173,6 +194,13 @@ def make_vector_env(
     to the envs on global rank zero, preserving the video/logging gate the
     entrypoints used to spell out inline.
     """
+    if resolve_backend(cfg) == "jax":
+        raise ValueError(
+            "env.backend=jax requested, but this algorithm's train loop only "
+            "supports the Python vector-env plane (the pure-JAX rollout "
+            "engine currently integrates with: sac). Drop env.backend=jax, "
+            "or use a supported entrypoint (sheeprl_tpu/envs/rollout)."
+        )
     rank = int(fabric.global_rank) if fabric is not None else 0
     if n_envs is None:
         world_size = int(fabric.world_size) if fabric is not None else 1

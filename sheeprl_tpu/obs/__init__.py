@@ -28,6 +28,7 @@ defaults to off; disabled, the instrumented code paths reduce to the plain
 from sheeprl_tpu.obs.counters import (
     Counters,
     DevicePoller,
+    add_act_dispatches,
     add_ckpt_blocked_ms,
     add_ckpt_write,
     add_env_async_steps,
@@ -36,6 +37,7 @@ from sheeprl_tpu.obs.counters import (
     add_h2d_bytes,
     add_prefetch,
     add_ring_gather,
+    add_rollout_burst,
     count_h2d,
     device_memory_stats,
     staged_device_put,
@@ -81,6 +83,7 @@ __all__ = [
     "StreamingHist",
     "Telemetry",
     "TraceWriter",
+    "add_act_dispatches",
     "add_ckpt_blocked_ms",
     "add_ckpt_write",
     "add_env_async_steps",
@@ -89,6 +92,7 @@ __all__ = [
     "add_h2d_bytes",
     "add_prefetch",
     "add_ring_gather",
+    "add_rollout_burst",
     "count_h2d",
     "cost_flops",
     "cost_flops_of",

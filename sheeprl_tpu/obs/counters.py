@@ -33,6 +33,7 @@ import numpy as np
 
 __all__ = [
     "Counters",
+    "add_act_dispatches",
     "add_ckpt_blocked_ms",
     "add_ckpt_write",
     "add_env_async_steps",
@@ -41,6 +42,7 @@ __all__ = [
     "add_h2d_bytes",
     "add_prefetch",
     "add_ring_gather",
+    "add_rollout_burst",
     "device_memory_stats",
     "DevicePoller",
     "install",
@@ -97,6 +99,15 @@ class Counters:
         self.env_steps_async = 0
         self.env_worker_restarts = 0
         self.env_degraded_to_sync = 0
+        # rollout engine (envs/rollout): `rollout_bursts` counts collection
+        # bursts (one device dispatch each), `act_dispatches` counts policy
+        # inference dispatches — per-step acting pays one per env step,
+        # burst acting one per K steps, the jitted-scan jax backend one per
+        # whole burst — and `env_steps_jax` counts env steps taken entirely
+        # inside jit (pure-JAX envs, zero host involvement)
+        self.rollout_bursts = 0
+        self.act_dispatches = 0
+        self.env_steps_jax = 0
 
     def add(self, field: str, amount) -> None:
         with self._lock:
@@ -124,6 +135,9 @@ class Counters:
                 "env_steps_async": self.env_steps_async,
                 "env_worker_restarts": self.env_worker_restarts,
                 "env_degraded_to_sync": self.env_degraded_to_sync,
+                "rollout_bursts": self.rollout_bursts,
+                "act_dispatches": self.act_dispatches,
+                "env_steps_jax": self.env_steps_jax,
             }
 
 
@@ -250,6 +264,30 @@ def add_env_degraded(n: int = 1) -> None:
     if c is not None:
         with c._lock:
             c.env_degraded_to_sync += int(n)
+
+
+# -- rollout engine accounting ------------------------------------------------
+
+
+def add_rollout_burst(act_dispatches: int = 1, jax_steps: int = 0) -> None:
+    """Record one collection burst: ``act_dispatches`` policy inference
+    dispatches were paid for it (1 for a jitted burst, K for a per-step
+    loop of K acts) and ``jax_steps`` env steps ran entirely inside jit."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.rollout_bursts += 1
+            c.act_dispatches += int(act_dispatches)
+            c.env_steps_jax += int(jax_steps)
+
+
+def add_act_dispatches(n: int = 1) -> None:
+    """Record ``n`` standalone policy inference dispatches (per-step acting
+    paths not yet routed through a rollout burst)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.act_dispatches += int(n)
 
 
 # -- checkpoint accounting --------------------------------------------------

@@ -1,0 +1,378 @@
+"""On-device rollout engine tests (``sheeprl_tpu/envs/rollout``).
+
+- the native pure-JAX env dynamics are **bitwise ports** of the gymnasium
+  classic-control envs (stepped side by side from the same physical state);
+- jitted-scan collection (tier a) is seeded-bitwise the sync host loop:
+  same keys → same actions/obs/rewards, and the device-ring contents match
+  a host-side replay of the same burst;
+- the in-jit ``scatter_append`` wraps the ring correctly at the capacity
+  edge, matching what per-row host adds would have produced;
+- burst acting (tier b) with K>1 is bitwise K=1 at the BurstActor level
+  (same trajectories into the same replay buffer) and at the SAC
+  entrypoint level (identical checkpointed buffer shards);
+- one SAC end-to-end CPU run with ``env.backend=jax`` lands the rollout
+  telemetry counters (``rollout_bursts``/``act_dispatches``/
+  ``env_steps_jax``) in telemetry.json.
+"""
+
+import glob
+import json
+import os
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_ring import DeviceRingTransitions, scatter_append
+from sheeprl_tpu.envs.rollout import (
+    BurstActor,
+    JaxCartPole,
+    JaxPendulum,
+    JaxRolloutEngine,
+    make_jax_env,
+)
+
+
+# -- native env parity with gymnasium -----------------------------------------
+
+
+def test_jax_cartpole_matches_gymnasium():
+    """Step the pure-JAX CartPole and gymnasium's from the same physical
+    state with the same action sequence: identical obs/reward/termination."""
+    env = JaxCartPole()
+    genv = gym.make("CartPole-v1")
+    state, obs = env.reset(jax.random.PRNGKey(3))
+    genv.reset(seed=0)
+    genv.unwrapped.state = np.asarray(obs, np.float64)
+    terminated = False
+    for t in range(200):
+        a = t % 2
+        state, obs, rew, term, trunc = env.step(state, jnp.int32(a), jax.random.PRNGKey(t))
+        gobs, grew, gterm, gtrunc, _ = genv.step(a)
+        np.testing.assert_allclose(np.asarray(obs), gobs, atol=1e-5)
+        assert float(rew) == float(grew) == 1.0
+        assert bool(term) == bool(gterm)
+        if term or trunc:
+            terminated = True
+            break
+    assert terminated, "the alternating-action episode must terminate"
+
+
+def test_jax_pendulum_matches_gymnasium():
+    env = JaxPendulum()
+    genv = gym.make("Pendulum-v1")
+    state, _ = env.reset(jax.random.PRNGKey(1))
+    genv.reset(seed=0)
+    genv.unwrapped.state = np.array([float(state["th"]), float(state["thdot"])])
+    for t in range(50):
+        a = np.array([0.7 * np.sin(t)], np.float32)
+        state, obs, rew, term, trunc = env.step(state, jnp.asarray(a), jax.random.PRNGKey(t))
+        gobs, grew, gterm, gtrunc, _ = genv.step(a)
+        np.testing.assert_allclose(np.asarray(obs), gobs, atol=1e-4)
+        np.testing.assert_allclose(float(rew), float(grew), atol=1e-4)
+        assert not bool(term)
+
+
+def test_make_jax_env_unknown_id_points_at_python_backend():
+    with pytest.raises(ValueError, match="env.backend=jax"):
+        make_jax_env("ALE/MsPacman-v5")
+
+
+# -- scatter_append ------------------------------------------------------------
+
+
+def test_scatter_append_wraparound():
+    """A burst crossing the capacity edge lands rows at ``(pos + t) % cap``
+    — bitwise what per-row host adds at the same positions produce."""
+    cap, n_envs, t = 8, 3, 6
+    bufs = {"x": jnp.zeros((cap, n_envs, 2), jnp.float32)}
+    rows = {"x": jnp.arange(t * n_envs * 2, dtype=jnp.float32).reshape(t, n_envs, 2)}
+    pos = 5  # 5,6,7,0,1,2 — wraps
+    out = jax.jit(lambda b, p, r: scatter_append(b, p, r, cap))(bufs, jnp.int32(pos), rows)
+    expect = np.zeros((cap, n_envs, 2), np.float32)
+    for i in range(t):
+        expect[(pos + i) % cap] = np.asarray(rows["x"])[i]
+    np.testing.assert_array_equal(np.asarray(out["x"]), expect)
+
+
+def test_scatter_append_rejects_overlong_burst():
+    bufs = {"x": jnp.zeros((4, 1), jnp.float32)}
+    rows = {"x": jnp.zeros((5, 1), jnp.float32)}
+    with pytest.raises(ValueError, match="exceeds the ring capacity"):
+        scatter_append(bufs, jnp.int32(0), rows, 4)
+
+
+def test_ring_adopt_and_sync_host_roundtrip():
+    """In-jit writes adopted by the ring advance the host counters without a
+    host copy; sync_host (forced by state_dict) downloads the real rows."""
+    cap, n_envs = 10, 2
+    rb = ReplayBuffer(cap, n_envs, memmap=False, obs_keys=("observations",))
+    ring = DeviceRingTransitions(rb)
+    eng = JaxRolloutEngine(JaxCartPole(), n_envs, jax.random.PRNGKey(0), ring=ring)
+    eng.collect(0, 7, random_actions=True)
+    assert rb._pos == 7 and not rb.full
+    eng.collect(0, 7, random_actions=True)  # wraps: 14 rows into 10
+    assert rb._pos == 4 and rb.full
+    # the ring can sample before any host copy exists
+    batch = ring.sample_device(4)
+    assert batch["observations"].shape == (1, 4, 4)
+    # state_dict forces the host download; rows must match the device ring
+    state = ring.state_dict()
+    assert state["pos"] == 4 and state["full"]
+    dev = jax.device_get(ring._buf)
+    np.testing.assert_array_equal(
+        np.asarray(rb.buffer["observations"]), dev["observations"]
+    )
+    assert np.abs(np.asarray(rb.buffer["observations"])).sum() > 0
+
+
+# -- jitted-scan collection vs the sync host loop ------------------------------
+
+
+def _host_reference_burst(env, n_envs, seed, burst_len):
+    """The engine's burst unrolled as a per-step host loop with the exact
+    same key discipline — the bitwise reference for the lax.scan path."""
+    key, sub = jax.random.split(jax.random.PRNGKey(seed))
+    state, obs = jax.vmap(env.reset)(jax.random.split(sub, n_envs))
+    obs = np.asarray(obs, np.float32).reshape(n_envs, -1)
+    rows = []
+    for _ in range(burst_len):
+        key, akey = jax.random.split(key)
+        actions = jax.vmap(env.sample_action)(jax.random.split(akey, n_envs))
+        key, skey, rkey = jax.random.split(key, 3)
+        state2, nobs, rew, term, trunc = jax.vmap(env.step)(
+            state, actions, jax.random.split(skey, n_envs)
+        )
+        nobs = np.asarray(nobs, np.float32).reshape(n_envs, -1)
+        done = np.asarray(jnp.logical_or(term, trunc))
+        rows.append(
+            {
+                "observations": obs.copy(),
+                "actions": np.asarray(actions, np.float32).reshape(n_envs, -1),
+                "rewards": np.asarray(rew, np.float32).reshape(n_envs, 1),
+                "dones": done.astype(np.float32).reshape(n_envs, 1),
+                "next_observations": nobs.copy(),
+            }
+        )
+        reset_state, reset_obs = jax.vmap(env.reset)(jax.random.split(rkey, n_envs))
+        state = jax.tree_util.tree_map(
+            lambda r, s: jnp.where(
+                jnp.asarray(done).reshape((n_envs,) + (1,) * (r.ndim - 1)), r, s
+            ),
+            reset_state,
+            state2,
+        )
+        obs = np.where(done[:, None], np.asarray(reset_obs).reshape(n_envs, -1), nobs)
+        obs = obs.astype(np.float32)
+    return rows
+
+
+def _engine_rows(burst_split, n_envs=4, total=50, cap=64, seed=123):
+    env = JaxCartPole()
+    rb = ReplayBuffer(cap, n_envs, memmap=False, obs_keys=("observations",))
+    ring = DeviceRingTransitions(rb)
+    eng = JaxRolloutEngine(env, n_envs, jax.random.PRNGKey(seed), ring=ring)
+    left = total
+    while left:
+        n = min(burst_split, left)
+        eng.collect(0, n, random_actions=True)
+        left -= n
+    ring.sync_host()
+    return {k: np.asarray(v) for k, v in rb.buffer.items()}
+
+
+def test_jitted_scan_collection_bitwise_vs_sync_step_loop():
+    """Seeded bitwise parity: ONE jitted 50-step burst leaves exactly the
+    ring contents (obs/actions/rewards/dones/next-obs) of 50 per-step
+    dispatches — the sync loop the burst replaces. Same key discipline per
+    step, so splitting the burst must not change a single bit."""
+    whole = _engine_rows(burst_split=50)
+    stepwise = _engine_rows(burst_split=1)
+    assert whole.keys() == stepwise.keys()
+    for k in whole:
+        np.testing.assert_array_equal(whole[k], stepwise[k], err_msg=k)
+
+
+def test_jitted_scan_collection_semantics_vs_host_reference():
+    """The burst semantics match a hand-unrolled host loop: same actions and
+    terminations bitwise (integer/boolean), dynamics within float tolerance
+    (separately compiled programs may fuse float ops differently), and the
+    auto-reset path is exercised (CartPole episodes end inside the burst)."""
+    n_envs, burst, seed = 4, 50, 123
+    got = _engine_rows(burst_split=burst, n_envs=n_envs, total=burst, seed=seed)
+    ref_rows = _host_reference_burst(JaxCartPole(), n_envs, seed, burst)
+    assert any(r["dones"].any() for r in ref_rows), "burst must cross an episode end"
+    for t, ref in enumerate(ref_rows):
+        np.testing.assert_array_equal(got["actions"][t], ref["actions"], err_msg=f"step {t}")
+        np.testing.assert_array_equal(got["dones"][t], ref["dones"], err_msg=f"step {t}")
+        np.testing.assert_array_equal(got["rewards"][t], ref["rewards"], err_msg=f"step {t}")
+        for k in ("observations", "next_observations"):
+            np.testing.assert_allclose(
+                got[k][t], ref[k], atol=1e-6, err_msg=f"step {t} key {k}"
+            )
+
+
+# -- burst acting (tier b) -----------------------------------------------------
+
+
+def _pendulum_vec(n_envs, seed):
+    from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+    venv = SyncVectorEnv(
+        [lambda: gym.make("Pendulum-v1") for _ in range(n_envs)],
+        autoreset_mode=AutoresetMode.SAME_STEP,
+    )
+    obs = venv.reset(seed=seed)[0].astype(np.float32)
+    return venv, obs
+
+
+def _collect_with_burst(k, steps, n_envs=2, seed=11):
+    """Drive a fixed stochastic policy through BurstActor with burst size
+    ``k``; returns the replay rows + final obs."""
+    venv, obs = _pendulum_vec(n_envs, seed)
+    rb = ReplayBuffer(steps, n_envs, memmap=False, obs_keys=("observations",))
+    box = {"obs": obs}
+
+    def act_fn(params, a_obs, key):
+        key, sub = jax.random.split(key)
+        noise = jax.random.normal(sub, (n_envs, 1), jnp.float32)
+        actions = jnp.tanh(a_obs[:, :1] * params + noise) * 2.0
+        return (actions,), key
+
+    def host_step(actions):
+        actions = np.asarray(actions)
+        next_o, rew, term, trunc, _ = venv.step(actions)
+        rb.add(
+            {
+                "observations": box["obs"][None],
+                "actions": actions.astype(np.float32)[None],
+                "rewards": np.asarray(rew, np.float32).reshape(1, n_envs, 1),
+                "dones": np.logical_or(term, trunc).astype(np.float32).reshape(1, n_envs, 1),
+            }
+        )
+        box["obs"] = next_o.astype(np.float32)
+        return box["obs"]
+
+    actor = BurstActor(act_fn, host_step, obs)
+    key = jax.random.PRNGKey(seed)
+    remaining = steps
+    while remaining > 0:
+        n = min(k, remaining)
+        obs, key = actor.rollout(jnp.float32(0.5), box["obs"], key, n)
+        remaining -= n
+    venv.close()
+    return {kk: np.asarray(v) for kk, v in rb.buffer.items()}, np.asarray(obs)
+
+
+def test_burst_actor_k4_bitwise_k1():
+    """K=4 bursts produce bitwise the K=1 per-step trajectories: same env
+    steps, same rng stream, same replay rows."""
+    rows1, obs1 = _collect_with_burst(1, 12)
+    rows4, obs4 = _collect_with_burst(4, 12)
+    assert rows1.keys() == rows4.keys()
+    for k in rows1:
+        np.testing.assert_array_equal(rows1[k], rows4[k], err_msg=k)
+    np.testing.assert_array_equal(obs1, obs4)
+
+
+# -- entrypoint acceptance -----------------------------------------------------
+
+
+def _sac_args(tmp_path, run_name, extra):
+    return [
+        "exp=sac",
+        "dry_run=False",
+        "total_steps=24",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+        "per_rank_batch_size=4",
+        "algo.learning_starts=4",
+        "algo.hidden_size=8",
+        "env=gym",
+        "env.id=Pendulum-v1",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "env.num_envs=2",
+        "buffer.size=64",
+        "buffer.memmap=False",
+        "metric.log_level=0",
+        "algo.run_test=False",
+        f"root_dir={tmp_path}/logs",
+        f"run_name={run_name}",
+        *extra,
+    ]
+
+
+def _load_ckpt_arrays(tmp_path, run_name, pattern):
+    d = sorted(
+        glob.glob(f"{tmp_path}/logs/**/{run_name}/**/ckpt_*_0", recursive=True)
+    )[-1]
+    out = {}
+    for f in sorted(glob.glob(os.path.join(d, pattern))):
+        z = np.load(f)
+        for k in z.files:
+            out[(os.path.basename(f), k)] = z[k]
+    return out
+
+
+def test_sac_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """SAC entrypoint equivalence: with training switched off
+    (per_rank_gradient_steps=0) the checkpointed replay shards of an
+    act_burst=4 run are bitwise the per-step run's."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    common = [
+        "algo.per_rank_gradient_steps=0",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "buffer.checkpoint=True",
+    ]
+    cli.run(_sac_args(tmp_path, "k1", common))
+    cli.run(_sac_args(tmp_path, "k4", common + ["env.act_burst=4"]))
+    a = _load_ckpt_arrays(tmp_path, "k1", "rb_env*.npz")
+    b = _load_ckpt_arrays(tmp_path, "k4", "rb_env*.npz")
+    assert a and a.keys() == b.keys()
+    written = 24 // 2  # total_steps / n_envs rows actually collected
+    for k in a:
+        if a[k].ndim == 0 or a[k].shape[0] < written:  # pos/full scalars
+            np.testing.assert_array_equal(a[k], b[k], err_msg=str(k))
+        else:
+            # rows past the write head are np.empty garbage; compare the
+            # collected region only
+            np.testing.assert_array_equal(
+                a[k][:written], b[k][:written], err_msg=str(k)
+            )
+
+
+def test_sac_jax_backend_e2e_counters(tmp_path, monkeypatch):
+    """SAC through the pure-JAX rollout engine end-to-end on CPU: trains,
+    checkpoints, and telemetry carries the rollout counters (bursts, one
+    inference dispatch per burst, in-jit env steps)."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    tel = tmp_path / "telemetry.json"
+    cli.run(
+        _sac_args(
+            tmp_path,
+            "jaxb",
+            [
+                "env.backend=jax",
+                "env.act_burst=4",
+                "checkpoint.every=1000000",
+                "metric.telemetry.enabled=true",
+                "metric.telemetry.trace=false",
+                f"metric.telemetry.summary_path={tel}",
+            ],
+        )
+    )
+    summary = json.loads(tel.read_text())
+    assert summary["rollout_bursts"] > 0
+    assert summary["act_dispatches"] == summary["rollout_bursts"]
+    # every env step of the run (24 policy steps / 2 envs = 12 updates) ran
+    # inside jit
+    assert summary["env_steps_jax"] == 24

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Rollout-engine micro-benchmark: jitted-scan collection vs the sync loop.
+
+Measures the exact loop the on-device rollout engine replaces, apples to
+apples — same MLP policy, same CartPole dynamics, same replay-add per step:
+
+- **jax tier**: the pure-JAX CartPole stepped by
+  :class:`~sheeprl_tpu.envs.rollout.engine.JaxRolloutEngine` — act → step →
+  device-ring add inside one ``lax.scan`` under jit, one dispatch per
+  burst;
+- **sync python tier**: gymnasium ``CartPole-v1`` under ``SyncVectorEnv``
+  with one jitted policy dispatch + one host ``ReplayBuffer.add`` per step
+  — the per-step path every Python-env algo pays without burst acting.
+
+Prints ONE JSON line (the contract bench.py's subprocess stages expect):
+``value`` is the jitted-scan steps/sec, ``sync_python_sps`` the per-step
+loop's, ``sps_vs_sync`` their ratio — the ISSUE-6 acceptance asks for
+>= 10x. Runs on whatever backend jax selects (CPU in CI; the gap only
+widens on an accelerator, where each sync-loop dispatch is a host round
+trip).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_ENVS = 64
+HIDDEN = 64
+JIT_BURST = 256
+JIT_REPEATS = 4
+SYNC_STEPS = 512
+RING_CAPACITY = 4096
+
+
+def _policy_params(key):
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (4, HIDDEN), jnp.float32) * 0.1,
+        "b1": jnp.zeros((HIDDEN,), jnp.float32),
+        "w2": jax.random.normal(k2, (HIDDEN, 2), jnp.float32) * 0.1,
+    }
+
+
+def _logits(params, obs):
+    import jax.numpy as jnp
+
+    h = jnp.maximum(obs @ params["w1"] + params["b1"], 0.0)
+    return h @ params["w2"]
+
+
+def bench_jax_tier() -> dict:
+    import jax
+
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+    from sheeprl_tpu.data.device_ring import DeviceRingTransitions
+    from sheeprl_tpu.envs.rollout import JaxCartPole, JaxRolloutEngine
+
+    params = _policy_params(jax.random.PRNGKey(0))
+
+    def policy(p, obs, key):
+        return jax.random.categorical(key, _logits(p, obs))
+
+    rb = ReplayBuffer(RING_CAPACITY, N_ENVS, memmap=False, obs_keys=("observations",))
+    ring = DeviceRingTransitions(rb)
+    eng = JaxRolloutEngine(
+        JaxCartPole(), N_ENVS, jax.random.PRNGKey(1), policy=policy, ring=ring
+    )
+    eng.collect(params, JIT_BURST)  # compile + first burst (discarded)
+    jax.block_until_ready(eng._carry[1])
+    t0 = time.perf_counter()
+    for _ in range(JIT_REPEATS):
+        stats = eng.collect(params, JIT_BURST)
+    jax.block_until_ready(stats)
+    elapsed = time.perf_counter() - t0
+    steps = JIT_REPEATS * JIT_BURST * N_ENVS
+    return {
+        "sps": steps / elapsed,
+        "steps": steps,
+        "seconds": round(elapsed, 3),
+        "dispatches": JIT_REPEATS,
+    }
+
+
+def bench_sync_tier() -> dict:
+    import gymnasium as gym
+    import jax
+    import numpy as np
+    from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    params = _policy_params(jax.random.PRNGKey(0))
+
+    @jax.jit
+    def act(p, obs, key):
+        key, sub = jax.random.split(key)
+        return jax.random.categorical(sub, _logits(p, obs)), key
+
+    envs = SyncVectorEnv(
+        [lambda: gym.make("CartPole-v1") for _ in range(N_ENVS)],
+        autoreset_mode=AutoresetMode.SAME_STEP,
+    )
+    rb = ReplayBuffer(RING_CAPACITY, N_ENVS, memmap=False, obs_keys=("observations",))
+    obs = envs.reset(seed=0)[0].astype(np.float32)
+    key = jax.random.PRNGKey(1)
+    act(params, obs, key)  # compile (discarded)
+    t0 = time.perf_counter()
+    for _ in range(SYNC_STEPS):
+        actions_j, key = act(params, obs, key)
+        actions = np.asarray(actions_j)
+        next_obs, rew, term, trunc, _ = envs.step(actions)
+        next_obs = next_obs.astype(np.float32)
+        rb.add(
+            {
+                "observations": obs[None],
+                "actions": actions.astype(np.float32).reshape(1, N_ENVS, 1),
+                "rewards": np.asarray(rew, np.float32).reshape(1, N_ENVS, 1),
+                "dones": np.logical_or(term, trunc).astype(np.float32).reshape(1, N_ENVS, 1),
+                "next_observations": next_obs[None],
+            }
+        )
+        obs = next_obs
+    elapsed = time.perf_counter() - t0
+    envs.close()
+    steps = SYNC_STEPS * N_ENVS
+    return {"sps": steps / elapsed, "steps": steps, "seconds": round(elapsed, 3)}
+
+
+def main() -> None:
+    import jax
+
+    jit = bench_jax_tier()
+    sync = bench_sync_tier()
+    line = {
+        "metric": "jax_cartpole_rollout_sps",
+        "value": round(jit["sps"], 1),
+        "unit": "env_steps/s",
+        "sync_python_sps": round(sync["sps"], 1),
+        "sps_vs_sync": round(jit["sps"] / sync["sps"], 2),
+        "n_envs": N_ENVS,
+        "jit_steps": jit["steps"],
+        "jit_dispatches": jit["dispatches"],
+        "sync_steps": sync["steps"],
+        "backend": jax.default_backend(),
+        "protocol": (
+            f"pure-JAX CartPole via JaxRolloutEngine ({JIT_REPEATS} bursts x "
+            f"{JIT_BURST} steps x {N_ENVS} envs, one dispatch per burst, "
+            "device-ring add in-jit) vs gymnasium CartPole-v1 SyncVectorEnv "
+            f"({SYNC_STEPS} steps, one jitted {HIDDEN}-unit-MLP act dispatch "
+            "+ host ReplayBuffer.add per step); first burst/step of each "
+            "tier discarded as compile warm-up"
+        ),
+    }
+    print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Rollout-uniformity lint: no per-step inference dispatch in acting loops.
+
+The rollout engine (``sheeprl_tpu/envs/rollout``, howto/rollout_engine.md)
+exists so collection loops stop paying one device round trip per env step:
+burst acting scans K acts per dispatch for Python envs, and the pure-JAX
+tier runs whole bursts in one program. The per-step anti-pattern it
+replaces is mechanical and recognizable::
+
+    for ...:                                  # the collection loop
+        actions_j, ... = policy_fn(...)       # device program per step
+        actions = np.asarray(actions_j)       # blocking fetch per step
+        envs.step(actions...)                 # then the env
+
+This lint flags any loop in an ``algos/`` entrypoint that BOTH steps the
+train-time vector env (``envs.step(...)``) AND fetches an action-named
+array (``np.asarray``/``jax.device_get`` of a name matching ``action``)
+— i.e. a re-grown per-step acting loop. Converted loops route through
+``BurstActor``/``JaxRolloutEngine`` and never trip it.
+
+Not-yet-converted entrypoints are grandfathered EXPLICITLY below; the list
+is checked both ways (a file that stops tripping must be delisted), so
+converting an algo — or regressing one — is always a visible diff here.
+
+AST-based; comments/docstrings are fine. Usage: ``python
+tools/lint_rollout.py`` — non-zero exit with findings on violation. Wired
+into the CI tier-1 lane (.github/workflows/tests.yml).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
+
+#: entrypoints still on the per-step acting path (burst conversion pending:
+#: recurrent/stateful players and the decoupled player threads). Keep in
+#: sync with howto/rollout_engine.md's support matrix.
+GRANDFATHERED = {
+    "a2c/a2c.py",
+    "dreamer_v1/dreamer_v1.py",
+    "dreamer_v2/dreamer_v2.py",
+    "dreamer_v3/dreamer_v3.py",
+    "droq/droq.py",
+    "p2e_dv1/p2e_dv1_exploration.py",
+    "p2e_dv1/p2e_dv1_finetuning.py",
+    "p2e_dv2/p2e_dv2_exploration.py",
+    "p2e_dv2/p2e_dv2_finetuning.py",
+    "p2e_dv3/p2e_dv3_exploration.py",
+    "p2e_dv3/p2e_dv3_finetuning.py",
+    "ppo/ppo_decoupled.py",
+    "ppo_recurrent/ppo_recurrent.py",
+    "sac/sac_decoupled.py",
+    "sac_ae/sac_ae.py",
+}
+
+#: helper files that legitimately step envs per-step (single eval episodes)
+SKIP_BASENAMES = {"evaluate.py", "utils.py", "agent.py", "loss.py"}
+
+_ACTION_NAME = re.compile(r"action", re.IGNORECASE)
+_FETCH_FUNCS = {"asarray", "device_get"}
+
+
+def _name_of(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _is_env_step(call: ast.Call) -> bool:
+    fn = call.func
+    return (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "step"
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id == "envs"
+    )
+
+
+def _mentions_action(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and _ACTION_NAME.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and _ACTION_NAME.search(sub.attr):
+            return True
+    return False
+
+
+def _is_fetch_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _name_of(node.func) in _FETCH_FUNCS
+
+
+def _is_action_fetch(call: ast.Call) -> bool:
+    """``np.asarray(<...action...>)`` / ``jax.device_get(<...action...>)``."""
+    return bool(call.args) and _is_action_fetch_args(call)
+
+
+def _is_action_fetch_args(call: ast.Call) -> bool:
+    return _mentions_action(call.args[0])
+
+
+def _comprehension_action_fetch(node: ast.AST) -> bool:
+    """``[np.asarray(a) for a in actions_j]`` — the fetch target is named by
+    the comprehension's iterable, not the asarray argument itself."""
+    if not isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+        return False
+    iters_action = any(_mentions_action(g.iter) for g in node.generators)
+    elt_fetches = any(_is_fetch_call(sub) for sub in ast.walk(node.elt))
+    return iters_action and elt_fetches
+
+
+def _walk_same_scope(node: ast.AST):
+    """``ast.walk`` that does not descend into nested function defs: they are
+    their own scope (burst callbacks live there by design) and their bodies
+    must not be attributed to the enclosing loop. A plain ``continue`` over
+    ``ast.walk`` cannot prune a subtree, so this recurses manually."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield child
+        yield from _walk_same_scope(child)
+
+
+def lint_file(path: str) -> list:
+    tree = ast.parse(open(path).read(), filename=path)
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        steps, fetches = [], []
+        for sub in _walk_same_scope(node):
+            if isinstance(sub, ast.Call):
+                if _is_env_step(sub):
+                    steps.append(sub.lineno)
+                elif _is_action_fetch(sub):
+                    fetches.append(sub.lineno)
+            elif _comprehension_action_fetch(sub):
+                fetches.append(sub.lineno)
+        if steps and fetches:
+            findings.append(
+                (
+                    min(steps + fetches),
+                    "per-step inference dispatch in a collection loop "
+                    f"(envs.step at line {steps[0]}, action fetch at line "
+                    f"{fetches[0]}) — route acting through BurstActor / "
+                    "JaxRolloutEngine (sheeprl_tpu/envs/rollout)",
+                )
+            )
+    return findings
+
+
+def main() -> int:
+    violations = []
+    tripped = set()
+    for root, _dirs, files in os.walk(ALGOS_DIR):
+        for fname in sorted(files):
+            if not fname.endswith(".py") or fname in SKIP_BASENAMES:
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, ALGOS_DIR).replace(os.sep, "/")
+            findings = lint_file(path)
+            if findings:
+                tripped.add(rel)
+                if rel not in GRANDFATHERED:
+                    violations.extend((rel, line, msg) for line, msg in findings)
+    stale = GRANDFATHERED - tripped
+    rc = 0
+    if violations:
+        print("rollout-uniformity lint FAILED:")
+        for rel, line, msg in violations:
+            print(f"  sheeprl_tpu/algos/{rel}:{line}: {msg}")
+        rc = 1
+    if stale:
+        print(
+            "rollout-uniformity lint: stale grandfather entries (these files "
+            "no longer trip the per-step pattern — delist them so they can't "
+            f"silently regress): {sorted(stale)}"
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            f"rollout-uniformity lint OK ({len(tripped)} grandfathered "
+            "per-step acting loops pending conversion)"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
