@@ -204,7 +204,7 @@ def _repeat_line(metric, run_once, baseline, protocol, repeats=3, min_stage_s=60
         "runs": runs,
         "warmup_run": warmup,
         "spread": round((max(runs) - min(runs)) / med, 3) if len(runs) > 1 else None,
-        "vs_baseline": round(baseline / med, 3),
+        "vs_baseline": round(baseline / med, 3) if baseline else None,
         "protocol": protocol,
     }
     if truncated:
@@ -229,6 +229,10 @@ def _phase_tails(tel) -> dict:
         # pure env.step slice inside it, so rollout_p95 - env-time is the
         # dispatch/bookkeeping residue (the RTT decomposition)
         ("Time/rollout_time", "rollout"),
+        # actor–learner plane (sheeprl_tpu/plane): the learner's exposed wait
+        # for player trajectory slabs — on a healthy plane this absorbs the
+        # env time that used to serialize the train step
+        ("Time/plane_wait_time", "plane_wait"),
     ):
         p = pct.get(phase) or {}
         if p.get("p95_ms") is not None:
@@ -586,6 +590,107 @@ def _dreamer_e2e_line(family, baseline, total_steps, min_stage_s, extra=()) -> s
     )
 
 
+def _sac_plane_line() -> str:
+    # Actor–learner plane evidence (sheeprl_tpu/plane, howto/actor_learner.md):
+    # the same decoupled SAC protocol twice — thread-local baseline
+    # (plane.num_players=0, the historical decoupled topology) and the
+    # 2-player+1-learner process plane — and the line reports the plane run
+    # with its counters (plane_traj_slabs / plane_policy_version /
+    # plane_player_restarts), phase tails (train_p95 beside plane_wait_p95 /
+    # env_p95: collection off the train-step critical path), and the sps
+    # delta vs the thread baseline. Pinned to CPU devices: the plane is a
+    # host-side property (players are CPU processes by design), and 2
+    # virtual CPU devices satisfy the decoupled >=2-device contract on any
+    # host. SAC is continuous-only, so the env is Pendulum (the CartPole of
+    # Box action spaces), not CartPole itself.
+    import tempfile
+
+    steps = 4096
+    cpu_env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+        ).strip(),
+    }
+
+    def build(mode, players, tel_path):
+        return [
+            "exp=sac_decoupled",
+            "fabric.devices=2",
+            "fabric.accelerator=cpu",
+            f"plane.num_players={players}",
+            "env.id=Pendulum-v1",
+            "env.num_envs=4",
+            f"total_steps={steps}",
+            "algo.learning_starts=512",
+            "per_rank_batch_size=64",
+            f"exp_name=bench_sac_plane_{mode}",
+            "metric.telemetry.enabled=true",
+            "metric.telemetry.trace=false",
+            f"metric.telemetry.summary_path={tel_path}",
+            *_QUIET,
+        ]
+
+    thread_tel = os.path.join(tempfile.mkdtemp(prefix="bench_plane_thr_"), "telemetry.json")
+    plane_tel = os.path.join(tempfile.mkdtemp(prefix="bench_plane_2p_"), "telemetry.json")
+
+    if _remaining() < 300.0:
+        return _skip_line("sac_pendulum_plane_2p1l", 300.0)
+    try:
+        thread_s = _timed_subprocess_run(
+            build("thread", 0, thread_tel), timeout=900, env=cpu_env
+        )
+    except Exception as exc:
+        thread_s = None
+        thread_err = repr(exc)[:200]
+    line = _repeat_line(
+        "sac_pendulum_plane_2p1l",
+        lambda: _timed_subprocess_run(build("2p1l", 2, plane_tel), timeout=900, env=cpu_env),
+        # a failed baseline must not fabricate a ratio: vs_baseline stays
+        # null and thread_baseline.error below records why
+        thread_s,
+        "decoupled SAC, Pendulum-v1, 4 envs, 4096 steps, test/log/ckpt off, "
+        "2 player processes + 1 learner (plane.num_players=2) vs the "
+        "thread-local decoupled baseline (vs_baseline = thread_s / plane_s, "
+        "> 1 means the process plane wins); CPU-pinned 2-device mesh",
+        repeats=1,
+        min_stage_s=240.0,
+    )
+    try:
+        data = json.loads(line)
+        with open(plane_tel) as f:
+            tel = json.load(f)
+        data["telemetry"] = {
+            k: tel.get(k)
+            for k in (
+                "plane_traj_slabs",
+                "plane_policy_version",
+                "plane_player_restarts",
+                "env_steps_async",
+                "recompiles",
+            )
+        }
+        data["telemetry"].update(_phase_tails(tel))
+        if data.get("value"):
+            data["sps"] = round(steps / data["value"], 1)
+        if thread_s:
+            thread_info = {"value": thread_s, "sps": round(steps / thread_s, 1)}
+            try:
+                with open(thread_tel) as f:
+                    thread_info.update(_phase_tails(json.load(f)))
+            except Exception:
+                pass
+            data["thread_baseline"] = thread_info
+            if data.get("sps"):
+                data["sps_vs_thread"] = round(data["sps"] / thread_info["sps"], 3)
+        else:
+            data["thread_baseline"] = {"error": thread_err}
+        line = json.dumps(data)
+    except Exception:
+        pass  # a skipped/failed stage has no summary; keep the line as-is
+    return line
+
+
 def main() -> None:
     # print every line as soon as it exists (a later crash cannot lose it)
     # AND re-print the full matrix at the end: the driver records a truncated
@@ -605,6 +710,12 @@ def main() -> None:
     # rollout-engine tier-a evidence: jitted-scan collection sps vs the sync
     # Python loop (cheap, ~1 min; ISSUE-6 acceptance >= 10x)
     emit(_rollout_jax_line())
+    # actor–learner plane evidence: 2-player+1-learner decoupled SAC vs the
+    # thread-local decoupled baseline (plane counters + plane_wait/train
+    # phase tails as the collection-overlap decomposition). Early in the
+    # matrix: it is cheap (~3 short CPU runs) and must not be starved by the
+    # long SAC tunnel stages below.
+    emit(_sac_plane_line())
     emit(_dreamer_line("dv3", min_stage_s=180.0, extra=("bench.profile=1",)))
     # DV2/DV1 device-step lines (grad-steps/s + scan-corrected MFU vs wall
     # rate; no xplane pass — keeps each under ~3 min warm). Their e2e

@@ -1,0 +1,230 @@
+"""PPO player loop for the actor–learner plane.
+
+One function, :func:`run_player`, drives PPO collection in BOTH decoupled
+modes: as a thread inside the learner process (``plane.num_players=0``, the
+:class:`~sheeprl_tpu.plane.supervisor.LocalPlane` transport) and as a
+spawned player process on the multi-process plane (imported by dotted name
+from :mod:`sheeprl_tpu.plane.worker`). One trajectory slab = one full
+rollout of ``algo.rollout_steps`` env steps for this player's env slice,
+plus the burst-level extras the learner's GAE needs (``next_values``).
+
+Unlike SAC, the PPO player needs the *whole* agent (policy and value head):
+it stores behavior values/log-probs per step, bootstraps V(s') into rewards
+on truncation, and closes each rollout with V(s_T) — so the publication
+channel carries the full ``params`` pytree, and the frozen per-rollout
+snapshot makes those values exactly what the coupled path computes inline.
+
+Acting runs through the PR-6 :class:`~sheeprl_tpu.envs.rollout.BurstActor`
+(``env.act_burst`` acts per device dispatch) with the per-step key folded
+from the player key and the global env-step index *inside* the scanned
+body, so trajectories are burst-size-invariant.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+__all__ = ["run_player", "ppo_slab_example"]
+
+
+def ppo_slab_example(
+    rollout_steps: int,
+    n_envs: int,
+    observation_space,
+    cnn_keys: List[str],
+    mlp_keys: List[str],
+    act_width: int,
+) -> Dict[str, np.ndarray]:
+    """Example arrays fixing the PPO trajectory-slab layout: one rollout of
+    ``rollout_steps`` steps for ``n_envs`` envs, per prepared obs key, plus
+    the one-per-burst ``next_values`` row."""
+    from sheeprl_tpu.algos.ppo.utils import prepare_obs
+
+    raw = {
+        k: np.zeros((n_envs, *observation_space[k].shape), observation_space[k].dtype)
+        for k in cnn_keys + mlp_keys
+    }
+    prepared = prepare_obs(raw, cnn_keys, n_envs)
+    example = {
+        k: np.zeros((rollout_steps, *v.shape), v.dtype) for k, v in prepared.items()
+    }
+    example.update(
+        {
+            "dones": np.zeros((rollout_steps, n_envs, 1), np.float32),
+            "values": np.zeros((rollout_steps, n_envs, 1), np.float32),
+            "actions": np.zeros((rollout_steps, n_envs, act_width), np.float32),
+            "logprobs": np.zeros((rollout_steps, n_envs, 1), np.float32),
+            "rewards": np.zeros((rollout_steps, n_envs, 1), np.float32),
+            "next_values": np.zeros((1, n_envs, 1), np.float32),
+        }
+    )
+    return example
+
+
+def run_player(ctx) -> None:
+    """Collect updates ``[ctx.start_update, num_updates]`` for this player's
+    env slice, one committed slab per rollout."""
+    import jax
+
+    from sheeprl_tpu.envs.rollout import BurstActor
+    from sheeprl_tpu.envs.vector import env_seeds, make_vector_env
+    from sheeprl_tpu.obs import span
+    from sheeprl_tpu.utils.metric import SumMetric
+
+    cfg = ctx.cfg
+    n_envs = int(ctx.n_envs)
+
+    if ctx.process_mode and cfg.env.get("vectorization", None) is None and cfg.env.get(
+        "sync_env", None
+    ) is None:
+        cfg.env.vectorization = "async"
+    if ctx.restart_count:
+        # a respawned player must not replay the exact pre-crash trajectories
+        cfg.seed = int(cfg.seed) + 7919 * int(ctx.restart_count)
+
+    envs = make_vector_env(
+        cfg,
+        fabric=None,
+        log_dir=ctx.log_dir if ctx.player_idx == 0 else None,
+        n_envs=n_envs,
+        rank=ctx.env_rank,
+    )
+    try:
+        _player_body(
+            ctx, cfg, envs, env_seeds, n_envs, jax, BurstActor, span, SumMetric
+        )
+    finally:
+        ctx.close_watchdog()
+        envs.close()
+
+
+def _player_body(ctx, cfg, envs, env_seeds, n_envs, jax, BurstActor, span, SumMetric):
+    import gymnasium as gym
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.ppo.agent import build_agent, sample_actions
+    from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
+
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+    obs_keys = mlp_keys + cnn_keys
+    rollout_steps = int(cfg.algo.rollout_steps)
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (
+            envs.single_action_space.nvec.tolist()
+            if is_multidiscrete
+            else [envs.single_action_space.n]
+        )
+    )
+    agent = build_agent(cfg, actions_dim, is_continuous, cnn_keys, mlp_keys)
+
+    @jax.jit
+    def value_fn(params, obs):
+        norm = normalize_obs(obs, cnn_keys, obs_keys)
+        return agent.apply({"params": params}, norm, method=agent.get_value)
+
+    o = envs.reset(seed=env_seeds(int(cfg.seed), int(ctx.env_rank), n_envs))[0]
+    obs = prepare_obs(o, cnn_keys, n_envs)
+    player_key = jnp.asarray(ctx.player_key)
+    act_burst = ctx.act_burst
+
+    # mutable state the host callback and the rollout loop share
+    box: Dict[str, Any] = {"obs": obs, "views": None, "row": 0, "eps": [], "u": 0}
+    #: (slab row, truncated env ids, prepared final obs) per truncation — the
+    #: V(s') bootstrap is patched into the slab rewards after each burst (the
+    #: params are frozen for the whole rollout, so the values are identical
+    #: to the inline per-step computation)
+    trunc_events: List[Tuple[int, np.ndarray, Dict[str, np.ndarray]]] = []
+
+    def _host_env_step(actions, real_actions, logprobs, values):
+        real_actions = np.asarray(real_actions)
+        with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
+            next_o, rewards, terminated, truncated, infos = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+
+        views, r = box["views"], box["row"]
+        truncated_envs = np.nonzero(truncated)[0]
+        if len(truncated_envs) > 0:
+            final_obs = infos["final_obs"]
+            t_obs = {
+                k: np.stack([np.asarray(final_obs[te][k]) for te in truncated_envs])
+                for k in obs_keys
+            }
+            trunc_events.append(
+                (r, truncated_envs, prepare_obs(t_obs, cnn_keys, len(truncated_envs)))
+            )
+
+        dones = np.logical_or(terminated, truncated).astype(np.float32)
+        for k in obs_keys:
+            views[k][r] = box["obs"][k]
+        views["dones"][r] = dones.reshape(n_envs, 1)
+        views["values"][r] = np.asarray(values).reshape(n_envs, 1)
+        views["actions"][r] = np.asarray(actions, np.float32).reshape(n_envs, -1)
+        views["logprobs"][r] = np.asarray(logprobs).reshape(n_envs, 1)
+        views["rewards"][r] = np.asarray(rewards, np.float32).reshape(n_envs, 1)
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            fi = infos["final_info"]
+            if isinstance(fi, dict) and "episode" in fi:
+                mask = np.asarray(fi.get("_episode", []), dtype=bool)
+                for i in np.nonzero(mask)[0]:
+                    box["eps"].append(
+                        (float(fi["episode"]["r"][i]), float(fi["episode"]["l"][i]))
+                    )
+
+        box["obs"] = prepare_obs(next_o, cnn_keys, n_envs)
+        box["row"] = r + 1
+        box["u"] += 1
+        ctx.beat()  # a hung envs.step() must fire the stall watchdog
+        return {**box["obs"], "__u": np.uint32(box["u"])}
+
+    def _act_fn(params, carry, key):
+        # per-step key = fold_in(player_key, global env-step index) INSIDE
+        # the scan: burst-size-invariant trajectories
+        step_key = jax.random.fold_in(key, carry["__u"])
+        obs_in = {k: carry[k] for k in obs_keys}
+        norm = normalize_obs(obs_in, cnn_keys, obs_keys)
+        pre_dist, values = agent.apply({"params": params}, norm)
+        actions, real_actions, logprob = sample_actions(pre_dist, is_continuous, step_key)
+        return (actions, real_actions, logprob, values), key
+
+    burst_actor = BurstActor(
+        _act_fn, _host_env_step, {**obs, "__u": np.uint32(0)}
+    )
+
+    update = int(ctx.start_update)
+    while update <= ctx.num_updates and not ctx.stop.is_set() and not ctx.orphaned():
+        version, params = ctx.wait_policy(update)
+        token, views = ctx.acquire_slab()
+        box["views"], box["row"] = views, 0
+        box["u"] = (update - 1) * rollout_steps
+        ep_stats: List[Tuple[float, float]] = []
+        box["eps"] = ep_stats
+        trunc_events.clear()
+
+        remaining = rollout_steps
+        while remaining > 0:
+            n_act = min(act_burst, remaining)
+            with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+                burst_actor.rollout(
+                    params, {**box["obs"], "__u": np.uint32(box["u"])}, player_key, n_act
+                )
+            remaining -= n_act
+
+        # deferred truncation bootstraps + the rollout-closing V(s_T), both
+        # on the frozen snapshot
+        for row, tr_envs, t_obs in trunc_events:
+            vals = np.asarray(value_fn(params, t_obs)).reshape(-1)
+            views["rewards"][row, tr_envs, 0] = views["rewards"][row, tr_envs, 0] + vals
+        views["next_values"][0] = np.asarray(value_fn(params, box["obs"])).reshape(n_envs, 1)
+
+        ctx.emit(token, views, update, rollout_steps, version, ep_stats)
+        update += 1

@@ -1,4 +1,4 @@
-"""PPO, decoupled — player/trainer split.
+"""PPO, decoupled — actor–learner plane.
 
 Behavioral contract from the reference ``sheeprl/algos/ppo/ppo_decoupled.py``
 (main :597-644, player :33-346, trainer :349-594): one process dedicated to
@@ -6,30 +6,32 @@ environment interaction and the rest to optimization, exchanging rollout
 chunks and updated parameters once per update, with the player always acting
 with the last broadcast parameters.
 
-TPU-native design: the reference's three Gloo/NCCL process groups
-(cfg broadcast, ``scatter_object_list`` rollout chunks, flat-param broadcast,
-``Join`` for uneven chunks — :619-640) collapse into a **player thread on
-the CPU host** feeding the SPMD trainer mesh through a depth-1 queue:
+TPU-native design (``sheeprl_tpu/plane``, howto/actor_learner.md): this
+entrypoint is the **learner**. Collection runs in the player loop
+(:mod:`sheeprl_tpu.algos.ppo.player`) on the execution plane selected by
+``plane.num_players``:
 
-- the player thread steps the envs and runs the jitted policy on the current
-  parameter snapshot while the main thread runs the update program on the
-  *previous* rollout (double buffering — env interaction and TPU compute
-  overlap instead of alternating);
-- parameter "broadcast" is swapping one replicated pytree reference; rollout
-  "scatter" is one sharded ``device_put`` (even chunking by construction, so
-  no Join semantics are needed);
-- the stored behavior-policy log-probs make the one-rollout parameter
-  staleness exact for the clipped objective.
+- ``0`` (default) — one player *thread* streaming one rollout slab per
+  update over an in-memory bounded queue
+  (:class:`~sheeprl_tpu.plane.supervisor.LocalPlane`);
+- ``N > 0`` — N player *processes*, each owning its slice of the env fleet
+  through the PR-5 async vector plane, streaming fixed-layout rollout slabs
+  over shared-memory ring queues
+  (:class:`~sheeprl_tpu.plane.supervisor.ProcessPlane`), hot-reloading
+  policy versions published atomically through the PR-2 checkpoint writer.
 
-Requires ≥2 devices like the reference (registry ``decoupled=True``; the
-CLI enforces it, cli.py check_configs).
+The reference's three Gloo/NCCL process groups (cfg broadcast,
+``scatter_object_list`` rollout chunks, flat-param broadcast, ``Join`` for
+uneven chunks — :619-640) collapse into the plane's two channels: the slab
+ring (even chunking by construction — each player owns a fixed env slice)
+and the version-monotone policy publication. The stored behavior-policy
+log-probs make the protocol's bounded parameter staleness exact for the
+clipped objective. Requires ≥2 devices like the reference.
 """
 
 from __future__ import annotations
 
 import os
-import queue
-import threading
 import warnings
 from typing import Any, Dict
 
@@ -38,20 +40,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.ppo.agent import build_agent, sample_actions
+from sheeprl_tpu.algos.ppo.agent import build_agent
+from sheeprl_tpu.algos.ppo.player import ppo_slab_example, run_player
 from sheeprl_tpu.algos.ppo.ppo import build_update_fn
-from sheeprl_tpu.envs.vector import make_vector_env
-from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
+from sheeprl_tpu.algos.ppo.utils import test
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.envs.vector import make_eval_env
 from sheeprl_tpu.obs import (
-    add_act_dispatches,
     count_h2d,
     cost_flops_of,
     get_telemetry,
     log_sps_metrics,
     shape_specs,
     span,
+)
+from sheeprl_tpu.plane import (
+    SlabSpec,
+    build_plane,
+    plane_env_split,
+    version_after,
 )
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
@@ -78,8 +86,12 @@ def main(fabric, cfg: Dict[str, Any]):
         save_configs(cfg, log_dir)
 
     n_envs = int(cfg.env.num_envs) * world_size
-    envs = make_vector_env(cfg, fabric, log_dir)
-    observation_space = envs.single_observation_space
+    # the learner never steps envs — players own them (ppo/player.py). One
+    # probe env pins the wrapped spaces the whole plane agrees on.
+    probe = make_eval_env(cfg, None, prefix="train")
+    action_space = probe.action_space
+    observation_space = probe.observation_space
+    probe.close()
 
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
@@ -92,17 +104,14 @@ def main(fabric, cfg: Dict[str, Any]):
     mlp_keys = list(cfg.mlp_keys.encoder)
     obs_keys = mlp_keys + cnn_keys
 
-    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
-    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
     actions_dim = tuple(
-        envs.single_action_space.shape
+        action_space.shape
         if is_continuous
-        else (
-            envs.single_action_space.nvec.tolist()
-            if is_multidiscrete
-            else [envs.single_action_space.n]
-        )
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
     )
+    act_width = int(np.prod(action_space.shape)) if is_continuous else int(sum(actions_dim))
 
     agent = build_agent(cfg, actions_dim, is_continuous, cnn_keys, mlp_keys)
 
@@ -141,18 +150,6 @@ def main(fabric, cfg: Dict[str, Any]):
 
     rollout_steps = int(cfg.algo.rollout_steps)
 
-    @jax.jit
-    def policy_step_fn(params, obs, key):
-        norm = normalize_obs(obs, cnn_keys, obs_keys)
-        pre_dist, values = agent.apply({"params": params}, norm)
-        actions, real_actions, logprob = sample_actions(pre_dist, is_continuous, key)
-        return actions, real_actions, logprob, values
-
-    @jax.jit
-    def value_fn(params, obs):
-        norm = normalize_obs(obs, cnn_keys, obs_keys)
-        return agent.apply({"params": params}, norm, method=agent.get_value)
-
     gamma, gae_lambda = float(cfg.algo.gamma), float(cfg.algo.gae_lambda)
 
     @jax.jit
@@ -178,119 +175,58 @@ def main(fabric, cfg: Dict[str, Any]):
     warn_checkpoint_rounding(cfg, policy_steps_per_update)
 
     # ------------------------------------------------------------------
-    # the player thread (reference player(), :33-346)
+    # the actor–learner plane (sheeprl_tpu/plane, howto/actor_learner.md)
     # ------------------------------------------------------------------
 
-    # depth-1 queue = the double buffer: the player fills rollout k+1 while
-    # the trainer consumes rollout k
-    rollout_q: "queue.Queue[Any]" = queue.Queue(maxsize=1)
-    # the "param broadcast": the trainer swaps in the new snapshot, the
-    # player reads whichever is current (jax arrays are immutable, so a torn
-    # read is impossible); the snapshot lives on the CPU host so the player's
-    # per-step policy dispatch never leaves the host (utils/host.py)
-    to_host = HostParamMirror.from_cfg(params, fabric, cfg)
-    param_cell = {"params": to_host(params)}
-    stop = threading.Event()
-    player_error: Dict[str, BaseException] = {}
+    num_players, envs_per_player = plane_env_split(cfg, n_envs)
+    slab_spec = SlabSpec.from_arrays(
+        ppo_slab_example(
+            rollout_steps, envs_per_player, observation_space, cnn_keys, mlp_keys, act_width
+        )
+    )
+    scalars = {
+        "num_updates": num_updates,
+        "learning_starts": 0,  # PPO trains from the first update
+        "first_train_update": start_step,
+        "act_burst": max(int(cfg.env.get("act_burst", 1) or 1), 1),
+        "max_policy_lag": int(cfg.get("plane", {}).get("max_policy_lag", 0) or 0),
+    }
 
-    # run-health: both sides of the decoupled pair heartbeat once per unit of
-    # progress; the watchdog flags whichever wedges (hung env worker, dead
-    # device link, deadlocked queue) instead of the run going silent
+    # the "param broadcast": an atomic policy publication players hot-reload;
+    # the snapshot lives on the CPU host (utils/host.py) so player acting
+    # never leaves the host
+    to_host = HostParamMirror.from_cfg(params, fabric, cfg)
+    root_key, player_key = jax.random.split(root_key)
+    player_keys = [player_key] + [
+        jax.random.fold_in(player_key, p) for p in range(1, max(num_players, 1))
+    ]
+
     telemetry = get_telemetry()
     watchdog = telemetry.watchdog() if telemetry is not None else None
     if watchdog is not None:
-        watchdog.register("ppo-player")
-        watchdog.register("ppo-trainer")
+        watchdog.register("ppo-learner")
         watchdog.start()
 
-    def player(player_key):
-        try:
-            obs = envs.reset(seed=cfg.seed)[0]
-            next_obs = prepare_obs(obs, cnn_keys, n_envs)
-            for update in range(start_step, num_updates + 1):
-                rollout = {k: [] for k in obs_keys}
-                extras = {"dones": [], "values": [], "actions": [], "logprobs": [], "rewards": []}
-                ep_stats = []
-                snapshot = param_cell["params"]
-                with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
-                    for _ in range(rollout_steps):
-                        if watchdog is not None:
-                            watchdog.beat("ppo-player")
-                        nonlocal_key = jax.random.fold_in(player_key, len(extras["dones"]) + update * rollout_steps)
-                        actions_j, real_actions_j, logprob_j, values_j = policy_step_fn(
-                            snapshot, next_obs, nonlocal_key
-                        )
-                        add_act_dispatches(1)
-                        real_actions = np.asarray(real_actions_j)
-                        obs, rewards, terminated, truncated, info = envs.step(
-                            real_actions.reshape(envs.action_space.shape)
-                        )
-
-                        truncated_envs = np.nonzero(truncated)[0]
-                        if len(truncated_envs) > 0:
-                            final_obs = info["final_obs"]
-                            t_obs = {
-                                k: np.stack([np.asarray(final_obs[te][k]) for te in truncated_envs])
-                                for k in obs_keys
-                            }
-                            t_obs = prepare_obs(t_obs, cnn_keys, len(truncated_envs))
-                            vals = np.asarray(value_fn(snapshot, t_obs)).reshape(-1)
-                            rewards = np.asarray(rewards, dtype=np.float32)
-                            rewards[truncated_envs] += vals
-
-                        dones = np.logical_or(terminated, truncated).astype(np.float32)
-                        for k in obs_keys:
-                            rollout[k].append(np.asarray(next_obs[k]))
-                        extras["dones"].append(dones.reshape(n_envs, 1))
-                        extras["values"].append(np.asarray(values_j).reshape(n_envs, 1))
-                        extras["actions"].append(np.asarray(actions_j).reshape(n_envs, -1))
-                        extras["logprobs"].append(np.asarray(logprob_j).reshape(n_envs, 1))
-                        extras["rewards"].append(
-                            np.asarray(rewards, np.float32).reshape(n_envs, 1)
-                        )
-                        next_obs = prepare_obs(obs, cnn_keys, n_envs)
-
-                        if cfg.metric.log_level > 0 and "final_info" in info:
-                            fi = info["final_info"]
-                            if isinstance(fi, dict) and "episode" in fi:
-                                mask = np.asarray(fi.get("_episode", []), dtype=bool)
-                                for i in np.nonzero(mask)[0]:
-                                    ep_stats.append(
-                                        (float(fi["episode"]["r"][i]), float(fi["episode"]["l"][i]))
-                                    )
-
-                    next_values = np.asarray(value_fn(snapshot, next_obs))
-
-                payload = {
-                    "data": {
-                        **{k: np.stack(rollout[k]) for k in obs_keys},
-                        **{k: np.stack(v) for k, v in extras.items()},
-                    },
-                    "next_values": next_values,
-                    "ep_stats": ep_stats,
-                }
-                if watchdog is not None:
-                    # blocking on a full queue = waiting for the trainer, not
-                    # a stall of the player
-                    watchdog.pause("ppo-player")
-                rollout_q.put(payload)
-                if watchdog is not None:
-                    watchdog.resume("ppo-player")
-                if stop.is_set():
-                    break
-        except BaseException as e:  # surface crashes in the trainer loop
-            player_error["error"] = e
-            rollout_q.put(None)
-        finally:
-            if watchdog is not None:  # a finished player is not a stalled one
-                watchdog.unregister("ppo-player")
-
-    root_key, player_key = jax.random.split(root_key)
-    player_thread = threading.Thread(target=player, args=(player_key,), daemon=True, name="ppo-player")
-    player_thread.start()
+    plane = build_plane(
+        cfg,
+        spec=slab_spec,
+        entry="sheeprl_tpu.algos.ppo.player:run_player",
+        run_player=run_player,
+        scalars=scalars,
+        player_keys=player_keys,
+        algo_name=cfg.algo.name,
+        start_update=start_step,
+        n_envs=n_envs,
+        log_dir=log_dir,
+        player_log_dir=log_dir if fabric.is_global_zero else None,
+        thread_name="ppo-player",
+        initial_params=to_host(params),
+        watchdog=watchdog,
+    )
 
     # ------------------------------------------------------------------
-    # the trainer loop (reference trainer(), :349-594)
+    # the learner loop (reference trainer(), :349-594): one clipped-surrogate
+    # update program per received rollout
     # ------------------------------------------------------------------
 
     last_train = 0
@@ -311,21 +247,28 @@ def main(fabric, cfg: Dict[str, Any]):
                 lr = cfg.algo.optimizer.lr
 
             if watchdog is not None:
-                # blocking on an empty queue = waiting for the player, not a
-                # stall of the trainer
-                watchdog.pause("ppo-trainer")
-            payload = rollout_q.get()
-            if payload is None:
-                raise RuntimeError("PPO player thread crashed") from player_error.get("error")
+                # waiting on player rollouts is idleness, not a stall
+                watchdog.pause("ppo-learner")
+            with span("Time/plane_wait_time", SumMetric(sync_on_compute=False), phase="plane_wait"):
+                handles = [plane.recv(p, update) for p in range(plane.n_players)]
             if watchdog is not None:
-                watchdog.beat("ppo-trainer")
+                watchdog.beat("ppo-learner")
             policy_step += policy_steps_per_update
 
+            if plane.n_players == 1:
+                rollout = {k: v for k, v in handles[0].data.items()}
+            else:
+                # assemble the full-width rollout in player order — the env
+                # axis concatenation restores the canonical seed order
+                rollout = {
+                    k: np.concatenate([h.data[k] for h in handles], axis=1)
+                    for k in handles[0].data
+                }
+            next_values = rollout.pop("next_values")[0]
+            ep_stats = [s for h in handles for s in h.ep_stats]
+
             returns, advantages = gae_fn(
-                payload["data"]["rewards"],
-                payload["data"]["values"],
-                payload["data"]["dones"],
-                payload["next_values"],
+                rollout["rewards"], rollout["values"], rollout["dones"], next_values
             )
 
             def flat(x):
@@ -334,15 +277,17 @@ def main(fabric, cfg: Dict[str, Any]):
 
             with span("Time/stage_h2d_time", phase="stage_h2d"):
                 local_data = {
-                    **{k: flat(payload["data"][k]) for k in obs_keys},
-                    "actions": flat(payload["data"]["actions"]),
-                    "logprobs": flat(payload["data"]["logprobs"]),
-                    "values": flat(payload["data"]["values"]),
+                    **{k: flat(rollout[k]) for k in obs_keys},
+                    "actions": flat(rollout["actions"]),
+                    "logprobs": flat(rollout["logprobs"]),
+                    "values": flat(rollout["values"]),
                     "returns": flat(returns),
                     "advantages": flat(advantages),
                 }
                 local_data = jax.device_put(local_data, data_sharding)
-            count_h2d(payload["data"])
+            count_h2d(rollout)
+            for h in handles:
+                h.release()
 
             with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 root_key, update_key = jax.random.split(root_key)
@@ -365,9 +310,9 @@ def main(fabric, cfg: Dict[str, Any]):
                 telemetry.set_train_flops(flops / world_size if flops else None)
             train_step += world_size
 
-            # the new parameters become visible to the player (the reference's
-            # rank-1 → rank-0 flat-parameter broadcast, :525-529)
-            param_cell["params"] = to_host(params)
+            # the parameter broadcast (reference :525-529): an atomic policy
+            # publication players hot-reload
+            plane.publish(version_after(update, start_step), to_host(params))
 
             if cfg.metric.log_level > 0 and logger is not None:
                 logger.log_metrics({"Info/learning_rate": lr}, policy_step)
@@ -378,7 +323,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.update("Loss/policy_loss", losses[0])
                 aggregator.update("Loss/value_loss", losses[1])
                 aggregator.update("Loss/entropy_loss", losses[2])
-                for ep_rew, ep_len in payload["ep_stats"]:
+                for ep_rew, ep_len in ep_stats:
                     if "Rewards/rew_avg" in aggregator:
                         aggregator.update("Rewards/rew_avg", ep_rew)
                     if "Game/ep_len_avg" in aggregator:
@@ -429,19 +374,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 with span("Time/checkpoint_time", phase="checkpoint"):
                     fabric.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state)
                 if preemption_requested():
-                    # SIGTERM/SIGINT: the final checkpoint is saved (the CLI
-                    # drains the in-flight write) — leave the train loop cleanly
+                    # SIGTERM/SIGINT: the final checkpoint is saved; leave the
+                    # loop cleanly — plane.drain() below joins the players
                     break
     finally:
-        stop.set()
-        try:  # unblock a player waiting on the full queue
-            rollout_q.get_nowait()
-        except queue.Empty:
-            pass
-        player_thread.join(timeout=30)
+        plane.drain()
         if watchdog is not None:
             watchdog.stop()
 
-    envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
         test(agent, jax.device_get(params), fabric, cfg, log_dir)

@@ -1,0 +1,200 @@
+"""SAC player loop for the actor–learner plane.
+
+One function, :func:`run_player`, drives SAC collection in BOTH decoupled
+modes: as a thread inside the learner process (``plane.num_players=0``, the
+:class:`~sheeprl_tpu.plane.supervisor.LocalPlane` transport) and as a
+spawned player process on the multi-process plane (imported by dotted name
+from :mod:`sheeprl_tpu.plane.worker`). The loop:
+
+- owns this player's slice of the env fleet (the canonical ``env_seeds``
+  partition: player ``p`` with ``E`` envs gets seeds ``seed + p*E + i`` —
+  player 0 of a 1-player plane is bitwise the thread-local seeding);
+- acts through the PR-6 :class:`~sheeprl_tpu.envs.rollout.BurstActor` —
+  the whole acting-loop body (env step, SAME_STEP final-obs fixup, episode
+  bookkeeping, trajectory-row write) lives in the host callback, one policy
+  dispatch per ``env.act_burst`` steps. Per-step keys are
+  ``fold_in(player_key, update)`` *inside* the scanned body, so
+  trajectories are burst-size-invariant and bitwise the historical
+  per-step discipline;
+- streams each burst as one trajectory slab (``ctx.writer`` — shared-memory
+  slot in process mode, bounded queue in thread mode; either way the commit
+  backpressures when the learner falls behind);
+- hot-reloads published policy versions through ``ctx.wait_policy``: the
+  deterministic version protocol of :mod:`sheeprl_tpu.plane.protocol`,
+  loosened by ``plane.max_policy_lag``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+__all__ = ["run_player", "sac_slab_example"]
+
+
+def sac_slab_example(
+    capacity: int, n_envs: int, obs_dim: int, act_dim: int, store_next_obs: bool
+) -> Dict[str, np.ndarray]:
+    """Example arrays fixing the SAC trajectory-slab layout (one burst of up
+    to ``capacity`` steps for ``n_envs`` envs)."""
+    example = {
+        "observations": np.zeros((capacity, n_envs, obs_dim), np.float32),
+        "actions": np.zeros((capacity, n_envs, act_dim), np.float32),
+        "rewards": np.zeros((capacity, n_envs, 1), np.float32),
+        "dones": np.zeros((capacity, n_envs, 1), np.float32),
+    }
+    if store_next_obs:
+        example["next_observations"] = np.zeros((capacity, n_envs, obs_dim), np.float32)
+    return example
+
+
+def run_player(ctx) -> None:
+    """Collect updates ``[ctx.start_update, num_updates]`` for this player's
+    env slice, one committed slab per collection burst."""
+    import jax
+
+    from sheeprl_tpu.algos.sac.agent import SACActor, action_bounds, squash_sample
+    from sheeprl_tpu.algos.sac.utils import concat_obs
+    from sheeprl_tpu.envs.rollout import BurstActor
+    from sheeprl_tpu.envs.vector import env_seeds, make_vector_env
+    from sheeprl_tpu.obs import span
+    from sheeprl_tpu.plane.protocol import burst_plan
+    from sheeprl_tpu.utils.metric import SumMetric
+
+    cfg = ctx.cfg
+    n_envs = int(ctx.n_envs)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+    store_next_obs = not bool(cfg.buffer.sample_next_obs)
+
+    if ctx.process_mode and cfg.env.get("vectorization", None) is None and cfg.env.get(
+        "sync_env", None
+    ) is None:
+        # plane players default to the PR-5 shared-memory pool (bitwise
+        # parity with sync is asserted by tests/test_envs/test_vector.py)
+        cfg.env.vectorization = "async"
+    if ctx.restart_count:
+        # a respawned player must not replay the exact pre-crash trajectories:
+        # offset this incarnation's env seeds (policy keys stay per-update)
+        cfg.seed = int(cfg.seed) + 7919 * int(ctx.restart_count)
+
+    envs = make_vector_env(
+        cfg,
+        fabric=None,
+        log_dir=ctx.log_dir if ctx.player_idx == 0 else None,
+        n_envs=n_envs,
+        rank=ctx.env_rank,
+    )
+    try:
+        _player_body(
+            ctx, cfg, envs, env_seeds, n_envs, mlp_keys, store_next_obs,
+            jax, SACActor, action_bounds, squash_sample, concat_obs,
+            BurstActor, burst_plan, span, SumMetric,
+        )
+    finally:
+        ctx.close_watchdog()
+        envs.close()
+
+
+def _player_body(
+    ctx, cfg, envs, env_seeds, n_envs, mlp_keys, store_next_obs,
+    jax, SACActor, action_bounds, squash_sample, concat_obs,
+    BurstActor, burst_plan, span, SumMetric,
+):
+    import jax.numpy as jnp
+
+    action_space = envs.single_action_space
+    act_dim = int(np.prod(action_space.shape))
+    action_scale, action_bias = action_bounds(action_space)
+    scale_j, bias_j = jnp.asarray(action_scale), jnp.asarray(action_bias)
+    actor = SACActor(action_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size)
+
+    # the random prefill draws from the vector action space's own rng —
+    # seeded so thread and process players sample identical prefills
+    envs.action_space.seed(int(cfg.seed) + 1_000_003 * (int(ctx.env_rank) + 1))
+
+    # the per-player slice of the canonical seed sequence: ``reset(seed=int)``
+    # would hand every player the SAME ``seed + i`` episode seeds — pass the
+    # rank-partitioned list instead (rank 0 is bitwise the historical seeding)
+    o = envs.reset(seed=env_seeds(int(cfg.seed), int(ctx.env_rank), n_envs))[0]
+    obs = concat_obs(o, mlp_keys, n_envs)
+    player_key = jnp.asarray(ctx.player_key)
+
+    # mutable state the host callback and the burst loop share
+    box: Dict[str, Any] = {"obs": obs, "views": None, "row": 0, "eps": [], "u": 0}
+
+    def _host_env_step(actions):
+        actions = np.asarray(actions)
+        with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
+            next_o, rewards, terminated, truncated, infos = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+        dones = np.logical_or(terminated, truncated)
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            fi = infos["final_info"]
+            if isinstance(fi, dict) and "episode" in fi:
+                mask = np.asarray(fi.get("_episode", []), dtype=bool)
+                for i in np.nonzero(mask)[0]:
+                    box["eps"].append(
+                        (float(fi["episode"]["r"][i]), float(fi["episode"]["l"][i]))
+                    )
+
+        next_obs = concat_obs(next_o, mlp_keys, n_envs)
+        real_next_obs = next_obs.copy()
+        if "final_obs" in infos:
+            for idx, final_obs in enumerate(infos["final_obs"]):
+                if final_obs is not None:
+                    real_next_obs[idx] = concat_obs(final_obs, mlp_keys, 1)[0]
+
+        views, r = box["views"], box["row"]
+        views["observations"][r] = box["obs"]
+        views["actions"][r] = np.asarray(actions, np.float32).reshape(n_envs, -1)
+        views["rewards"][r] = np.asarray(rewards, np.float32).reshape(n_envs, 1)
+        views["dones"][r] = np.asarray(dones, np.float32).reshape(n_envs, 1)
+        if store_next_obs:
+            views["next_observations"][r] = real_next_obs
+        box["row"] = r + 1
+        box["obs"] = next_obs
+        box["u"] += 1
+        ctx.beat()  # a hung envs.step() must fire the stall watchdog
+        return {"obs": next_obs, "u": np.uint32(box["u"])}
+
+    def _act_fn(actor_params, carry, key):
+        # per-step key = fold_in(player_key, update) INSIDE the scan: bitwise
+        # the historical per-step discipline, for every burst size
+        step_key = jax.random.fold_in(key, carry["u"])
+        mean, std = actor.apply({"params": actor_params}, carry["obs"])
+        actions, _ = squash_sample(mean, std, step_key, scale_j, bias_j)
+        return (actions,), key
+
+    burst_actor = BurstActor(
+        _act_fn, _host_env_step, {"obs": obs, "u": np.uint32(0)}
+    )
+
+    update = int(ctx.start_update)
+    version = 0
+    while update <= ctx.num_updates and not ctx.stop.is_set() and not ctx.orphaned():
+        n_act, random_phase = burst_plan(
+            update, ctx.act_burst, ctx.learning_starts, ctx.num_updates
+        )
+        params = None
+        if not random_phase:
+            version, params = ctx.wait_policy(update)
+        token, views = ctx.acquire_slab()
+        box["views"], box["row"], box["u"] = views, 0, update
+        ep_stats: List[Tuple[float, float]] = []
+        box["eps"] = ep_stats
+        if random_phase:
+            for _ in range(n_act):
+                _host_env_step(envs.action_space.sample())
+        else:
+            with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+                burst_actor.rollout(
+                    params,
+                    {"obs": box["obs"], "u": np.uint32(update)},
+                    player_key,
+                    n_act,
+                )
+        ctx.emit(token, views, update, n_act, version, ep_stats)
+        update += n_act

@@ -1,23 +1,34 @@
-"""SAC, decoupled — player/trainer split.
+"""SAC, decoupled — actor–learner plane.
 
 Behavioral contract from the reference ``sheeprl/algos/sac/sac_decoupled.py``
-(main :32-60, player :63-270, trainer :273-548): a dedicated environment
-process keeps the replay buffer and ships one sampled batch per policy step
-to the trainers, which return updated parameters.
+(main :32-60, player :63-270, trainer :273-548): dedicated environment
+players keep feeding a replay buffer while trainers run one train round per
+policy step and broadcast updated parameters back.
 
-TPU-native design (see ``ppo/ppo_decoupled.py`` for the pattern): the player
-is a CPU-host thread stepping the envs and appending to the host-side numpy
-replay buffer under a lock; the trainer loop paces itself to the reference's
-one-train-round-per-policy-step cadence through a step-counter condition
-variable, samples directly from the shared buffer, runs the fused SPMD SAC
-step, and swaps the replicated parameter pytree the player acts with.
-Requires ≥2 devices like the reference.
+TPU-native design (``sheeprl_tpu/plane``, howto/actor_learner.md): this
+entrypoint is the **learner**. Collection runs in the player loop
+(:mod:`sheeprl_tpu.algos.sac.player`) on the execution plane selected by
+``plane.num_players``:
+
+- ``0`` (default) — one player *thread* streaming trajectory bursts over an
+  in-memory bounded queue (:class:`~sheeprl_tpu.plane.supervisor.LocalPlane`);
+- ``N > 0`` — N player *processes*, each owning its slice of the env fleet
+  through the PR-5 async vector plane, streaming fixed-layout trajectory
+  slabs over shared-memory ring queues with credited-slot backpressure
+  (:class:`~sheeprl_tpu.plane.supervisor.ProcessPlane`), hot-reloading
+  policy versions published atomically through the PR-2 checkpoint writer.
+
+Both modes speak the same protocol (:mod:`sheeprl_tpu.plane.protocol`):
+the learner trains update ``u-1`` while players collect ``u``, players act
+on the version trained through ``u-2`` (plus ``plane.max_policy_lag``), so
+a seeded 1-player plane run is bitwise the thread-local run — the
+regression gate in ``tests/test_plane``. Requires ≥2 devices like the
+reference.
 """
 
 from __future__ import annotations
 
 import os
-import threading
 import warnings
 from typing import Any, Dict
 
@@ -31,27 +42,33 @@ from sheeprl_tpu.algos.sac.agent import (
     SACCritic,
     action_bounds,
     build_agent_state,
-    squash_sample,
 )
+from sheeprl_tpu.algos.sac.player import run_player, sac_slab_example
 from sheeprl_tpu.algos.sac.sac import build_train_fn
-from sheeprl_tpu.algos.sac.utils import concat_obs, test
+from sheeprl_tpu.algos.sac.utils import test
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
-from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.staging import make_replay_staging
-from sheeprl_tpu.envs.vector import make_vector_env
-from sheeprl_tpu.utils.logger import create_tensorboard_logger
-from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
-from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.envs.vector import make_eval_env
 from sheeprl_tpu.obs import (
-    add_act_dispatches,
     cost_flops_of,
     get_telemetry,
     log_sps_metrics,
     shape_specs,
     span,
 )
+from sheeprl_tpu.plane import (
+    SlabSpec,
+    build_plane,
+    burst_plan,
+    plane_env_split,
+    version_after,
+)
+from sheeprl_tpu.utils.host import HostParamMirror
+from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 
 
@@ -76,10 +93,12 @@ def main(fabric, cfg: Dict[str, Any]):
         save_configs(cfg, log_dir)
 
     n_envs = int(cfg.env.num_envs) * world_size
-    # vector backend picked by env.vectorization (envs/vector/factory.py)
-    envs = make_vector_env(cfg, fabric, log_dir)
-    action_space = envs.single_action_space
-    observation_space = envs.single_observation_space
+    # the learner never steps envs — players own them (sac/player.py). One
+    # probe env pins the wrapped spaces the whole plane agrees on.
+    probe = make_eval_env(cfg, None, prefix="train")
+    action_space = probe.action_space
+    observation_space = probe.observation_space
+    probe.close()
     if not isinstance(action_space, gym.spaces.Box):
         raise ValueError("Only continuous action space is supported for the SAC agent")
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -146,19 +165,19 @@ def main(fabric, cfg: Dict[str, Any]):
     if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
         rb.load_state_dict(state["rb"])
 
-    scale_j, bias_j = jnp.asarray(action_scale), jnp.asarray(action_bias)
-
-    @jax.jit
-    def policy_fn(actor_params, obs, key):
-        mean, std = actor.apply({"params": actor_params}, obs)
-        actions, _ = squash_sample(mean, std, key, scale_j, bias_j)
-        return actions
-
     train_fn = build_train_fn(
         actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric,
         action_scale, action_bias, target_entropy, donate=False,
     )
     batch_sharding = fabric.sharding(None, fabric.data_axis)
+    # TPU-first replay staging (data/staging.py). The learner thread is the
+    # only replay writer on the plane — player trajectories arrive as slabs
+    # and land through rb.add below — so no cross-thread buffer lock is
+    # needed anymore (the prefetch pipeline still binds its own).
+    staging = make_replay_staging(
+        cfg, fabric, rb, batch_sharding=batch_sharding, seed=cfg.seed
+    )
+    rb = staging.rb
 
     last_train = 0
     train_step = 0
@@ -175,137 +194,88 @@ def main(fabric, cfg: Dict[str, Any]):
 
     per_rank_gradient_steps = int(cfg.algo.per_rank_gradient_steps)
     ema_every = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_update + 1
+    act_burst = max(int(cfg.env.get("act_burst", 1) or 1), 1)
+    first_train_update = max(learning_starts, start_step)
 
     # ------------------------------------------------------------------
-    # the player thread (reference player(), :63-270): steps the envs with
-    # the latest broadcast params and appends to the shared host buffer
+    # the actor–learner plane (sheeprl_tpu/plane, howto/actor_learner.md)
     # ------------------------------------------------------------------
 
-    # reentrant: the staging facade binds this same lock into the buffer's
-    # add, so the player's explicit `with rb_lock` wrapper re-acquires it
-    rb_lock = threading.RLock()
-    # TPU-first replay staging (data/staging.py): device-ring gathers when
-    # buffer.device_ring=True, double-buffered host prefetch otherwise; the
-    # shared lock serializes the player's adds against background sampling
-    staging = make_replay_staging(
-        cfg, fabric, rb, batch_sharding=batch_sharding, seed=cfg.seed, lock=rb_lock
+    num_players, envs_per_player = plane_env_split(cfg, n_envs)
+    store_next_obs = not cfg.buffer.sample_next_obs
+    slab_spec = SlabSpec.from_arrays(
+        sac_slab_example(act_burst, envs_per_player, obs_dim, act_dim, store_next_obs)
     )
-    rb = staging.rb
-    step_cv = threading.Condition()
-    # collected/trained counters bound the player's lead to one step (the
-    # reference player blocks on the per-step param exchange, :291-294)
-    progress = {"collected": start_step - 1, "trained": start_step - 1}
-    actor_mirror = HostParamMirror.from_cfg(agent_state["actor"], fabric, cfg)
-    param_cell = {"actor": actor_mirror(agent_state["actor"])}
-    player_error: Dict[str, BaseException] = {}
-    stop = threading.Event()
+    scalars = {
+        "num_updates": num_updates,
+        "learning_starts": learning_starts,
+        "first_train_update": first_train_update,
+        "act_burst": act_burst,
+        "max_policy_lag": int(cfg.get("plane", {}).get("max_policy_lag", 0) or 0),
+    }
 
-    # run-health: both sides of the decoupled pair heartbeat once per unit of
-    # progress; the watchdog flags whichever wedges instead of the run going
-    # silent on a hung env worker / device link / exchange wait
+    actor_mirror = HostParamMirror.from_cfg(agent_state["actor"], fabric, cfg)
+    root_key, player_key = jax.random.split(root_key)
+    player_keys = [player_key] + [
+        jax.random.fold_in(player_key, p) for p in range(1, max(num_players, 1))
+    ]
+
     telemetry = get_telemetry()
     watchdog = telemetry.watchdog() if telemetry is not None else None
     if watchdog is not None:
-        watchdog.register("sac-player")
-        watchdog.register("sac-trainer")
+        watchdog.register("sac-learner")
         watchdog.start()
 
-    def player(player_key):
-        try:
-            o = envs.reset(seed=cfg.seed)[0]
-            obs = concat_obs(o, cfg.mlp_keys.encoder, n_envs)
-            for update in range(start_step, num_updates + 1):
-                # collect step `update` while the trainer works on `update-1`
-                # (one-step lead = the PPO sibling's depth-1 queue)
-                if watchdog is not None:
-                    # waiting for the trainer to release the next step is
-                    # idleness, not a stall of the player
-                    watchdog.pause("sac-player")
-                with step_cv:
-                    step_cv.wait_for(
-                        lambda: progress["trained"] >= update - 2 or stop.is_set()
-                    )
-                if stop.is_set():
-                    return
-                if watchdog is not None:
-                    watchdog.beat("sac-player")
-                with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
-                    if update <= learning_starts:
-                        actions = envs.action_space.sample()
-                    else:
-                        step_key = jax.random.fold_in(player_key, update)
-                        actions = np.asarray(policy_fn(param_cell["actor"], obs, step_key))
-                        add_act_dispatches(1)
-                    next_o, rewards, terminated, truncated, infos = envs.step(
-                        actions.reshape(envs.action_space.shape)
-                    )
-                    dones = np.logical_or(terminated, truncated)
-
-                ep_stats = []
-                if cfg.metric.log_level > 0 and "final_info" in infos:
-                    fi = infos["final_info"]
-                    if isinstance(fi, dict) and "episode" in fi:
-                        mask = np.asarray(fi.get("_episode", []), dtype=bool)
-                        for i in np.nonzero(mask)[0]:
-                            ep_stats.append(
-                                (float(fi["episode"]["r"][i]), float(fi["episode"]["l"][i]))
-                            )
-
-                next_obs = concat_obs(next_o, cfg.mlp_keys.encoder, n_envs)
-                real_next_obs = next_obs.copy()
-                if "final_obs" in infos:
-                    for idx, final_obs in enumerate(infos["final_obs"]):
-                        if final_obs is not None:
-                            real_next_obs[idx] = concat_obs(final_obs, cfg.mlp_keys.encoder, 1)[0]
-
-                step_data = {
-                    "observations": obs[None],
-                    "actions": np.asarray(actions, np.float32).reshape(1, n_envs, -1),
-                    "rewards": np.asarray(rewards, np.float32).reshape(1, n_envs, 1),
-                    "dones": np.asarray(dones, np.float32).reshape(1, n_envs, 1),
-                }
-                if not cfg.buffer.sample_next_obs:
-                    step_data["next_observations"] = real_next_obs[None]
-                with rb_lock:
-                    rb.add(step_data)
-                obs = next_obs
-
-                with step_cv:
-                    progress["collected"] = update
-                    progress.setdefault("ep_stats", []).extend(ep_stats)
-                    step_cv.notify_all()
-        except BaseException as e:
-            player_error["error"] = e
-            with step_cv:
-                progress["collected"] = num_updates
-                step_cv.notify_all()
-        finally:
-            if watchdog is not None:  # a finished player is not a stalled one
-                watchdog.unregister("sac-player")
-
-    root_key, player_key = jax.random.split(root_key)
-    player_thread = threading.Thread(target=player, args=(player_key,), daemon=True, name="sac-player")
-    player_thread.start()
+    plane = build_plane(
+        cfg,
+        spec=slab_spec,
+        entry="sheeprl_tpu.algos.sac.player:run_player",
+        run_player=run_player,
+        scalars=scalars,
+        player_keys=player_keys,
+        algo_name=cfg.algo.name,
+        start_update=start_step,
+        n_envs=n_envs,
+        log_dir=log_dir,
+        player_log_dir=log_dir if fabric.is_global_zero else None,
+        thread_name="sac-player",
+        initial_params=actor_mirror(agent_state["actor"]),
+        watchdog=watchdog,
+    )
 
     # ------------------------------------------------------------------
-    # the trainer loop (reference trainer(), :273-548): one train round per
+    # the learner loop (reference trainer(), :273-548): one train round per
     # policy step once learning starts
     # ------------------------------------------------------------------
 
+    update = start_step
     try:
-        for update in range(start_step, num_updates + 1):
+        while update <= num_updates:
+            n_act, _random_phase = burst_plan(update, act_burst, learning_starts, num_updates)
+            first, last = update, update + n_act - 1
+
             if watchdog is not None:
-                # waiting for the player's next collected step is idleness,
-                # not a stall of the trainer
-                watchdog.pause("sac-trainer")
-            with step_cv:
-                step_cv.wait_for(lambda: progress["collected"] >= update)
-                ep_stats = progress.pop("ep_stats", [])
-            if "error" in player_error:
-                raise RuntimeError("SAC player thread crashed") from player_error["error"]
+                # waiting on player trajectories is idleness, not a stall
+                watchdog.pause("sac-learner")
+            with span("Time/plane_wait_time", SumMetric(sync_on_compute=False), phase="plane_wait"):
+                handles = [plane.recv(p, update) for p in range(plane.n_players)]
             if watchdog is not None:
-                watchdog.beat("sac-trainer")
-            policy_step += n_envs
+                watchdog.beat("sac-learner")
+
+            if plane.n_players == 1:
+                rows = {k: v[:n_act] for k, v in handles[0].data.items()}
+            else:
+                # assemble the full-width step rows in player order — the env
+                # axis concatenation restores the canonical seed order
+                rows = {
+                    k: np.concatenate([h.data[k][:n_act] for h in handles], axis=1)
+                    for k in handles[0].data
+                }
+            rb.add(rows)  # the one copy of the slab→replay path
+            ep_stats = [s for h in handles for s in h.ep_stats]
+            for h in handles:
+                h.release()
+            policy_step += n_envs * n_act
 
             if aggregator and not aggregator.disabled:
                 for ep_rew, ep_len in ep_stats:
@@ -313,12 +283,14 @@ def main(fabric, cfg: Dict[str, Any]):
                     aggregator.update("Game/ep_len_avg", ep_len)
                     fabric.print(f"Rank-0: policy_step={policy_step}, reward={ep_rew}")
 
-            if update >= learning_starts:
-                training_steps = learning_starts if update == learning_starts else 1
+            if last >= learning_starts and per_rank_gradient_steps > 0:
+                # one gradient burst covering every update index this burst
+                # collected (the reference per-step cadence for K=1),
+                # including the learning-starts catch-up
+                training_steps = last - max(first, learning_starts) + 1
+                if first <= learning_starts <= last:
+                    training_steps += learning_starts - 1
                 g_total = max(training_steps, 1) * per_rank_gradient_steps
-                # [G, B*world, ...] device arrays: ring-gathered from HBM,
-                # or host-sampled + device_put overlapped with the previous
-                # burst (sampling serializes on rb_lock against player adds)
                 batch = staging.sample_device(
                     world_size * cfg.per_rank_batch_size,
                     n_samples=g_total,
@@ -327,27 +299,40 @@ def main(fabric, cfg: Dict[str, Any]):
 
                 with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                     root_key, train_key = jax.random.split(root_key)
-                    do_ema = jnp.bool_(update % ema_every == 0)
+                    do_ema = jnp.bool_(
+                        any(u % ema_every == 0 for u in range(first, last + 1))
+                    )
                     train_args = (agent_state, opt_states, batch, train_key, do_ema)
                     agent_state, opt_states, losses = train_fn(*train_args)
                     losses = fetch_losses_if_observed(losses, aggregator)
                 if telemetry is not None and telemetry.needs_train_flops():
                     # donation is off in decoupled mode; one AOT cost
-                    # analysis, registered per train-step UNIT (the counter
-                    # advances by world_size per dispatched program)
+                    # analysis, registered per train-step UNIT
                     flops = cost_flops_of(train_fn, *shape_specs(train_args))
                     telemetry.set_train_flops(flops / world_size if flops else None)
                 train_step += world_size
-                # parameter broadcast to the player (reference :525-529)
-                param_cell["actor"] = actor_mirror(agent_state["actor"])
+                # the parameter broadcast (reference :525-529): an atomic
+                # policy publication players hot-reload
+                plane.publish(
+                    version_after(last, first_train_update),
+                    actor_mirror(agent_state["actor"]),
+                )
 
                 if aggregator and not aggregator.disabled:
                     aggregator.update("Loss/value_loss", losses[0])
                     aggregator.update("Loss/policy_loss", losses[1])
                     aggregator.update("Loss/alpha_loss", losses[2])
+            elif last >= learning_starts:
+                # per_rank_gradient_steps=0 skips training (sac.py contract),
+                # but the version protocol must stay live or players would
+                # wait forever for versions no train step will ever produce
+                plane.publish(
+                    version_after(last, first_train_update),
+                    actor_mirror(agent_state["actor"]),
+                )
 
             if cfg.metric.log_level > 0 and (
-                policy_step - last_log >= cfg.metric.log_every or update == num_updates
+                policy_step - last_log >= cfg.metric.log_every or last == num_updates
             ):
                 if aggregator and not aggregator.disabled:
                     metrics_dict = aggregator.compute()
@@ -366,19 +351,18 @@ def main(fabric, cfg: Dict[str, Any]):
                 last_log = policy_step
                 last_train = train_step
 
-            if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
+            if should_checkpoint(cfg, policy_step, last_checkpoint, last, num_updates):
                 last_checkpoint = policy_step
                 ckpt_state = {
                     "agent": jax.device_get(agent_state),
                     "opt_states": jax.device_get(opt_states),
-                    "update": update * world_size,
+                    "update": last * world_size,
                     "batch_size": cfg.per_rank_batch_size * world_size,
                     "last_log": last_log,
                     "last_checkpoint": last_checkpoint,
                 }
                 ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{fabric.global_rank}")
-                with rb_lock, span("Time/checkpoint_time", phase="checkpoint"):
-                    # the player must not write mid-snapshot
+                with span("Time/checkpoint_time", phase="checkpoint"):
                     fabric.call(
                         "on_checkpoint_player",
                         ckpt_path=ckpt_path,
@@ -386,23 +370,17 @@ def main(fabric, cfg: Dict[str, Any]):
                         replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
                     )
                 if preemption_requested():
-                    # SIGTERM/SIGINT: the final checkpoint is saved (the CLI
-                    # drains the in-flight write) — leave the train loop cleanly
+                    # SIGTERM/SIGINT: the final checkpoint is saved; leave the
+                    # loop cleanly — plane.drain() below joins the players
                     break
 
-            # release the player for the next step (bounded one-step lead)
-            with step_cv:
-                progress["trained"] = update
-                step_cv.notify_all()
+            update = last + 1
     finally:
-        stop.set()
-        with step_cv:
-            step_cv.notify_all()
-        player_thread.join(timeout=30)
+        plane.drain()
         if watchdog is not None:
             watchdog.stop()
         staging.close()
-        envs.close()
 
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():
+        scale_j, bias_j = jnp.asarray(action_scale), jnp.asarray(action_bias)
         test(actor, agent_state["actor"], scale_j, bias_j, fabric, cfg, log_dir)

@@ -203,15 +203,56 @@ def check_configs(cfg) -> None:
         )
 
     # burst acting (env.act_burst, envs/rollout) is consumed by the coupled
-    # SAC/PPO loops; elsewhere a >1 value would silently act per-step — the
-    # exact silent-ignore trap the resume-override accounting closes, so warn
-    if int(cfg.env.get("act_burst", 1) or 1) > 1 and algo_name not in ("sac", "ppo"):
+    # SAC/PPO loops and the decoupled plane players; elsewhere a >1 value
+    # would silently act per-step — the exact silent-ignore trap the
+    # resume-override accounting closes, so warn
+    if int(cfg.env.get("act_burst", 1) or 1) > 1 and algo_name not in (
+        "sac",
+        "ppo",
+        "sac_decoupled",
+        "ppo_decoupled",
+    ):
         warnings.warn(
             f"env.act_burst={cfg.env.act_burst} is only consumed by the "
-            f"coupled SAC/PPO rollout paths; '{algo_name}' acts per-step "
-            "(howto/rollout_engine.md)",
+            f"SAC/PPO rollout paths (coupled loops and plane players); "
+            f"'{algo_name}' acts per-step (howto/rollout_engine.md)",
             UserWarning,
         )
+
+    # the actor–learner plane (plane.*, sheeprl_tpu/plane) is consumed by the
+    # decoupled entrypoints only; validate its knobs here so a multi-process
+    # run can't silently degrade (mirrors the env.act_burst rule above)
+    num_players = int(cfg.get("plane", {}).get("num_players", 0) or 0)
+    if num_players > 0:
+        if not entry["decoupled"]:
+            warnings.warn(
+                f"plane.num_players={num_players} is only consumed by the "
+                f"decoupled entrypoints (sac_decoupled, ppo_decoupled); "
+                f"'{algo_name}' runs coupled and ignores it "
+                "(howto/actor_learner.md)",
+                UserWarning,
+            )
+        elif str(cfg.env.get("vectorization", "") or "").lower() == "sync" or (
+            # the legacy spelling resolves to the same sync backend when
+            # vectorization is unset (envs/vector/factory.resolve_vectorization)
+            cfg.env.get("vectorization", None) is None
+            and bool(cfg.env.get("sync_env", None))
+        ):
+            raise RuntimeError(
+                f"plane.num_players={num_players} with a sync env pool "
+                "(env.vectorization=sync, or legacy env.sync_env=true) "
+                "serializes every player's env fleet inside its own process — "
+                "the degraded pool defeats the multi-process plane. Drop the "
+                "sync override (players default to the shared-memory async "
+                "pool) or set plane.num_players=0 (howto/actor_learner.md)."
+            )
+        keep = int(cfg.get("plane", {}).get("keep_policies", 4) or 4)
+        if keep < 2:
+            raise RuntimeError(
+                f"plane.keep_policies={keep} can garbage-collect the policy "
+                "version a freshly-respawned player still needs; use >= 2 "
+                "(howto/actor_learner.md)"
+            )
 
     # mixed precision is validated for everyone but currently consumed only by
     # the DreamerV3 model family — warn instead of silently training in f32

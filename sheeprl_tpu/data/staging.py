@@ -335,6 +335,12 @@ def make_replay_staging(
             if lock is not None:
                 ring.bind_write_lock(lock)
             return RingStaging(ring)
+    # the ring paths seed the buffer's sampler at construction; the host
+    # path must too, or replay draws come from OS entropy and seeded runs
+    # are not reproducible (the plane's thread-vs-process bitwise gate
+    # depends on this)
+    if seed is not None and hasattr(rb, "seed"):
+        rb.seed(int(seed))
     return HostStaging(
         rb,
         batch_sharding,

@@ -185,6 +185,7 @@ def make_vector_env(
     prefix: str = "train",
     restart_on_exception: bool = False,
     n_envs: Optional[int] = None,
+    rank: Optional[int] = None,
 ):
     """Build the train-time vector env for one process.
 
@@ -193,6 +194,12 @@ def make_vector_env(
     count, and one process drives the whole mesh). ``log_dir`` is only handed
     to the envs on global rank zero, preserving the video/logging gate the
     entrypoints used to spell out inline.
+
+    ``rank`` overrides the seed-partition index (default
+    ``fabric.global_rank``): the actor–learner plane's player processes pass
+    their player index with per-player ``n_envs``, so N players slice the
+    same canonical ``env_seeds`` sequence one learner process would use —
+    player 0 of a 1-player plane reproduces the thread-local seeding bitwise.
     """
     if resolve_backend(cfg) == "jax":
         raise ValueError(
@@ -201,7 +208,9 @@ def make_vector_env(
             "engine currently integrates with: sac). Drop env.backend=jax, "
             "or use a supported entrypoint (sheeprl_tpu/envs/rollout)."
         )
-    rank = int(fabric.global_rank) if fabric is not None else 0
+    if rank is None:
+        rank = int(fabric.global_rank) if fabric is not None else 0
+    rank = int(rank)
     if n_envs is None:
         world_size = int(fabric.world_size) if fabric is not None else 1
         n_envs = int(cfg.env.num_envs) * world_size

@@ -35,11 +35,14 @@ from sheeprl_tpu.obs.counters import (
     add_env_degraded,
     add_env_worker_restart,
     add_h2d_bytes,
+    add_plane_player_restart,
+    add_plane_slabs,
     add_prefetch,
     add_ring_gather,
     add_rollout_burst,
     count_h2d,
     device_memory_stats,
+    note_plane_policy_version,
     staged_device_put,
     tree_nbytes,
 )
@@ -90,6 +93,8 @@ __all__ = [
     "add_env_degraded",
     "add_env_worker_restart",
     "add_h2d_bytes",
+    "add_plane_player_restart",
+    "add_plane_slabs",
     "add_prefetch",
     "add_ring_gather",
     "add_rollout_burst",
@@ -102,6 +107,7 @@ __all__ = [
     "get_tracer",
     "log_sps_metrics",
     "mfu_pct",
+    "note_plane_policy_version",
     "profiler_capture",
     "prometheus_text",
     "set_tracer",

@@ -40,9 +40,12 @@ __all__ = [
     "add_env_degraded",
     "add_env_worker_restart",
     "add_h2d_bytes",
+    "add_plane_player_restart",
+    "add_plane_slabs",
     "add_prefetch",
     "add_ring_gather",
     "add_rollout_burst",
+    "note_plane_policy_version",
     "device_memory_stats",
     "DevicePoller",
     "install",
@@ -108,6 +111,13 @@ class Counters:
         self.rollout_bursts = 0
         self.act_dispatches = 0
         self.env_steps_jax = 0
+        # actor–learner plane (sheeprl_tpu/plane): trajectory slabs received
+        # by the learner over the shared-memory queues, the newest published
+        # policy version (a gauge — max, not a sum), and player processes
+        # respawned after a crash
+        self.plane_traj_slabs = 0
+        self.plane_policy_version = 0
+        self.plane_player_restarts = 0
 
     def add(self, field: str, amount) -> None:
         with self._lock:
@@ -138,6 +148,9 @@ class Counters:
                 "rollout_bursts": self.rollout_bursts,
                 "act_dispatches": self.act_dispatches,
                 "env_steps_jax": self.env_steps_jax,
+                "plane_traj_slabs": self.plane_traj_slabs,
+                "plane_policy_version": self.plane_policy_version,
+                "plane_player_restarts": self.plane_player_restarts,
             }
 
 
@@ -288,6 +301,33 @@ def add_act_dispatches(n: int = 1) -> None:
     if c is not None:
         with c._lock:
             c.act_dispatches += int(n)
+
+
+# -- actor–learner plane accounting ------------------------------------------
+
+
+def add_plane_slabs(n: int = 1) -> None:
+    """Record ``n`` trajectory slabs received from player processes."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.plane_traj_slabs += int(n)
+
+
+def note_plane_policy_version(version: int) -> None:
+    """Record the newest published policy version (monotone gauge)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.plane_policy_version = max(c.plane_policy_version, int(version))
+
+
+def add_plane_player_restart(n: int = 1) -> None:
+    """Record ``n`` player-process respawns (crash within the restart budget)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.plane_player_restarts += int(n)
 
 
 # -- checkpoint accounting --------------------------------------------------

@@ -478,6 +478,12 @@ class Telemetry:
                 f"{s['env_worker_restarts']} worker restart(s)"
                 + (" · DEGRADED TO SYNC" if s.get("env_degraded_to_sync") else "")
             )
+        if s.get("plane_traj_slabs") or s.get("plane_player_restarts"):
+            lines.append(
+                f"  plane: {s['plane_traj_slabs']} trajectory slab(s) · "
+                f"policy v{s['plane_policy_version']} · "
+                f"{s['plane_player_restarts']} player restart(s)"
+            )
         if s["ckpt_saves"] or s["ckpt_failures"]:
             lines.append(
                 f"  ckpt {s['ckpt_saves']} saves ({fmt_bytes(s['ckpt_bytes'])}), "
@@ -490,6 +496,7 @@ class Telemetry:
             ("Time/train_time", "train"),
             ("Time/env_interaction_time", "env"),
             ("Time/stage_h2d_time", "stage"),
+            ("Time/plane_wait_time", "plane_wait"),
         ):
             pct = s.get("phase_percentiles", {}).get(name)
             if pct and pct.get("p95_ms") is not None:

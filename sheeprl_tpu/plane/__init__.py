@@ -1,0 +1,74 @@
+"""Trajectory-streaming actor–learner execution plane.
+
+The SURVEY §3.3/§5.8 player↔trainer architecture at production shape
+(SEED-RL/IMPALA topology): N **player processes**, each owning its share of
+the env fleet through the PR-5 async vector plane and acting through the
+PR-6 burst path, stream fixed-layout trajectory slabs to the learner over
+shared-memory ring queues with credited-slot backpressure; the learner
+feeds its replay/rollout pipeline from the assembled slabs and publishes
+acting parameters back through an atomic policy-snapshot channel built on
+the PR-2 checkpoint writer. The learner's train step never waits on env
+stepping; a slow learner throttles players instead of OOMing; a killed
+player respawns from the latest published policy.
+
+Pieces (``howto/actor_learner.md``):
+
+- :mod:`~sheeprl_tpu.plane.protocol` — the shared burst/version arithmetic
+  both sides derive independently (no control-flow messages);
+- :mod:`~sheeprl_tpu.plane.slabs` — shared-memory trajectory slab rings
+  with credited-slot backpressure (``plane.queue_slots``);
+- :mod:`~sheeprl_tpu.plane.publish` — atomic, checksummed, strictly-monotone
+  policy-weight publication (``policy_<ver>.tmp`` → fsync → rename) with
+  torn-write resilience; plus the in-process channel for thread mode;
+- :mod:`~sheeprl_tpu.plane.worker` — player-process bootstrap (CPU-pinned
+  jax, signal hygiene) and the transport-agnostic :class:`PlayerContext`;
+- :mod:`~sheeprl_tpu.plane.supervisor` — :class:`ProcessPlane` (spawn /
+  monitor / respawn-within-budget / drain) and :class:`LocalPlane` (the
+  same surface over a player thread, ``plane.num_players=0``).
+
+Knobs live in the ``plane`` config group; decoupled entrypoints are
+required to route through this package by ``tools/lint_plane.py``.
+"""
+
+from sheeprl_tpu.plane.local import BurstPayload, LocalBurstQueue, LocalPlayerHandle
+from sheeprl_tpu.plane.protocol import burst_plan, required_version, version_after
+from sheeprl_tpu.plane.publish import (
+    LocalPolicyChannel,
+    PolicyPoller,
+    PolicyPublisher,
+    policy_path,
+)
+from sheeprl_tpu.plane.slabs import PlaneClosed, SlabHandle, SlabSpec, TrajSlabRing
+from sheeprl_tpu.plane.supervisor import (
+    LocalPlane,
+    ProcessPlane,
+    build_plane,
+    plane_env_split,
+    resolve_plane_players,
+)
+from sheeprl_tpu.plane.worker import LocalWriter, PlayerContext, SlabWriter
+
+__all__ = [
+    "BurstPayload",
+    "LocalBurstQueue",
+    "LocalPlane",
+    "LocalPlayerHandle",
+    "LocalPolicyChannel",
+    "LocalWriter",
+    "PlaneClosed",
+    "PlayerContext",
+    "PolicyPoller",
+    "PolicyPublisher",
+    "ProcessPlane",
+    "SlabHandle",
+    "SlabSpec",
+    "SlabWriter",
+    "TrajSlabRing",
+    "build_plane",
+    "burst_plan",
+    "plane_env_split",
+    "policy_path",
+    "required_version",
+    "resolve_plane_players",
+    "version_after",
+]

@@ -1,0 +1,119 @@
+"""Thread-local transport for the decoupled mode's in-process fallback.
+
+``plane.num_players=0`` keeps the decoupled algorithms in one process — but
+they still run *on the plane*: the player is a thread driven by the same
+algo player-loop function the multi-process plane spawns, streaming the same
+committed trajectory bursts through :class:`LocalBurstQueue` (a bounded
+in-memory queue with the credited-slot semantics of
+:class:`~sheeprl_tpu.plane.slabs.TrajSlabRing`), and hot-reloading policy
+versions through
+:class:`~sheeprl_tpu.plane.publish.LocalPolicyChannel`. One protocol, two
+transports — the thread mode is the 1-player plane minus the process
+boundary, which is exactly what the bitwise regression test asserts.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.plane.slabs import PlaneClosed
+
+__all__ = ["LocalBurstQueue", "LocalPlayerHandle", "BurstPayload"]
+
+
+@dataclass
+class BurstPayload:
+    """One committed collection burst (thread transport: plain arrays shared
+    by reference — every step's arrays are freshly allocated by the player,
+    so nothing aliases)."""
+
+    data: Dict[str, np.ndarray]
+    first_update: int
+    n_valid: int
+    policy_version: int
+    ep_stats: List[Tuple[float, float]] = field(default_factory=list)
+
+    def release(self) -> None:  # symmetric with SlabHandle
+        pass
+
+
+class LocalBurstQueue:
+    """Bounded burst queue between the player thread and the learner loop.
+
+    ``maxsize`` plays the role of the slab credits: a slow learner blocks
+    the player's commit instead of letting payloads pile up.
+    """
+
+    def __init__(self, n_slots: int):
+        self._q: "_queue.Queue[BurstPayload]" = _queue.Queue(maxsize=max(int(n_slots), 1))
+
+    # player side ------------------------------------------------------------
+
+    def commit(self, payload: BurstPayload, stop=None, poll_s: float = 0.2) -> None:
+        while True:
+            try:
+                self._q.put(payload, timeout=poll_s)
+                return
+            except _queue.Full:
+                if stop is not None and stop.is_set():
+                    raise PlaneClosed("plane stopping while waiting for a burst credit")
+
+    # learner side -----------------------------------------------------------
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[BurstPayload]:
+        try:
+            return self._q.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+    def drain(self) -> None:
+        """Unblock a player stuck on a full queue during shutdown."""
+        while True:
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                return
+
+
+class LocalPlayerHandle:
+    """The plane-owned player thread (algos never touch ``threading`` —
+    ``tools/lint_plane.py`` enforces it).
+
+    ``target`` is the algo's player-loop function; a raised exception is
+    captured and re-raised in the learner by :meth:`check`.
+    """
+
+    def __init__(self, target: Callable[[], Any], name: str = "plane-player"):
+        self._error: Dict[str, BaseException] = {}
+        self.stop = threading.Event()
+
+        def _run():
+            try:
+                target()
+            except PlaneClosed:
+                pass  # clean shutdown
+            except BaseException as e:
+                self._error["error"] = e
+
+        self._thread = threading.Thread(target=_run, daemon=True, name=name)
+
+    def start(self) -> "LocalPlayerHandle":
+        self._thread.start()
+        return self
+
+    def check(self) -> None:
+        """Raise if the player thread died with an error."""
+        if "error" in self._error:
+            raise RuntimeError("decoupled player thread crashed") from self._error["error"]
+
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def join(self, timeout: float = 30.0) -> None:
+        self.stop.set()
+        self._thread.join(timeout=timeout)

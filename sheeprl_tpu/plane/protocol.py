@@ -1,0 +1,58 @@
+"""The actor–learner plane's shared arithmetic: burst segmentation and the
+deterministic policy-version protocol.
+
+Player and learner never exchange control messages about *which* updates a
+trajectory burst covers or *which* policy version acting at update ``u``
+requires — both sides derive them from the same pure functions below, from
+the same config scalars. That is what makes the 1-player plane run
+seeded-bitwise-equal to the thread-local decoupled path (the regression gate
+in ``tests/test_plane``): transport changes, arithmetic doesn't.
+
+Version numbering
+-----------------
+``version`` counts *updates trained through in this run*: version 0 is the
+initial (or resumed) parameters, published before any player starts; after
+the learner trains through update ``t`` it publishes version
+``t - first_train_update + 1`` where ``first_train_update =
+max(learning_starts, start_step)`` (the first update the learner actually
+trains — SAC starts at ``learning_starts``, PPO at ``start_step``).
+
+A player acting the burst that starts at update ``first`` needs
+:func:`required_version`\\ ``(first, first_train_update)`` — the parameters
+produced by training through update ``first - 2``. That is exactly the
+bounded one-step lead the thread-local decoupled loops enforced with a
+condition variable, made explicit: the learner can train update ``u - 1``
+while the player collects ``u``, so collection and training overlap, but the
+player can never act on parameters staler than two updates (plus
+``plane.max_policy_lag`` more when the operator trades staleness for slack).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+__all__ = ["burst_plan", "required_version", "version_after"]
+
+
+def burst_plan(
+    first: int, act_burst: int, learning_starts: int, num_updates: int
+) -> Tuple[int, bool]:
+    """``(n_act, random_phase)`` for the collection burst starting at update
+    ``first`` — the same clamp the coupled SAC loop uses: bursts never cross
+    the learning-starts boundary (so the catch-up train runs on time) nor
+    ``num_updates`` (so the run cannot overshoot ``total_steps``)."""
+    random_phase = first <= learning_starts
+    boundary = min(learning_starts, num_updates) if random_phase else num_updates
+    return max(min(int(act_burst), boundary - first + 1), 1), random_phase
+
+
+def version_after(last: int, first_train_update: int) -> int:
+    """The policy version the learner publishes after training through
+    update ``last`` (0 when nothing has been trained yet)."""
+    return max(0, int(last) - int(first_train_update) + 1)
+
+
+def required_version(first: int, first_train_update: int) -> int:
+    """The policy version acting at update ``first`` requires: the
+    parameters trained through update ``first - 2``."""
+    return version_after(first - 2, first_train_update)

@@ -1,0 +1,264 @@
+"""Atomic policy-weight publication: learner → player processes.
+
+The learner publishes acting parameters as *policy-only snapshot manifests*
+through the PR-2 checkpoint writer: every version lands as
+``policy/policy_<ver>.tmp/`` (npz shard + checksummed manifest, fsynced)
+and is renamed final only when complete — so a player polling the directory
+either sees a whole, manifest-valid version or a ``.tmp`` partial it skips.
+A learner killed mid-publish can never tear the weights a player acts with:
+torn-write resilience is inherited from ``ckpt.writer.write_checkpoint``,
+not re-implemented (asserted in ``tests/test_plane/test_publish.py``).
+
+Versions are strictly monotone (the publisher refuses to go backwards) and
+garbage-collected to ``plane.keep_policies`` finals — always keeping the
+newest, and never collecting below what a freshly-respawned player may
+still need (the protocol bounds the player/learner version gap to one burst,
+see :mod:`sheeprl_tpu.plane.protocol`).
+
+:class:`LocalPolicyChannel` is the same channel for the thread-local
+decoupled mode: an in-process version store with identical semantics
+(monotone publish, ``wait_min_version``), so the algo player loop is one
+code path across both modes — which is what makes thread mode vs 1-player
+plane mode a bitwise regression pair.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "POLICY_DIR",
+    "LocalPolicyChannel",
+    "PolicyPoller",
+    "PolicyPublisher",
+    "policy_path",
+]
+
+POLICY_DIR = "policy"
+_POLICY_RE = re.compile(r"^policy_(\d+)$")
+
+
+def policy_path(root: str, version: int) -> str:
+    return os.path.join(root, f"policy_{int(version):08d}")
+
+
+class PolicyPublisher:
+    """Learner side of the publication channel (one per run, rank 0).
+
+    ``async_publish=True`` (what :class:`~sheeprl_tpu.plane.supervisor.
+    ProcessPlane` uses) moves the npz-write + fsync + rename + GC off the
+    learner's critical path onto a single writer thread: ``publish``
+    validates monotonicity, enqueues, and returns. The queue is bounded (a
+    dead-slow disk backpressures the learner instead of growing an unbounded
+    pile of pinned pytrees) and strictly FIFO — every version lands, in
+    order, so the poller's exact-smallest-version waits (the ``max_policy_
+    lag=0`` determinism contract) see the same sequence as synchronous
+    publication. Players tolerate publication latency by design (they poll).
+    A writer-thread failure is re-raised on the next ``publish`` call.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        keep_policies: int = 4,
+        algo: Optional[str] = None,
+        async_publish: bool = False,
+    ):
+        self.root = os.path.abspath(root)
+        self.keep = max(int(keep_policies), 2)
+        self.algo = algo
+        self._last: Optional[int] = None
+        self._async = bool(async_publish)
+        self._queue: Optional[Any] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        os.makedirs(self.root, exist_ok=True)
+
+    def publish(self, version: int, params: Any) -> str:
+        """Write ``params`` as version ``version`` (host pytree); atomic via
+        the ckpt writer's tmp→fsync→rename; returns the final path (which an
+        async publication reaches shortly after this returns)."""
+        from sheeprl_tpu.obs.counters import note_plane_policy_version
+
+        version = int(version)
+        if self._last is not None and version <= self._last:
+            raise ValueError(
+                f"policy versions must be strictly monotone: got {version} after {self._last}"
+            )
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("policy publication failed on the writer thread") from err
+        if self._async:
+            if self._queue is None:
+                import queue as _queue
+
+                self._queue = _queue.Queue(maxsize=8)
+                self._thread = threading.Thread(
+                    target=self._worker, name="policy-publisher", daemon=True
+                )
+                self._thread.start()
+            self._queue.put((version, params))
+        else:
+            self._write(version, params)
+        self._last = version
+        note_plane_policy_version(version)
+        return policy_path(self.root, version)
+
+    def _write(self, version: int, params: Any) -> None:
+        from sheeprl_tpu.ckpt.writer import write_checkpoint
+
+        write_checkpoint(
+            policy_path(self.root, version),
+            {"params": params, "version": version},
+            step=version,
+            algo=self.algo,
+        )
+        self._gc()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            try:
+                self._write(*item)
+            except BaseException as exc:  # surfaced on the next publish()
+                self._error = exc
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Flush pending publications and stop the writer thread."""
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def _gc(self) -> None:
+        versions = sorted(_list_versions(self.root))
+        for v in versions[: -self.keep]:
+            shutil.rmtree(policy_path(self.root, v), ignore_errors=True)
+
+
+def _list_versions(root: str):
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return
+    for name in names:
+        m = _POLICY_RE.match(name.split(".", 1)[0])
+        if m and not name.endswith(".tmp") and not name.endswith(".old"):
+            yield int(m.group(1))
+
+
+class PolicyPoller:
+    """Player side: poll the directory, load validated versions, keep the
+    prior version on any torn/corrupt candidate."""
+
+    def __init__(self, root: str, poll_interval_s: float = 0.05):
+        self.root = os.path.abspath(root)
+        self.poll_interval_s = max(float(poll_interval_s), 0.005)
+        self._cache: Tuple[Optional[int], Any] = (None, None)
+
+    def latest_version(self) -> Optional[int]:
+        versions = sorted(_list_versions(self.root))
+        return versions[-1] if versions else None
+
+    def load(self, version: int) -> Optional[Any]:
+        """The params of ``version`` (host pytree), or None when the dir is
+        missing or fails validation — the caller keeps what it has."""
+        from sheeprl_tpu.ckpt.manifest import CheckpointCorruptedError
+        from sheeprl_tpu.ckpt.resume import read_checkpoint
+
+        cached_v, cached = self._cache
+        if cached_v == int(version):
+            return cached
+        try:
+            state = read_checkpoint(policy_path(self.root, version), verify=True)
+            params = state["params"]
+        except (CheckpointCorruptedError, FileNotFoundError, OSError, KeyError):
+            return None
+        self._cache = (int(version), params)
+        return params
+
+    def wait_min_version(
+        self, min_version: int, stop=None, use_exact: bool = True
+    ) -> Tuple[int, Any]:
+        """Block until a valid version ``>= min_version`` exists; return
+        ``(version, params)``.
+
+        ``use_exact=True`` (the deterministic default, ``max_policy_lag=0``)
+        returns the *smallest* published version satisfying the bound — the
+        same version the thread-local protocol would have used — so runs are
+        reproducible. ``use_exact=False`` returns the newest (bounded
+        staleness, maximum freshness).
+
+        Raises :class:`~sheeprl_tpu.plane.slabs.PlaneClosed` if ``stop`` is
+        set while waiting.
+        """
+        from sheeprl_tpu.plane.slabs import PlaneClosed
+
+        min_version = max(int(min_version), 0)
+        while True:
+            versions = sorted(_list_versions(self.root))
+            eligible = [v for v in versions if v >= min_version]
+            if not use_exact:
+                eligible = eligible[-1:]
+            for v in eligible:
+                params = self.load(v)
+                if params is not None:
+                    return v, params
+            if stop is not None and stop.is_set():
+                raise PlaneClosed("plane stopping while waiting for a policy version")
+            time.sleep(self.poll_interval_s)
+
+
+class LocalPolicyChannel:
+    """In-process publication channel for the thread-local decoupled mode.
+
+    Same contract as publisher+poller (monotone versions, smallest-version-
+    ``>=``-bound waits) over a dict and a condition variable; parameters are
+    shared by reference (jax arrays are immutable, a torn read is
+    impossible).
+    """
+
+    def __init__(self, keep_policies: int = 4):
+        self.keep = max(int(keep_policies), 2)
+        self._versions: Dict[int, Any] = {}
+        self._cv = threading.Condition()
+        self._last: Optional[int] = None
+
+    def publish(self, version: int, params: Any) -> None:
+        from sheeprl_tpu.obs.counters import note_plane_policy_version
+
+        version = int(version)
+        with self._cv:
+            if self._last is not None and version <= self._last:
+                raise ValueError(
+                    f"policy versions must be strictly monotone: got {version} after {self._last}"
+                )
+            self._versions[version] = params
+            self._last = version
+            for v in sorted(self._versions)[: -self.keep]:
+                del self._versions[v]
+            self._cv.notify_all()
+        note_plane_policy_version(version)
+
+    def wait_min_version(
+        self, min_version: int, stop=None, use_exact: bool = True
+    ) -> Tuple[int, Any]:
+        from sheeprl_tpu.plane.slabs import PlaneClosed
+
+        min_version = max(int(min_version), 0)
+        with self._cv:
+            while True:
+                eligible = sorted(v for v in self._versions if v >= min_version)
+                if eligible:
+                    v = eligible[0] if use_exact else eligible[-1]
+                    return v, self._versions[v]
+                if stop is not None and stop.is_set():
+                    raise PlaneClosed("plane stopping while waiting for a policy version")
+                self._cv.wait(timeout=0.2)

@@ -1,0 +1,214 @@
+"""Shared-memory trajectory slab queues with credited-slot backpressure.
+
+One :class:`TrajSlabRing` connects one player process to the learner. It is
+the PR-5 shared-memory idea applied to whole *trajectory bursts* instead of
+single env steps: every array the player would have handed to
+``ReplayBuffer.add`` lives in a fixed-layout shared block
+
+    ``[n_slots, capacity_steps, n_envs, *single_shape]``
+
+(one block per trajectory key, ``multiprocessing.RawArray`` — anonymous,
+inherited at spawn, nothing in /dev/shm to leak), so a committed slab is
+read by the learner as numpy *views* and the one copy of the whole
+player→replay path is the learner's ``ReplayBuffer.add`` indexed assignment
+— exactly the PR-5 zero-copy contract, at burst granularity.
+
+Backpressure is credited slots: the ``free`` queue starts holding every slot
+index and the player must take a credit before writing. A slow learner
+simply stops returning credits, so players throttle at
+``plane.queue_slots`` in-flight slabs each instead of growing an unbounded
+pickle queue (or OOMing the host). The ``filled`` queue carries only the
+tiny commit record (slot index, covered updates, policy version, episode
+stats) — bulk data never crosses a pipe.
+
+Slab layout per key is declared once as a :class:`SlabSpec`; both sides
+build their numpy views from it, so a layout mismatch is a construction
+error, not silent corruption.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["SlabSpec", "SlabHandle", "TrajSlabRing", "PlaneClosed"]
+
+
+class PlaneClosed(Exception):
+    """The plane is shutting down — raised out of blocking queue waits."""
+
+
+@dataclass(frozen=True)
+class SlabSpec:
+    """Fixed layout of one trajectory slab.
+
+    ``keys`` maps each trajectory key to ``(steps, n_envs, *single_shape)``
+    and a dtype — ``steps`` is the per-key step capacity (most keys share
+    the burst capacity; per-burst extras like PPO's ``next_values`` declare
+    ``steps=1``).
+    """
+
+    keys: Tuple[Tuple[str, Tuple[int, ...], str], ...]
+
+    @classmethod
+    def from_arrays(cls, example: Dict[str, np.ndarray]) -> "SlabSpec":
+        return cls(
+            tuple(
+                (k, tuple(int(s) for s in v.shape), np.dtype(v.dtype).name)
+                for k, v in example.items()
+            )
+        )
+
+    def nbytes(self) -> int:
+        return sum(
+            int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+            for _, shape, dtype in self.keys
+        )
+
+
+def _alloc(ctx, shape: Tuple[int, ...], dtype: np.dtype):
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    return ctx.RawArray("b", max(nbytes, 1))
+
+
+def _view(raw, shape: Tuple[int, ...], dtype: np.dtype) -> np.ndarray:
+    return np.frombuffer(
+        raw, dtype=dtype, count=int(np.prod(shape, dtype=np.int64))
+    ).reshape(shape)
+
+
+@dataclass
+class SlabHandle:
+    """One committed slab on the learner side: zero-copy views plus the
+    commit record. ``release()`` returns the slot credit to the player —
+    call it only after the rows have been copied out (``rb.add``)."""
+
+    data: Dict[str, np.ndarray]
+    first_update: int
+    n_valid: int
+    policy_version: int
+    ep_stats: List[Tuple[float, float]]
+    _ring: Optional["TrajSlabRing"]
+    _slot: int
+
+    def release(self) -> None:
+        if self._ring is not None:
+            ring, self._ring = self._ring, None
+            ring._free.put(self._slot)
+
+
+class TrajSlabRing:
+    """The per-player slab transport. Constructed in the learner from an mp
+    context; picklable (RawArrays + queues + metadata only), passed whole to
+    the player process.
+
+    Player side::
+
+        slot = ring.acquire(stop)               # blocks on a credit
+        views = ring.writer_views(slot)         # numpy views into shm
+        ...fill views[k][:n]...
+        ring.commit(slot, first_update, n, version, ep_stats)
+
+    Learner side::
+
+        handle = ring.recv(timeout=...)         # None on timeout
+        rb.add({k: v[:handle.n_valid] ...})     # the one copy
+        handle.release()
+    """
+
+    def __init__(self, ctx, spec: SlabSpec, n_slots: int):
+        if n_slots < 1:
+            raise ValueError(f"TrajSlabRing needs >=1 slot, got {n_slots}")
+        self.spec = spec
+        self.n_slots = int(n_slots)
+        self._raw = {
+            key: _alloc(ctx, (self.n_slots, *shape), np.dtype(dtype))
+            for key, shape, dtype in spec.keys
+        }
+        self._free = ctx.Queue()
+        self._filled = ctx.Queue()
+        for slot in range(self.n_slots):
+            self._free.put(slot)
+        self._views: Optional[Dict[str, np.ndarray]] = None
+
+    # -- views ---------------------------------------------------------------
+
+    def _all_views(self) -> Dict[str, np.ndarray]:
+        if self._views is None:
+            self._views = {
+                key: _view(self._raw[key], (self.n_slots, *shape), np.dtype(dtype))
+                for key, shape, dtype in self.spec.keys
+            }
+        return self._views
+
+    def writer_views(self, slot: int) -> Dict[str, np.ndarray]:
+        return {k: v[slot] for k, v in self._all_views().items()}
+
+    def raw_nbytes(self) -> int:
+        return sum(len(r) for r in self._raw.values())
+
+    # -- player side ---------------------------------------------------------
+
+    def acquire(self, stop=None, poll_s: float = 0.2) -> int:
+        """Take one slot credit; blocks until the learner returns one. With
+        ``stop`` set mid-wait, raises :class:`PlaneClosed` (clean shutdown,
+        not an error)."""
+        while True:
+            try:
+                return self._free.get(timeout=poll_s)
+            except _queue.Empty:
+                if stop is not None and stop.is_set():
+                    raise PlaneClosed("plane stopping while waiting for a slab credit")
+
+    def commit(
+        self,
+        slot: int,
+        first_update: int,
+        n_valid: int,
+        policy_version: int,
+        ep_stats: Optional[List[Tuple[float, float]]] = None,
+    ) -> None:
+        self._filled.put(
+            (int(slot), int(first_update), int(n_valid), int(policy_version), list(ep_stats or []))
+        )
+
+    # -- learner side --------------------------------------------------------
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[SlabHandle]:
+        """Next committed slab, or ``None`` on timeout (the supervisor uses
+        short timeouts to interleave liveness checks with the wait)."""
+        try:
+            slot, first_update, n_valid, version, ep_stats = self._filled.get(
+                timeout=timeout
+            )
+        except _queue.Empty:
+            return None
+        return SlabHandle(
+            data=self.writer_views(slot),
+            first_update=first_update,
+            n_valid=n_valid,
+            policy_version=version,
+            ep_stats=ep_stats,
+            _ring=self,
+            _slot=slot,
+        )
+
+    def close(self) -> None:
+        """Drop queue feeder threads so interpreter shutdown never hangs on
+        a half-drained queue."""
+        for q in (self._free, self._filled):
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except Exception:
+                pass
+
+    # RawArrays/queues pickle through the mp context's reduction; the cached
+    # views must not (they are process-local).
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_views"] = None
+        return state

@@ -1,0 +1,457 @@
+"""Learner-side orchestration of the multi-process actor–learner plane.
+
+:class:`ProcessPlane` owns everything the learner needs to run N player
+processes: per-player :class:`~sheeprl_tpu.plane.slabs.TrajSlabRing`
+transports, the :class:`~sheeprl_tpu.plane.publish.PolicyPublisher`, an
+event queue for player errors/telemetry, and the fault-tolerance loop —
+a player that dies (crash, kill, OOM) is respawned **from the latest
+published policy version** at exactly the next trajectory burst the learner
+expects, on a fresh slab ring (lost credits die with the old one), within a
+``plane.max_player_restarts`` budget per player. Each respawn bumps the
+``plane_player_restarts`` counter and fires the flight recorder, so fault
+handling is evidence, not silence.
+
+:class:`LocalPlane` is the same surface over the thread transport
+(``plane.num_players=0``): one player thread, in-memory burst queue,
+in-process policy channel. The decoupled learner loops are written against
+the shared surface and never branch on the mode.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _queue
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from sheeprl_tpu.obs.counters import add_plane_player_restart, add_plane_slabs, installed
+from sheeprl_tpu.plane.local import LocalBurstQueue, LocalPlayerHandle
+from sheeprl_tpu.plane.publish import (
+    POLICY_DIR,
+    LocalPolicyChannel,
+    PolicyPublisher,
+)
+from sheeprl_tpu.plane.slabs import SlabSpec, TrajSlabRing
+
+__all__ = [
+    "LocalPlane",
+    "ProcessPlane",
+    "build_plane",
+    "plane_env_split",
+    "resolve_plane_players",
+]
+
+#: player-process counter fields folded into the learner's counters at exit
+_FOLDED_COUNTERS = (
+    "env_steps_async",
+    "env_worker_restarts",
+    "env_degraded_to_sync",
+    "act_dispatches",
+    "rollout_bursts",
+)
+
+
+def resolve_plane_players(cfg) -> int:
+    """``plane.num_players`` (0 = thread-local mode), tolerant of configs
+    persisted before the plane group existed."""
+    try:
+        return max(int(cfg.get("plane", {}).get("num_players", 0) or 0), 0)
+    except AttributeError:
+        return 0
+
+
+def plane_env_split(cfg, n_envs: int):
+    """``(num_players, envs_per_player)``: each player owns an equal slice of
+    the env fleet (0 players = the thread-local mode owning all of it)."""
+    num_players = resolve_plane_players(cfg)
+    if num_players > 0 and n_envs % num_players != 0:
+        raise ValueError(
+            f"plane.num_players={num_players} must divide the env fleet "
+            f"(env.num_envs * world_size = {n_envs})"
+        )
+    return num_players, (n_envs // num_players if num_players > 0 else n_envs)
+
+
+def build_plane(
+    cfg,
+    *,
+    spec: SlabSpec,
+    entry: str,
+    run_player: Callable[[Any], None],
+    scalars: Dict[str, int],
+    player_keys: List[Any],
+    algo_name: str,
+    start_update: int,
+    n_envs: int,
+    log_dir: str,
+    player_log_dir: Optional[str],
+    thread_name: str,
+    initial_params: Any,
+    watchdog: Any = None,
+):
+    """The one plane bring-up both decoupled entrypoints share: pick the
+    transport from ``plane.num_players``, publish version 0 (the initial or
+    resumed parameters — players poll the channel before their first act),
+    and start. ``watchdog`` is the learner's running stall watchdog, handed
+    to the thread-mode player so a hung env step still fires a stall dump
+    (process players are covered by ``plane.recv_timeout_s`` instead)."""
+    from sheeprl_tpu.plane.worker import PlayerContext
+
+    num_players, envs_per_player = plane_env_split(cfg, n_envs)
+    if num_players > 0:
+        plane = ProcessPlane(
+            cfg,
+            log_dir=log_dir,
+            entry=entry,
+            spec=spec,
+            n_players=num_players,
+            envs_per_player=envs_per_player,
+            scalars=scalars,
+            player_keys=[np.asarray(k) for k in player_keys],
+            algo_name=algo_name,
+            start_update=start_update,
+        )
+    else:
+        ctx = PlayerContext(
+            cfg=cfg,
+            player_idx=0,
+            n_players=1,
+            n_envs=n_envs,
+            env_rank=0,
+            start_update=start_update,
+            restart_count=0,
+            log_dir=player_log_dir,
+            channel=None,
+            writer=None,
+            stop=None,
+            player_key=np.asarray(player_keys[0]),
+            scalars=scalars,
+            watchdog=watchdog,
+        )
+        plane = LocalPlane(cfg, spec, lambda: run_player(ctx), name=thread_name)
+        ctx.channel = plane.channel
+        ctx.writer = plane.writer
+        ctx.stop = plane.stop
+    plane.publish(0, initial_params)
+    return plane.start()
+
+
+class LocalPlane:
+    """Thread-transport plane: one in-process player (num_players=0)."""
+
+    n_players = 1
+
+    def __init__(self, cfg, spec: SlabSpec, player_fn: Callable[[Any], None], *, name: str):
+        from sheeprl_tpu.plane.worker import LocalWriter
+
+        pcfg = cfg.get("plane", {}) or {}
+        self.channel = LocalPolicyChannel(keep_policies=int(pcfg.get("keep_policies", 4)))
+        self._queue = LocalBurstQueue(int(pcfg.get("queue_slots", 4)))
+        self.writer = LocalWriter(self._queue, spec)
+        self._handle = LocalPlayerHandle(player_fn, name=name)
+        # same hard deadline as ProcessPlane.recv: a wedged player thread
+        # (hung env step) must fail the run, not stall it silently forever
+        self.recv_timeout_s = float(pcfg.get("recv_timeout_s", 300.0) or 0.0)
+
+    @property
+    def stop(self):
+        return self._handle.stop
+
+    def start(self) -> "LocalPlane":
+        self._handle.start()
+        return self
+
+    def publish(self, version: int, params: Any) -> None:
+        from sheeprl_tpu.obs import span
+
+        with span("Time/policy_publish_time", phase="publish"):
+            self.channel.publish(version, params)
+
+    def recv(self, idx: int, expected_first: int):
+        """Next burst from the (single) player; raises if the thread died."""
+        deadline = (
+            time.monotonic() + self.recv_timeout_s if self.recv_timeout_s > 0 else None
+        )
+        while True:
+            payload = self._queue.recv(timeout=0.5)
+            if payload is not None:
+                add_plane_slabs()
+                if payload.first_update != expected_first:
+                    raise RuntimeError(
+                        f"plane protocol drift: learner expected the burst at update "
+                        f"{expected_first}, player sent {payload.first_update}"
+                    )
+                return payload
+            self._handle.check()
+            if not self._handle.alive():
+                raise RuntimeError(
+                    "decoupled player thread exited before the run finished"
+                )
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"plane: no trajectory burst from the player thread within "
+                    f"{self.recv_timeout_s}s (update {expected_first})"
+                )
+
+    def check(self) -> None:
+        self._handle.check()
+
+    def drain(self, timeout: float = 30.0) -> None:
+        if self._handle is not None:
+            self._handle.stop.set()
+            self._queue.drain()  # unblock a commit waiting on a credit
+            self._handle.join(timeout=timeout)
+
+
+class ProcessPlane:
+    """Multi-process plane: N players over shared-memory slab rings."""
+
+    def __init__(
+        self,
+        cfg,
+        *,
+        log_dir: str,
+        entry: str,
+        spec: SlabSpec,
+        n_players: int,
+        envs_per_player: int,
+        scalars: Dict[str, int],
+        player_keys: List[np.ndarray],
+        algo_name: str,
+        start_update: int,
+    ):
+        import multiprocessing as mp
+
+        pcfg = cfg.get("plane", {}) or {}
+        self.cfg = cfg
+        self.log_dir = log_dir
+        self.entry = entry
+        self.spec = spec
+        self.n_players = int(n_players)
+        self.envs_per_player = int(envs_per_player)
+        self.scalars = dict(scalars)
+        self.player_keys = [np.asarray(k) for k in player_keys]
+        self.queue_slots = max(int(pcfg.get("queue_slots", 4)), 1)
+        self.max_restarts = max(int(pcfg.get("max_player_restarts", 2)), 0)
+        self.poll_interval_s = float(pcfg.get("poll_interval_s", 0.05) or 0.05)
+        self.recv_timeout_s = float(pcfg.get("recv_timeout_s", 300.0) or 0.0)
+        # non-fork start method: the learner has live jax threads (see the
+        # PR-5 factory note); default shared with the env plane's knob
+        method = str(cfg.env.get("mp_context", "forkserver") or "forkserver")
+        self._mp = mp.get_context(method)
+        self.stop = self._mp.Event()
+        self._events = self._mp.Queue()
+        self._telemetry_enabled = installed() is not None
+
+        self.publisher = PolicyPublisher(
+            os.path.join(log_dir, POLICY_DIR),
+            keep_policies=int(pcfg.get("keep_policies", 4)),
+            algo=algo_name,
+            # the npz write + fsync + rename runs per burst — off the train
+            # critical path (players poll; they tolerate publication latency)
+            async_publish=True,
+        )
+        self.channel = self.publisher  # learner-side publish surface
+
+        self._rings: List[Optional[TrajSlabRing]] = [None] * self.n_players
+        self._procs: List[Optional[Any]] = [None] * self.n_players
+        self._restarts = [0] * self.n_players
+        self._errors: Dict[int, str] = {}
+        self._start_update = int(start_update)
+        self._cfg_plain = _plain(cfg)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ProcessPlane":
+        for idx in range(self.n_players):
+            self._spawn(idx, self._start_update)
+        return self
+
+    def _spawn(self, idx: int, start_update: int) -> None:
+        ring = TrajSlabRing(self._mp, self.spec, self.queue_slots)
+        spec = {
+            "entry": self.entry,
+            "cfg": self._cfg_plain,
+            "player_idx": idx,
+            "n_players": self.n_players,
+            "n_envs": self.envs_per_player,
+            "env_rank": idx,
+            "start_update": int(start_update),
+            "restart_count": self._restarts[idx],
+            "log_dir": self.log_dir,
+            "policy_root": self.publisher.root,
+            "poll_interval_s": self.poll_interval_s,
+            "ring": ring,
+            "stop": self.stop,
+            "events": self._events,
+            "player_key": self.player_keys[idx],
+            "scalars": self.scalars,
+            "prng_impl": _prng_impl(),
+            "telemetry": self._telemetry_enabled,
+        }
+        from sheeprl_tpu.plane.worker import child_main
+
+        # NOT daemonic: players own env worker pools (daemons cannot have
+        # children). Orphan safety comes from the ppid watch in the player
+        # loop plus the terminate/kill ladder in drain().
+        proc = self._mp.Process(
+            target=child_main, args=(spec,), name=f"plane-player-{idx}", daemon=False
+        )
+        proc.start()
+        old = self._rings[idx]
+        self._rings[idx] = ring
+        self._procs[idx] = proc
+        if old is not None:
+            old.close()
+
+    def publish(self, version: int, params: Any) -> None:
+        from sheeprl_tpu.obs import span
+
+        with span("Time/policy_publish_time", phase="publish"):
+            self.publisher.publish(version, params)
+
+    # -- receive + fault tolerance -------------------------------------------
+
+    def recv(self, idx: int, expected_first: int):
+        """The burst starting at ``expected_first`` from player ``idx``,
+        respawning the player (fresh ring, latest policy) if it dies."""
+        deadline = (
+            time.monotonic() + self.recv_timeout_s if self.recv_timeout_s > 0 else None
+        )
+        while True:
+            handle = self._rings[idx].recv(timeout=0.5)
+            self._drain_events()
+            if handle is not None:
+                if handle.first_update != expected_first:
+                    # a pre-crash ring is replaced wholesale, so this is
+                    # protocol drift, not recoverable raciness
+                    handle.release()
+                    raise RuntimeError(
+                        f"plane protocol drift: learner expected the burst at update "
+                        f"{expected_first} from player {idx}, got {handle.first_update}"
+                    )
+                add_plane_slabs()
+                return handle
+            proc = self._procs[idx]
+            if proc is not None and not proc.is_alive():
+                self._respawn(idx, expected_first)
+                if deadline is not None:
+                    # the replacement pays spawn + jax init + env-pool build +
+                    # a full collection burst; charging it the dead player's
+                    # leftover window would defeat the restart budget
+                    deadline = time.monotonic() + self.recv_timeout_s
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"plane: no trajectory burst from player {idx} within "
+                    f"{self.recv_timeout_s}s (update {expected_first})"
+                )
+
+    def _respawn(self, idx: int, next_update: int) -> None:
+        err = self._errors.pop(idx, None)
+        self._restarts[idx] += 1
+        if self._restarts[idx] > self.max_restarts:
+            raise RuntimeError(
+                f"plane player {idx} died and exhausted its restart budget "
+                f"({self.max_restarts})" + (f"; last error:\n{err}" if err else "")
+            )
+        warnings.warn(
+            f"plane player {idx} died (restart {self._restarts[idx]}/"
+            f"{self.max_restarts}); respawning at update {next_update} from the "
+            "latest published policy" + (f"; error:\n{err}" if err else "")
+        )
+        add_plane_player_restart()
+        from sheeprl_tpu.obs import get_telemetry
+
+        telemetry = get_telemetry()
+        if telemetry is not None and telemetry.flight is not None:
+            telemetry.flight.trigger(
+                "plane_player_restart",
+                {"player": idx, "restart": self._restarts[idx], "update": next_update},
+            )
+        self._spawn(idx, next_update)
+
+    def _drain_events(self) -> None:
+        while True:
+            try:
+                idx, kind, payload = self._events.get_nowait()
+            except _queue.Empty:
+                return
+            if kind == "error":
+                self._errors[int(idx)] = str(payload)
+            elif kind == "telemetry":
+                self._fold_counters(payload)
+
+    def _fold_counters(self, snap: Dict[str, Any]) -> None:
+        counters = installed()
+        if counters is None or not isinstance(snap, dict):
+            return
+        for field in _FOLDED_COUNTERS:
+            amount = snap.get(field, 0)
+            if amount:
+                counters.add(field, int(amount))
+
+    def check(self) -> None:
+        self._drain_events()
+
+    # -- shutdown ------------------------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Stop players and join them — also the PR-2 preemption path: the
+        learner's SIGTERM checkpoint breaks its loop, then players (which
+        ignore the signal) exit through the stop event and are joined here."""
+        self.stop.set()
+        deadline = time.monotonic() + timeout
+        for idx, proc in enumerate(self._procs):
+            if proc is None:
+                continue
+            # free a player blocked on a full slab queue
+            ring = self._rings[idx]
+            while ring is not None:
+                h = ring.recv(timeout=0.01)
+                if h is None:
+                    break
+                h.release()
+            proc.join(timeout=max(deadline - time.monotonic(), 0.5))
+            if proc.is_alive():
+                proc.terminate()
+                proc.join(timeout=5.0)
+            if proc.is_alive():
+                proc.kill()
+                proc.join(timeout=5.0)
+        self._drain_events()
+        for ring in self._rings:
+            if ring is not None:
+                ring.close()
+        self.publisher.close()
+        try:
+            self._events.cancel_join_thread()
+            self._events.close()
+        except Exception:
+            pass
+
+
+def _prng_impl() -> Optional[str]:
+    try:
+        import jax
+
+        return str(jax.config.jax_default_prng_impl)
+    except Exception:
+        return None
+
+
+def _plain(cfg) -> Any:
+    """A picklable deep copy of the composed config (dotdicts are dict
+    subclasses, but resolve through a plain structure to be safe)."""
+    from sheeprl_tpu.utils.utils import dotdict
+
+    def rec(node):
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return [rec(v) for v in node]
+        return node
+
+    return dotdict(rec(cfg))

@@ -1,0 +1,48 @@
+"""Subprocess target for the mid-publish SIGKILL test.
+
+Publishes policy version 1 atomically, then starts publishing version 2 and
+hangs inside the manifest commit — printing ``MIDPUBLISH`` once the weight
+shard is on disk but the version directory is still a ``.tmp`` partial. The
+parent test SIGKILLs this process at that point: the policy root then holds
+exactly what a learner torn mid-publication leaves behind, and a player
+polling it must keep acting on version 1.
+
+Run: ``python plane_kill_worker.py <policy_root>``
+"""
+
+import os
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from sheeprl_tpu.ckpt import manifest as manifest_mod
+from sheeprl_tpu.plane import PolicyPublisher
+
+
+def main() -> None:
+    root = sys.argv[1]
+    publisher = PolicyPublisher(root, keep_policies=4)
+    publisher.publish(1, {"w": np.full((4, 4), 1.0, np.float32)})
+
+    real_write_manifest = manifest_mod.write_manifest
+    blocked = threading.Event()
+
+    def blocking_write_manifest(dirname, manifest, fsync=True):
+        # the npz shard is fully written; the commit record is not — announce
+        # and hang so the parent can SIGKILL mid-publish
+        print("MIDPUBLISH", flush=True)
+        blocked.wait()  # forever
+        real_write_manifest(dirname, manifest, fsync)
+
+    from sheeprl_tpu.ckpt import writer as writer_mod
+
+    writer_mod.write_manifest = blocking_write_manifest
+    publisher.publish(2, {"w": np.full((4, 4), 2.0, np.float32)})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""End-to-end actor–learner plane acceptance (sheeprl_tpu/plane).
+
+The three scenarios ISSUE 7 gates on:
+
+- a seeded 1-player plane run is **bitwise** the thread-local decoupled run
+  (same protocol, different transport — the regression gate for the
+  decoupled rewrite);
+- worker-loss fault injection: a SIGKILLed player process is respawned from
+  the latest published policy and the run finishes, with the respawn visible
+  in telemetry;
+- learner preemption: SIGTERM drains through the PR-2 path with the player
+  processes joining cleanly, and ``checkpoint.resume_from=latest`` resumes
+  with players live.
+"""
+
+import glob
+import json
+import multiprocessing as mp
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu import cli
+from sheeprl_tpu.ckpt.resume import read_checkpoint, resolve_latest
+
+
+def _sac_args(tmp_path, mode, players, total_steps=320, learning_starts=96):
+    return [
+        "exp=sac_decoupled",
+        f"plane.num_players={players}",
+        "fabric.devices=2",
+        "fabric.accelerator=cpu",
+        "env.id=Pendulum-v1",
+        "env.num_envs=2",
+        "env.capture_video=False",
+        "env.vectorization=async",  # both modes on the same env backend
+        "buffer.memmap=False",
+        "buffer.size=1024",
+        "buffer.prefetch=False",  # strict sampling determinism
+        "per_rank_batch_size=8",
+        f"total_steps={total_steps}",
+        f"algo.learning_starts={learning_starts}",
+        "algo.hidden_size=8",
+        "algo.run_test=False",
+        "metric.log_level=0",
+        "metric.log_every=1000000",
+        "checkpoint.every=1000000",
+        "checkpoint.save_last=True",
+        f"root_dir={tmp_path}/{mode}",
+        "run_name=test",
+    ]
+
+
+def _final_state(run_root):
+    latest = resolve_latest(str(run_root))
+    assert latest is not None, f"no resumable checkpoint under {run_root}"
+    return read_checkpoint(latest)
+
+
+def test_sac_one_player_plane_bitwise_equals_thread_mode(tmp_path, monkeypatch):
+    """Transport changes, arithmetic doesn't: the multi-process plane with
+    one player reproduces the thread-local decoupled run bit-for-bit."""
+    import jax
+
+    monkeypatch.chdir(tmp_path)
+    cli.run(_sac_args(tmp_path, "thread", players=0))
+    cli.run(_sac_args(tmp_path, "plane", players=1))
+
+    thread_leaves = jax.tree_util.tree_leaves(_final_state(f"{tmp_path}/thread")["agent"])
+    plane_leaves = jax.tree_util.tree_leaves(_final_state(f"{tmp_path}/plane")["agent"])
+    assert len(thread_leaves) == len(plane_leaves)
+    for i, (a, b) in enumerate(zip(thread_leaves, plane_leaves)):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"agent leaf {i} diverged"
+        )
+
+
+def _kill_one_player_when_alive(killed):
+    """Watcher-thread body: SIGKILL the first plane player process once the
+    plane is up and past its jit warmup."""
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        players = [p for p in mp.active_children() if p.name.startswith("plane-player")]
+        if players and players[0].pid is not None:
+            time.sleep(3.0)  # let it commit a few slabs first
+            target = [p for p in mp.active_children() if p.name.startswith("plane-player")]
+            if target:
+                os.kill(target[0].pid, signal.SIGKILL)
+                killed["pid"] = target[0].pid
+            return
+        time.sleep(0.1)
+
+
+def test_plane_player_kill_respawns_and_run_finishes(tmp_path, monkeypatch):
+    """Worker-loss fault injection: one of two players is SIGKILLed mid-run;
+    the supervisor respawns it from the latest published policy and the run
+    completes, with the respawn recorded in telemetry.json."""
+    monkeypatch.chdir(tmp_path)
+    killed = {}
+    watcher = threading.Thread(target=_kill_one_player_when_alive, args=(killed,), daemon=True)
+    watcher.start()
+    cli.run(
+        _sac_args(tmp_path, "faults", players=2, total_steps=640, learning_starts=128)
+        + ["metric=telemetry", "metric.telemetry.poll_interval_s=0"]
+    )
+    watcher.join(timeout=10)
+    assert killed.get("pid"), "the watcher never found a player process to kill"
+
+    t_files = glob.glob(f"{tmp_path}/faults/**/telemetry.json", recursive=True)
+    assert t_files, "telemetry.json missing"
+    t = json.load(open(sorted(t_files)[-1]))
+    assert t["plane_player_restarts"] >= 1, "the killed player was not respawned"
+    assert t["plane_traj_slabs"] > 0
+    assert t["plane_policy_version"] > 0
+    # the run finished: the final checkpoint covers every update
+    state = _final_state(f"{tmp_path}/faults")
+    assert int(np.asarray(state["update"])) == (640 // 4) * 2  # num_updates * world_size
+
+
+def test_plane_sigterm_drains_and_resumes_with_players(tmp_path, monkeypatch):
+    """Learner preemption over the plane: SIGTERM checkpoints and drains (the
+    players ignore the signal and exit via the stop event), then
+    ``checkpoint.resume_from=latest`` picks the run back up with player
+    processes live and finishes it."""
+    from sheeprl_tpu.ckpt.preemption import reset_preemption
+
+    monkeypatch.chdir(tmp_path)
+    timer = threading.Timer(8.0, os.kill, (os.getpid(), signal.SIGTERM))
+    timer.start()
+    try:
+        cli.run(
+            _sac_args(
+                tmp_path, "preempt", players=2, total_steps=200000, learning_starts=64
+            )
+        )
+    finally:
+        timer.cancel()
+        reset_preemption()
+
+    state = _final_state(f"{tmp_path}/preempt")
+    saved_update = int(np.asarray(state["update"]))
+    assert 0 < saved_update < 2 * (200000 // 4), "run was not cut short"
+    # no orphaned player processes survive the drain
+    leftover = [p for p in mp.active_children() if p.name.startswith("plane-player")]
+    assert not leftover, f"drain left players behind: {leftover}"
+
+    # resume with players live, to completion this time
+    total = (saved_update // 2) * 4 + 64  # a handful of updates past the cut
+    cli.run(
+        _sac_args(tmp_path, "preempt", players=2, total_steps=total, learning_starts=64)
+        + ["checkpoint.resume_from=latest"]
+    )
+    resumed = _final_state(f"{tmp_path}/preempt")
+    assert int(np.asarray(resumed["update"])) == (total // 4) * 2
+    assert int(np.asarray(resumed["update"])) > saved_update

@@ -1,0 +1,69 @@
+"""Unit tests of the plane's shared arithmetic (sheeprl_tpu/plane/protocol).
+
+Both sides of the plane derive burst segmentation and policy versions from
+these pure functions instead of exchanging control messages — so the
+arithmetic is load-bearing for the bitwise thread-vs-process gate and must
+be pinned exactly.
+"""
+
+from sheeprl_tpu.plane import burst_plan, required_version, version_after
+
+
+def test_burst_plan_random_phase_clamps_at_learning_starts():
+    # updates 1..5 are the random phase (learning_starts=5): a K=4 burst
+    # starting at 3 must stop at 5 so the catch-up train runs on time
+    n_act, random_phase = burst_plan(3, 4, 5, 100)
+    assert (n_act, random_phase) == (3, True)
+
+
+def test_burst_plan_trained_phase_clamps_at_num_updates():
+    n_act, random_phase = burst_plan(98, 8, 5, 100)
+    assert (n_act, random_phase) == (3, False)
+
+
+def test_burst_plan_k1_is_per_step():
+    for update in (1, 5, 6, 100):
+        n_act, _ = burst_plan(update, 1, 5, 100)
+        assert n_act == 1
+
+
+def test_burst_plan_never_returns_zero():
+    n_act, _ = burst_plan(100, 8, 5, 100)
+    assert n_act == 1
+
+
+def test_version_after_counts_trained_updates():
+    # first_train_update=5: training through update 5 publishes version 1
+    assert version_after(4, 5) == 0
+    assert version_after(5, 5) == 1
+    assert version_after(9, 5) == 5
+
+
+def test_required_version_is_two_updates_behind():
+    # acting update u requires the params trained through u-2: the one-step
+    # lead that lets the learner train u-1 while the player collects u
+    assert required_version(5, 5) == 0  # nothing trained yet
+    assert required_version(6, 5) == 0
+    assert required_version(7, 5) == 1
+    assert required_version(8, 5) == 2
+
+
+def test_learner_player_version_lockstep():
+    """Liveness invariant: when a player is about to collect the burst at
+    ``update`` it has committed every burst through ``update - 1`` — so the
+    learner can train through ``update - 1`` and publish a version
+    satisfying the player's bound without needing any further trajectories.
+    (The poller waits for any version >= the bound, so coarser-than-1
+    publication cadence under act_burst > 1 is fine.)"""
+    for act_burst in (1, 3, 8):
+        first_train, learning_starts, num_updates = 5, 5, 50
+        max_published = 0  # version 0 is published before any player starts
+        update = 1
+        while update <= num_updates:
+            n_act, random_phase = burst_plan(update, act_burst, learning_starts, num_updates)
+            if not random_phase:
+                assert required_version(update, first_train) <= max_published
+            last = update + n_act - 1
+            if last >= learning_starts:
+                max_published = max(max_published, version_after(last, first_train))
+            update = last + 1

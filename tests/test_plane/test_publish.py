@@ -1,0 +1,132 @@
+"""Policy-publication channel tests (sheeprl_tpu/plane/publish).
+
+The channel's contract: versions are strictly monotone, every published
+version a player loads is whole (atomic tmp→fsync→rename via the PR-2
+writer), a learner killed mid-publish can never tear the weights a player
+acts with, and GC never collects what a respawned player may still need.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.plane import (
+    LocalPolicyChannel,
+    PolicyPoller,
+    PolicyPublisher,
+    policy_path,
+)
+
+WORKER = os.path.join(os.path.dirname(__file__), "plane_kill_worker.py")
+
+
+def _params(fill: float):
+    return {"actor": {"w": np.full((3, 2), fill, np.float32), "b": np.zeros(2, np.float32)}}
+
+
+def test_publish_load_roundtrip_bitwise(tmp_path):
+    pub = PolicyPublisher(str(tmp_path), keep_policies=4)
+    pub.publish(1, _params(0.25))
+    poller = PolicyPoller(str(tmp_path))
+    loaded = poller.load(1)
+    np.testing.assert_array_equal(loaded["actor"]["w"], _params(0.25)["actor"]["w"])
+    np.testing.assert_array_equal(loaded["actor"]["b"], _params(0.25)["actor"]["b"])
+
+
+def test_versions_strictly_monotone(tmp_path):
+    pub = PolicyPublisher(str(tmp_path), keep_policies=4)
+    pub.publish(1, _params(1.0))
+    pub.publish(2, _params(2.0))
+    for bad in (2, 1, 0):
+        with pytest.raises(ValueError):
+            pub.publish(bad, _params(9.0))
+
+
+def test_local_channel_versions_strictly_monotone():
+    ch = LocalPolicyChannel(keep_policies=4)
+    ch.publish(0, _params(0.0))
+    ch.publish(1, _params(1.0))
+    with pytest.raises(ValueError):
+        ch.publish(1, _params(9.0))
+
+
+def test_gc_keeps_newest_and_never_below_two(tmp_path):
+    pub = PolicyPublisher(str(tmp_path), keep_policies=2, algo=None)
+    for v in range(1, 7):
+        pub.publish(v, _params(float(v)))
+    poller = PolicyPoller(str(tmp_path))
+    assert poller.latest_version() == 6
+    assert not os.path.isdir(policy_path(str(tmp_path), 4))
+    assert os.path.isdir(policy_path(str(tmp_path), 5))
+    # a respawned player bound below the newest gets the oldest survivor
+    v, params = poller.wait_min_version(3)
+    assert v == 5
+    np.testing.assert_array_equal(params["actor"]["w"], _params(5.0)["actor"]["w"])
+
+
+def test_wait_min_version_exact_returns_smallest_eligible(tmp_path):
+    pub = PolicyPublisher(str(tmp_path), keep_policies=8)
+    for v in range(1, 5):
+        pub.publish(v, _params(float(v)))
+    poller = PolicyPoller(str(tmp_path))
+    v, params = poller.wait_min_version(2, use_exact=True)
+    assert v == 2  # deterministic lockstep: the thread-local protocol's pick
+    v, params = poller.wait_min_version(2, use_exact=False)
+    assert v == 4  # bounded staleness: the freshest
+
+
+def test_poller_skips_torn_candidates(tmp_path):
+    pub = PolicyPublisher(str(tmp_path), keep_policies=8)
+    pub.publish(1, _params(1.0))
+    # a .tmp partial (mid-rename state) and a final dir with a corrupt
+    # manifest: neither may ever be served
+    os.makedirs(policy_path(str(tmp_path), 2) + ".tmp")
+    corrupt = policy_path(str(tmp_path), 3)
+    os.makedirs(corrupt)
+    with open(os.path.join(corrupt, "manifest.json"), "w") as f:
+        f.write("{not json")
+    poller = PolicyPoller(str(tmp_path))
+    assert poller.load(3) is None
+    v, params = poller.wait_min_version(1)
+    assert v == 1
+    np.testing.assert_array_equal(params["actor"]["w"], _params(1.0)["actor"]["w"])
+
+
+@pytest.fixture(scope="module")
+def killed_policy_root(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("killed") / "policy")
+    os.makedirs(root)
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, root],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        for line in proc.stdout:  # wait for the mid-publish announcement
+            if "MIDPUBLISH" in line:
+                break
+        else:
+            pytest.fail(f"worker exited early (rc={proc.wait()}) without MIDPUBLISH")
+        os.kill(proc.pid, signal.SIGKILL)
+    finally:
+        proc.wait(timeout=30)
+        proc.stdout.close()
+    return root
+
+
+def test_kill_mid_publish_players_keep_prior_version(killed_policy_root):
+    """The acceptance scenario: a learner SIGKILLed mid-publication leaves a
+    ``.tmp`` partial, never a final version 2 — players keep version 1."""
+    names = sorted(os.listdir(killed_policy_root))
+    assert os.path.basename(policy_path("", 1)) in names
+    assert os.path.basename(policy_path("", 2)) not in names
+    poller = PolicyPoller(killed_policy_root)
+    assert poller.latest_version() == 1
+    v, params = poller.wait_min_version(1)
+    assert v == 1
+    np.testing.assert_array_equal(params["w"], np.full((4, 4), 1.0, np.float32))

@@ -1,0 +1,100 @@
+"""Trajectory slab ring semantics (sheeprl_tpu/plane/slabs).
+
+The ring is the player→learner transport: fixed-layout shared blocks,
+credited slots, zero-copy learner views. These tests drive it single-process
+(both ends on local views — the layout and credit arithmetic are identical;
+the cross-process path is covered by the e2e plane tests).
+"""
+
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.plane import PlaneClosed, SlabSpec, TrajSlabRing
+
+
+def _spec(steps=4, n_envs=2, obs=3):
+    return SlabSpec.from_arrays(
+        {
+            "observations": np.zeros((steps, n_envs, obs), np.float32),
+            "rewards": np.zeros((steps, n_envs, 1), np.float32),
+        }
+    )
+
+
+def test_spec_from_arrays_fixes_shapes_and_dtypes():
+    spec = _spec()
+    assert dict((k, (s, d)) for k, s, d in spec.keys) == {
+        "observations": ((4, 2, 3), "float32"),
+        "rewards": ((4, 2, 1), "float32"),
+    }
+    assert spec.nbytes() == 4 * 2 * 3 * 4 + 4 * 2 * 1 * 4
+
+
+def test_commit_recv_roundtrip_is_zero_copy():
+    ring = TrajSlabRing(mp.get_context("spawn"), _spec(), n_slots=2)
+    slot = ring.acquire()
+    views = ring.writer_views(slot)
+    views["observations"][:] = np.arange(24, dtype=np.float32).reshape(4, 2, 3)
+    views["rewards"][:] = 1.0
+    ring.commit(slot, first_update=7, n_valid=3, policy_version=2, ep_stats=[(1.0, 9.0)])
+
+    handle = ring.recv(timeout=5)
+    assert handle is not None
+    assert (handle.first_update, handle.n_valid, handle.policy_version) == (7, 3, 2)
+    assert handle.ep_stats == [(1.0, 9.0)]
+    # learner views alias the writer's shared block — no copy in between
+    assert np.shares_memory(handle.data["observations"], views["observations"])
+    np.testing.assert_array_equal(
+        handle.data["observations"][:3], np.arange(24, dtype=np.float32).reshape(4, 2, 3)[:3]
+    )
+    handle.release()
+    ring.close()
+
+
+def test_credited_slots_backpressure_player_until_release():
+    ring = TrajSlabRing(mp.get_context("spawn"), _spec(), n_slots=1)
+    slot = ring.acquire()
+    ring.commit(slot, 1, 4, 0)
+
+    got = {}
+
+    def blocked_acquire():
+        got["slot"] = ring.acquire()
+
+    t = threading.Thread(target=blocked_acquire, daemon=True)
+    t.start()
+    t.join(timeout=0.6)
+    assert t.is_alive(), "acquire must block while the learner holds every credit"
+
+    handle = ring.recv(timeout=5)
+    handle.release()  # the credit goes back...
+    t.join(timeout=5)
+    assert not t.is_alive() and got["slot"] == slot  # ...and unblocks the player
+    ring.close()
+
+
+def test_acquire_raises_plane_closed_on_stop():
+    ring = TrajSlabRing(mp.get_context("spawn"), _spec(), n_slots=1)
+    ring.acquire()  # drain the only credit
+    stop = threading.Event()
+    stop.set()
+    with pytest.raises(PlaneClosed):
+        ring.acquire(stop, poll_s=0.05)
+    ring.close()
+
+
+def test_recv_timeout_returns_none_quickly():
+    ring = TrajSlabRing(mp.get_context("spawn"), _spec(), n_slots=1)
+    t0 = time.monotonic()
+    assert ring.recv(timeout=0.05) is None
+    assert time.monotonic() - t0 < 2.0
+    ring.close()
+
+
+def test_ring_rejects_zero_slots():
+    with pytest.raises(ValueError):
+        TrajSlabRing(mp.get_context("spawn"), _spec(), n_slots=0)

@@ -77,6 +77,23 @@ def goodness_change(old: Dict[str, Any], new: Dict[str, Any]) -> Optional[float]
     return 1.0 - nv / ov
 
 
+def _sub_metrics(line: Dict[str, Any]) -> Dict[str, Tuple[float, bool]]:
+    """Diffable sub-metrics riding on an evidence line beyond ``value``:
+    the computed ``sps`` (higher-better) and the folded phase tails
+    (``telemetry.*_p50_ms``/``*_p95_ms``, lower-better) — so a line like
+    the plane's carries regression coverage for its latency decomposition,
+    not just its wall-clock."""
+    out: Dict[str, Tuple[float, bool]] = {}
+    if isinstance(line.get("sps"), (int, float)):
+        out["sps"] = (float(line["sps"]), True)
+    tel = line.get("telemetry")
+    if isinstance(tel, dict):
+        for key, val in tel.items():
+            if key.endswith("_ms") and isinstance(val, (int, float)) and val > 0:
+                out[f"telemetry.{key}"] = (float(val), False)
+    return out
+
+
 def compare(
     old_lines: Dict[str, Dict[str, Any]],
     new_lines: Dict[str, Dict[str, Any]],
@@ -106,6 +123,20 @@ def compare(
         else:
             word = "better" if change > 0 else "worse"
             report.append(f"  {metric}: {arrow} ({abs(change) * 100.0:.1f}% {word})")
+        old_sub, new_sub = _sub_metrics(old), _sub_metrics(new)
+        for sub in sorted(set(old_sub) & set(new_sub)):
+            (ov, higher), (nv, _) = old_sub[sub], new_sub[sub]
+            sub_change = (nv / ov - 1.0) if higher else (1.0 - nv / ov)
+            arrow = f"{ov} -> {nv}"
+            if sub_change < -threshold:
+                msg = f"{metric}.{sub}: {arrow} ({-sub_change * 100.0:.1f}% SLOWER)"
+                report.append(f"  REGRESSION {msg}")
+                regressions.append(msg)
+            else:
+                word = "better" if sub_change > 0 else "worse"
+                report.append(
+                    f"    {metric}.{sub}: {arrow} ({abs(sub_change) * 100.0:.1f}% {word})"
+                )
     return report, regressions
 
 

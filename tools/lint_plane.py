@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Plane-uniformity lint: decoupled execution goes through sheeprl_tpu/plane.
+
+The actor–learner plane (``sheeprl_tpu/plane``, howto/actor_learner.md) owns
+every player/learner transport concern: player threads and processes, burst
+queues with credited-slot backpressure, atomic policy publication, fault
+tolerance, drain. Before it existed each decoupled entrypoint hand-rolled a
+``threading.Thread`` player plus an ad-hoc ``queue.Queue`` — per-algo drift
+in shutdown, error propagation, and backpressure semantics. This lint keeps
+that from regrowing:
+
+1. ``algos/`` files must not import ``threading``, ``multiprocessing``,
+   ``queue``, or ``concurrent.futures`` (any alias, any from-import): player
+   loops, worker pools, and queues belong to the plane (or to the other
+   shared subsystems — envs/vector, data/staging, ckpt — which are already
+   linted separately and live outside ``algos/``).
+2. Decoupled entrypoints (``*_decoupled.py``) must import from
+   ``sheeprl_tpu.plane`` — the only sanctioned route to a player.
+
+AST-based; comments/docstrings are fine. Usage: ``python
+tools/lint_plane.py`` — non-zero exit with findings on violation. Wired into
+the CI tier-1 lane (.github/workflows/tests.yml).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
+
+#: modules whose import inside algos/ means hand-rolled concurrency
+FORBIDDEN_MODULES = {"threading", "multiprocessing", "queue", "concurrent"}
+
+
+def _imported_forbidden(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".", 1)[0]
+                if root in FORBIDDEN_MODULES:
+                    yield node.lineno, alias.name
+        elif isinstance(node, ast.ImportFrom):
+            root = (node.module or "").split(".", 1)[0]
+            if root in FORBIDDEN_MODULES:
+                yield node.lineno, node.module or ""
+
+
+def _imports_plane(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if (node.module or "").startswith("sheeprl_tpu.plane"):
+                return True
+        elif isinstance(node, ast.Import):
+            if any(a.name.startswith("sheeprl_tpu.plane") for a in node.names):
+                return True
+    return False
+
+
+def main() -> int:
+    violations = []
+    for root, _dirs, files in os.walk(ALGOS_DIR):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, ALGOS_DIR).replace(os.sep, "/")
+            tree = ast.parse(open(path).read(), filename=path)
+            for lineno, mod in _imported_forbidden(tree):
+                violations.append(
+                    (
+                        rel,
+                        lineno,
+                        f"import of '{mod}': hand-rolled concurrency in an "
+                        "algo — player loops, queues, and worker pools belong "
+                        "to the actor–learner plane (sheeprl_tpu/plane, "
+                        "howto/actor_learner.md)",
+                    )
+                )
+            if fname.endswith("_decoupled.py") and not _imports_plane(tree):
+                violations.append(
+                    (
+                        rel,
+                        1,
+                        "decoupled entrypoint does not import "
+                        "sheeprl_tpu.plane — decoupled execution must run on "
+                        "the actor–learner plane (LocalPlane/ProcessPlane)",
+                    )
+                )
+    if violations:
+        print("plane-uniformity lint FAILED:")
+        for rel, line, msg in violations:
+            print(f"  sheeprl_tpu/algos/{rel}:{line}: {msg}")
+        return 1
+    print("plane-uniformity lint OK (decoupled entrypoints route through sheeprl_tpu/plane)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

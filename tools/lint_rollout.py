@@ -38,8 +38,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
 
 #: entrypoints still on the per-step acting path (burst conversion pending:
-#: recurrent/stateful players and the decoupled player threads). Keep in
-#: sync with howto/rollout_engine.md's support matrix.
+#: recurrent/stateful players). The decoupled entrypoints (sac_decoupled,
+#: ppo_decoupled) were delisted when their players moved onto the
+#: actor–learner plane acting through BurstActor (sheeprl_tpu/plane,
+#: algos/{sac,ppo}/player.py). Keep in sync with howto/rollout_engine.md's
+#: support matrix.
 GRANDFATHERED = {
     "a2c/a2c.py",
     "dreamer_v1/dreamer_v1.py",
@@ -52,9 +55,7 @@ GRANDFATHERED = {
     "p2e_dv2/p2e_dv2_finetuning.py",
     "p2e_dv3/p2e_dv3_exploration.py",
     "p2e_dv3/p2e_dv3_finetuning.py",
-    "ppo/ppo_decoupled.py",
     "ppo_recurrent/ppo_recurrent.py",
-    "sac/sac_decoupled.py",
     "sac_ae/sac_ae.py",
 }
 
