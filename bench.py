@@ -239,6 +239,13 @@ def _phase_tails(tel) -> dict:
             if prefix == "train":
                 out[f"{prefix}_p50_ms"] = p.get("p50_ms")
             out[f"{prefix}_p95_ms"] = p["p95_ms"]
+    # in-run device profile (obs/prof): when a metric.telemetry.profile
+    # window landed during the run, the evidence line carries the measured
+    # device time + roofline verdict next to the wall-clock —
+    # tools/bench_compare.py diffs these unit-directionally across rounds
+    for key in ("device_ms_per_step", "mfu_device_pct", "roofline_verdict"):
+        if tel.get(key) is not None:
+            out[key] = tel[key]
     return out
 
 

@@ -298,11 +298,9 @@ def main() -> None:
     # capture an xplane trace and report the device-side per-step time (the
     # 'XLA Modules' line — the trustworthy number)
     device_us = None
-    if profile and jax.devices()[0].platform != "cpu":
-        import os
+    if profile:  # CPU too — the parser has a host-plane fallback (obs/prof)
         import tempfile
 
-        os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
         trace_dir = tempfile.mkdtemp(prefix=f"bench_{family}_trace_")
         n_prof = min(5, n)  # keys has n+1 entries; bench.steps can be small
         jax.profiler.start_trace(trace_dir)
@@ -313,10 +311,11 @@ def main() -> None:
         float(np.asarray(metrics["Loss/world_model_loss"]))  # block
         jax.profiler.stop_trace()
         try:
-            from tools.parse_xplane import summarize
+            # the promoted parser (self-contained wire decoding, no tf proto)
+            from sheeprl_tpu.obs.prof.xplane import summarize
 
             device_us = summarize(trace_dir, n_prof)["modules_us_per_step"]
-        except Exception as exc:  # missing tf proto etc. — keep the bench alive
+        except Exception as exc:  # unreadable trace — keep the bench alive
             print(f"# profile parse failed: {exc}", file=sys.stderr)
 
     # FLOPs + MFU (every family, round-5 VERDICT #5): raw XLA module

@@ -53,7 +53,14 @@ from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import log_sps_metrics, span
+from sheeprl_tpu.obs import (
+    get_telemetry,
+    log_sps_metrics,
+    profile_tick,
+    register_train_cost,
+    shape_specs,
+    span,
+)
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -609,6 +616,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 sequence_length=cfg.per_rank_sequence_length,
                 n_samples=n_samples,
             )
+            telemetry = get_telemetry()
+            train_specs = None
             with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 metrics = None
                 for i in range(n_samples):
@@ -617,6 +626,9 @@ def main(fabric, cfg: Dict[str, Any]):
                     # step host→HBM upload
                     batch = {k: v[i] for k, v in local_data.items()}
                     root_key, train_key = jax.random.split(root_key)
+                    if train_specs is None and telemetry is not None and telemetry.needs_train_flops():
+                        # specs captured pre-call: the step donates agent_state
+                        train_specs = shape_specs((agent_state, batch, train_key))
                     agent_state, metrics = train_fn(agent_state, batch, train_key)
                     per_rank_gradient_steps += 1
                 if metrics is not None:
@@ -624,6 +636,14 @@ def main(fabric, cfg: Dict[str, Any]):
                 play_wm = wm_mirror(agent_state["params"]["world_model"])
                 play_actor = actor_mirror(agent_state["params"]["actor"])
                 train_step += world_size
+            if train_specs is not None:
+                # the counter advances by world_size per block of
+                # per_rank_gradient_steps single-step dispatches
+                register_train_cost(
+                    telemetry, train_fn, *train_specs,
+                    world_size=world_size,
+                    dispatches_per_step=cfg.algo.per_rank_gradient_steps,
+                )
             updates_before_training = cfg.algo.train_every // policy_steps_per_update
             if cfg.algo.actor.expl_decay:
                 expl_decay_steps += 1
@@ -658,6 +678,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 world_size=world_size,
                 action_repeat=cfg.env.action_repeat,
             )
+            profile_tick(policy_step=policy_step, world_size=world_size)
             last_log = policy_step
             last_train = train_step
 

@@ -67,9 +67,10 @@ from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import (
     LoopProbe,
     add_act_dispatches,
-    cost_flops_of,
     get_telemetry,
     log_sps_metrics,
+    profile_tick,
+    register_train_cost,
     shape_specs,
     span,
 )
@@ -1060,11 +1061,12 @@ def main(fabric, cfg: Dict[str, Any]):
                         play_actor = actor_mirror(agent_state["params"]["actor"])
                     train_step += world_size
                 if burst_specs is not None:
-                    # one AOT cost analysis of the whole burst, registered per
-                    # train-step UNIT (the counter advances by world_size per
-                    # dispatched burst)
-                    flops = cost_flops_of(train_fn.burst, *burst_specs)
-                    telemetry.set_train_flops(flops / world_size if flops else None)
+                    # one AOT cost analysis of the whole burst (FLOPs +
+                    # bytes accessed), registered per train-step UNIT (the
+                    # counter advances by world_size per dispatched burst)
+                    register_train_cost(
+                        telemetry, train_fn.burst, *burst_specs, world_size=world_size
+                    )
             updates_before_training = cfg.algo.train_every // policy_steps_per_update
             if cfg.algo.actor.expl_decay:
                 expl_decay_steps += 1
@@ -1100,6 +1102,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 world_size=world_size,
                 action_repeat=cfg.env.action_repeat,
             )
+            profile_tick(policy_step=policy_step, world_size=world_size)
             last_log = policy_step
             last_train = train_step
 

@@ -46,7 +46,7 @@ from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import log_sps_metrics, span
+from sheeprl_tpu.obs import log_sps_metrics, profile_tick, span
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -388,6 +388,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 world_size=world_size,
                 action_repeat=cfg.env.action_repeat,
             )
+            profile_tick(policy_step=policy_step, world_size=world_size)
             last_log = policy_step
             last_train = train_step
 

@@ -55,9 +55,10 @@ from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import (
     count_h2d,
-    cost_flops_of,
     get_telemetry,
     log_sps_metrics,
+    profile_tick,
+    register_train_cost,
     shape_specs,
     span,
 )
@@ -486,10 +487,11 @@ def main(fabric, cfg: Dict[str, Any]):
             params, opt_state, losses = update_fn(*update_args)
             losses = fetch_losses_if_observed(losses, aggregator)
         if update_specs is not None:
-            # per train-step UNIT: the counter advances by world_size per
-            # dispatched update program
-            flops = cost_flops_of(update_fn, *update_specs)
-            telemetry.set_train_flops(flops / world_size if flops else None)
+            # per train-step UNIT (FLOPs + bytes accessed): the counter
+            # advances by world_size per dispatched update program
+            register_train_cost(
+                telemetry, update_fn, *update_specs, world_size=world_size
+            )
         play_params = to_host(params)
         train_step += world_size
 
@@ -520,6 +522,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 world_size=world_size,
                 action_repeat=cfg.env.action_repeat,
             )
+            profile_tick(policy_step=policy_step, world_size=world_size)
             last_log = policy_step
             last_train = train_step
 

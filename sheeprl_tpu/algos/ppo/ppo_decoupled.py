@@ -49,9 +49,10 @@ from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.envs.vector import make_eval_env
 from sheeprl_tpu.obs import (
     count_h2d,
-    cost_flops_of,
     get_telemetry,
     log_sps_metrics,
+    profile_tick,
+    register_train_cost,
     shape_specs,
     span,
 )
@@ -306,8 +307,10 @@ def main(fabric, cfg: Dict[str, Any]):
                 # still valid for the one-off AOT cost analysis; per
                 # train-step UNIT (the counter advances by world_size per
                 # dispatched update program)
-                flops = cost_flops_of(update_fn, *shape_specs(update_args))
-                telemetry.set_train_flops(flops / world_size if flops else None)
+                register_train_cost(
+                    telemetry, update_fn, *shape_specs(update_args),
+                    world_size=world_size,
+                )
             train_step += world_size
 
             # the parameter broadcast (reference :525-529): an atomic policy
@@ -346,6 +349,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     world_size=world_size,
                     action_repeat=cfg.env.action_repeat,
                 )
+                profile_tick(policy_step=policy_step, world_size=world_size)
                 last_log = policy_step
                 last_train = train_step
 

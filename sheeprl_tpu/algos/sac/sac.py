@@ -66,9 +66,10 @@ from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import (
-    cost_flops_of,
     get_telemetry,
     log_sps_metrics,
+    profile_tick,
+    register_train_cost,
     shape_specs,
     span,
 )
@@ -519,10 +520,12 @@ def main(fabric, cfg: Dict[str, Any]):
                 agent_state, opt_states, losses = train_fn(*train_args)
                 losses = fetch_losses_if_observed(losses, aggregator)
             if train_specs is not None:
-                # per train-step UNIT: the counter advances by world_size per
-                # dispatched program (which runs g_total gradient steps)
-                flops = cost_flops_of(train_fn, *train_specs)
-                telemetry.set_train_flops(flops / world_size if flops else None)
+                # per train-step UNIT (FLOPs + bytes accessed): the counter
+                # advances by world_size per dispatched program (which runs
+                # g_total gradient steps)
+                register_train_cost(
+                    telemetry, train_fn, *train_specs, world_size=world_size
+                )
             if backend == "python":
                 play_actor = actor_mirror(agent_state["actor"])
             train_step += world_size
@@ -549,6 +552,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 world_size=world_size,
                 action_repeat=cfg.env.action_repeat,
             )
+            profile_tick(policy_step=policy_step, world_size=world_size)
             last_log = policy_step
             last_train = train_step
 

@@ -52,9 +52,10 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.envs.vector import make_eval_env
 from sheeprl_tpu.obs import (
-    cost_flops_of,
     get_telemetry,
     log_sps_metrics,
+    profile_tick,
+    register_train_cost,
     shape_specs,
     span,
 )
@@ -308,8 +309,10 @@ def main(fabric, cfg: Dict[str, Any]):
                 if telemetry is not None and telemetry.needs_train_flops():
                     # donation is off in decoupled mode; one AOT cost
                     # analysis, registered per train-step UNIT
-                    flops = cost_flops_of(train_fn, *shape_specs(train_args))
-                    telemetry.set_train_flops(flops / world_size if flops else None)
+                    register_train_cost(
+                        telemetry, train_fn, *shape_specs(train_args),
+                        world_size=world_size,
+                    )
                 train_step += world_size
                 # the parameter broadcast (reference :525-529): an atomic
                 # policy publication players hot-reload
@@ -348,6 +351,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     world_size=world_size,
                     action_repeat=cfg.env.action_repeat,
                 )
+                profile_tick(policy_step=policy_step, world_size=world_size)
                 last_log = policy_step
                 last_train = train_step
 

@@ -18,7 +18,11 @@ pieces (see ``howto/telemetry.md``):
   of every span duration (per-phase ``p50/p95/p99``);
 - :mod:`~sheeprl_tpu.obs.live` — the live plane: periodic atomic
   ``telemetry/live.json`` snapshots, an optional Prometheus endpoint, and
-  the anomaly-triggered flight recorder.
+  the anomaly-triggered flight recorder;
+- :mod:`~sheeprl_tpu.obs.prof` — device-time profiling: in-run xplane
+  capture + parsing, per-module attribution, and the roofline
+  (MFU / bandwidth / binding-constraint) accounting
+  (``howto/profiling.md``).
 
 Everything is configured by the ``metric.telemetry`` config group and
 defaults to off; disabled, the instrumented code paths reduce to the plain
@@ -59,11 +63,12 @@ from sheeprl_tpu.obs.perf import (
     PEAK_TFLOPS_BF16,
     LoopProbe,
     cost_flops,
-    cost_flops_of,
     log_sps_metrics,
     mfu_pct,
+    register_train_cost,
     shape_specs,
 )
+from sheeprl_tpu.obs.prof.capture import profile_tick
 from sheeprl_tpu.obs.spans import TraceWriter, get_tracer, set_tracer, span
 from sheeprl_tpu.obs.telemetry import (
     Telemetry,
@@ -100,7 +105,6 @@ __all__ = [
     "add_rollout_burst",
     "count_h2d",
     "cost_flops",
-    "cost_flops_of",
     "device_memory_stats",
     "finalize_telemetry",
     "get_telemetry",
@@ -108,8 +112,10 @@ __all__ = [
     "log_sps_metrics",
     "mfu_pct",
     "note_plane_policy_version",
+    "profile_tick",
     "profiler_capture",
     "prometheus_text",
+    "register_train_cost",
     "set_tracer",
     "setup_telemetry",
     "shape_specs",

@@ -432,20 +432,43 @@ class FlightRecorder:
         return path
 
     def _capture_window(self, out_dir: str) -> None:
+        # the StepProfiler (obs/prof/capture.py) may hold the process-wide
+        # jax.profiler slot — arbitrate through the shared guard so two
+        # capture paths never race a start_trace into a tracing runtime
+        from sheeprl_tpu.obs.prof.capture import end_capture, parse_and_fold, try_begin_capture
+
         with self._lock:
             if self._capturing:
                 return
             self._capturing = True
+        if not try_begin_capture():
+            with self._lock:
+                self._capturing = False
+            return
 
         def _run():
+            captured = False
             try:
                 with profiler_capture(out_dir):
                     time.sleep(self.profiler_capture_s)
+                captured = True
             except Exception:
                 pass  # a failed capture must never take the run down
             finally:
+                end_capture()
                 with self._lock:
                     self._capturing = False
+            if captured:
+                # auto-parse the anomaly trace: the roofline summary lands
+                # next to the dump instead of waiting for a hand-run parse
+                from sheeprl_tpu.obs.telemetry import get_telemetry
+
+                record = parse_and_fold(out_dir, get_telemetry())
+                if record is not None:
+                    try:
+                        atomic_write_json(f"{out_dir}_summary.json", record)
+                    except OSError:
+                        pass
 
         threading.Thread(
             target=_run, name="obs-flight-capture", daemon=True

@@ -20,9 +20,9 @@ __all__ = [
     "LoopProbe",
     "PEAK_TFLOPS_BF16",
     "cost_flops",
-    "cost_flops_of",
     "log_sps_metrics",
     "mfu_pct",
+    "register_train_cost",
     "shape_specs",
 ]
 
@@ -106,19 +106,39 @@ def shape_specs(tree: Any) -> Any:
     return jax.tree_util.tree_map(spec, tree)
 
 
-def cost_flops_of(jit_fn, *args, **kwargs) -> Optional[float]:
-    """FLOPs of ``jit_fn(*args)`` via AOT lower+compile, or None.
+def register_train_cost(
+    telemetry, jit_fn, *specs, world_size: int = 1, dispatches_per_step: int = 1
+) -> None:
+    """One AOT cost analysis of the train program, registered with the run
+    telemetry per train-step *unit*.
 
-    Pass :func:`shape_specs` of the arguments rather than live arrays when
-    the call donates buffers. The compile hits the in-memory executable cache
-    when the same program already ran, so this is cheap enough to call once
-    per run; it is still a retrace, so callers gate it on telemetry being
-    enabled.
+    The step counter advances by ``world_size`` per *training block*, which
+    dispatches the analyzed program ``dispatches_per_step`` times (1 for the
+    fused-burst families — DV3, SAC, PPO; ``per_rank_gradient_steps`` for
+    the families that loop a single-gradient-step program — DV1, DV2, P2E).
+    Registered cost = program cost × dispatches / world_size, so
+    ``flops_per_train_step × Δtrain_step`` is the per-device work actually
+    executed — the MFU numerator against the single-chip peak, and (with
+    bytes accessed) the roofline numerators for the in-run profiler
+    (``obs/prof``). Entrypoints call this once, gated on
+    :meth:`~sheeprl_tpu.obs.telemetry.Telemetry.needs_train_flops`; a
+    backend without a cost model records the attempt and stays quiet.
     """
-    try:
-        return cost_flops(jit_fn.lower(*args, **kwargs).compile())
-    except Exception:
-        return None
+    if telemetry is None:
+        return
+    from sheeprl_tpu.obs.prof.roofline import cost_of
+
+    cost = cost_of(jit_fn, *specs)
+    ws = max(int(world_size), 1)
+    dps = max(int(dispatches_per_step), 1)
+    if cost and cost.get("flops"):
+        telemetry.set_train_cost(
+            cost["flops"] * dps / ws,
+            (cost.get("bytes_accessed") or 0.0) * dps / ws or None,
+            dispatches_per_step=dps,
+        )
+    else:
+        telemetry.set_train_cost(None, None)
 
 
 def mfu_pct(

@@ -1,0 +1,69 @@
+"""Device-time profiling and roofline attribution (howto/profiling.md).
+
+The missing half of the PR-1/PR-4 telemetry plane: those measure *host*
+wall-time per phase; this package measures where the **device** time goes
+and what binds it. Four pieces:
+
+- :mod:`~sheeprl_tpu.obs.prof.xplane` — a self-contained parser for the
+  ``*.xplane.pb`` traces ``jax.profiler`` writes (no tensorflow import; the
+  proto wire format is decoded directly). Attributes profiled device time to
+  compiled XLA modules — per-module executions, total ms, ms/exec — with a
+  host-plane fallback so CPU runs profile too, and maps module names onto
+  the framework's phase names (train step, acting, rollout scan, staging).
+- :mod:`~sheeprl_tpu.obs.prof.roofline` — combines ``cost_analysis()``
+  FLOPs + bytes-accessed with measured device time into per-module MFU,
+  achieved bandwidth, and a compute-bound / memory-bound / dispatch-bound
+  verdict against a device-peak registry (CPU fallback included so the
+  analysis runs everywhere).
+- :mod:`~sheeprl_tpu.obs.prof.capture` — the in-run capture scheduler:
+  ``profile_tick`` (called by every entrypoint at its log boundary, linted
+  by ``tools/lint_telemetry.py``) opens a ``jax.profiler`` window every
+  ``metric.telemetry.profile.every_n_steps`` policy steps, auto-parses it,
+  and folds ``device_ms_per_step`` / ``mfu_device_pct`` /
+  ``roofline_verdict`` into ``telemetry.json`` and ``live.json``.
+- :mod:`~sheeprl_tpu.obs.prof.harness` — one builder of a family's real
+  train step on synthetic data (the setup previously copy-pasted across
+  ``bench_dreamer.py``, ``tools/profile_step.py`` and the ``diag_dv3_*``
+  one-offs), used by ``tools/roofline_report.py`` to produce the per-family
+  binding-constraint table.
+"""
+
+from sheeprl_tpu.obs.prof.capture import (
+    StepProfiler,
+    parse_and_fold,
+    profile_tick,
+    try_begin_capture,
+    end_capture,
+)
+from sheeprl_tpu.obs.prof.roofline import (
+    DEVICE_PEAKS,
+    cost_bytes,
+    cost_of,
+    detect_peaks,
+    roofline_analyze,
+)
+from sheeprl_tpu.obs.prof.xplane import (
+    find_xplane,
+    load_xspace,
+    phase_of,
+    summarize,
+    summarize_space,
+)
+
+__all__ = [
+    "DEVICE_PEAKS",
+    "StepProfiler",
+    "cost_bytes",
+    "cost_of",
+    "detect_peaks",
+    "end_capture",
+    "find_xplane",
+    "load_xspace",
+    "parse_and_fold",
+    "phase_of",
+    "profile_tick",
+    "roofline_analyze",
+    "summarize",
+    "summarize_space",
+    "try_begin_capture",
+]

@@ -1,0 +1,332 @@
+"""In-run device-profile capture: scheduled windows, auto-parse, fold-in.
+
+``tools/profile_step.py`` could always capture a trace — by hand, offline,
+for one hard-wired workload. This module makes the same capture a scheduled
+part of any run: every ``metric.telemetry.profile.every_n_steps`` policy
+steps (default off) the :class:`StepProfiler` opens the PR-4
+``profiler_capture`` window, bounds it by ``profile.window_s`` (a timer
+thread stops the trace so a slow log cadence cannot produce a gigabyte
+xplane), parses it with :mod:`~sheeprl_tpu.obs.prof.xplane`, runs the
+:mod:`~sheeprl_tpu.obs.prof.roofline` analysis against the registered train
+cost, and folds ``device_ms_per_step`` / ``mfu_device_pct`` /
+``roofline_verdict`` into ``telemetry.json`` + ``live.json`` (plus a
+per-capture ``telemetry/prof/capture_<step>.json`` artifact).
+
+Entrypoints drive it through one call — :func:`profile_tick`, placed at the
+same log boundary as ``log_sps_metrics`` and required there by
+``tools/lint_telemetry.py``. Everything is a no-op when telemetry or the
+profile group is off, and a failed capture/parse can never take a run down.
+
+``jax.profiler`` allows one active trace per process, and the PR-4 flight
+recorder opens capture windows of its own: both now arbitrate through
+:func:`try_begin_capture` / :func:`end_capture` so the two can never race a
+``start_trace`` into an already-tracing runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "StepProfiler",
+    "end_capture",
+    "parse_and_fold",
+    "profile_tick",
+    "try_begin_capture",
+]
+
+# one jax.profiler trace per process: shared by StepProfiler and the
+# flight recorder's anomaly capture window
+_CAPTURE_LOCK = threading.Lock()
+_CAPTURE_ACTIVE = False
+
+
+def try_begin_capture() -> bool:
+    """Claim the process-wide profiler slot; False when a capture is live."""
+    global _CAPTURE_ACTIVE
+    with _CAPTURE_LOCK:
+        if _CAPTURE_ACTIVE:
+            return False
+        _CAPTURE_ACTIVE = True
+        return True
+
+
+def end_capture() -> None:
+    global _CAPTURE_ACTIVE
+    with _CAPTURE_LOCK:
+        _CAPTURE_ACTIVE = False
+
+
+def analyze_trace(
+    trace_dir: str,
+    flops_per_step: Optional[float] = None,
+    bytes_per_step: Optional[float] = None,
+    world_size: int = 1,
+    dispatches_per_step: int = 1,
+    peaks: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Parse one capture directory and run the roofline on its train module.
+
+    ``flops_per_step`` / ``bytes_per_step`` are per train-step *unit* (the
+    ``set_train_cost`` convention: program cost × dispatches_per_step /
+    world_size, with the step counter advancing by world_size per training
+    block), so per-dispatch cost is ``× world_size / dispatches_per_step``
+    and per-unit device time is ``× dispatches_per_step / world_size``.
+    """
+    from sheeprl_tpu.obs.prof.roofline import roofline_analyze
+    from sheeprl_tpu.obs.prof.xplane import find_xplane, load_xspace, summarize_space
+
+    summary = summarize_space(load_xspace(find_xplane(trace_dir)))
+    train = summary["train_module"]
+    rec = summary["modules"].get(train) if train else None
+    ms_per_exec = rec["ms_per_exec"] if rec else None
+    ws = max(int(world_size), 1)
+    dps = max(int(dispatches_per_step), 1)
+    roofline = roofline_analyze(
+        flops_per_exec=flops_per_step * ws / dps if flops_per_step else None,
+        bytes_per_exec=bytes_per_step * ws / dps if bytes_per_step else None,
+        device_ms_per_exec=ms_per_exec,
+        busy_frac=summary["busy_frac"],
+        peaks=peaks,
+    )
+    top = sorted(
+        summary["modules"].items(), key=lambda kv: kv[1]["total_ms"], reverse=True
+    )[:8]
+    return {
+        "trace_dir": trace_dir,
+        "source": summary["source"],
+        "train_module": train,
+        "device_ms_per_step": (
+            round(ms_per_exec * dps / ws, 3) if ms_per_exec is not None else None
+        ),
+        "mfu_device_pct": roofline["mfu_pct"],
+        "achieved_gbps": roofline["achieved_gbps"],
+        "bandwidth_util_pct": roofline["bandwidth_util_pct"],
+        "arithmetic_intensity": roofline["arithmetic_intensity"],
+        "roofline_verdict": roofline["verdict"],
+        "peaks": roofline["peaks"],
+        "window_ms": summary["window_ms"],
+        "busy_frac": summary["busy_frac"],
+        "modules": {
+            name: {
+                "phase": m["phase"],
+                "execs": m["execs"],
+                "ms_per_exec": round(m["ms_per_exec"], 3),
+                "total_ms": round(m["total_ms"], 3),
+            }
+            for name, m in top
+        },
+    }
+
+
+def parse_and_fold(
+    trace_dir: str, telemetry=None, world_size: Optional[int] = None
+) -> Optional[Dict[str, Any]]:
+    """Best-effort: analyze a finished capture and fold it into ``telemetry``
+    (used by the flight recorder after its anomaly capture window). Returns
+    the record, or None when the trace is unreadable.
+
+    World size and peak overrides come from the telemetry when not given —
+    ``profile_tick`` records the caller's world_size, and the StepProfiler
+    carries the ``profile.peak_*`` config — so an anomaly capture scales
+    and classifies identically to a scheduled one."""
+    prof = getattr(telemetry, "prof", None)
+    try:
+        from sheeprl_tpu.obs.prof.roofline import detect_peaks
+
+        record = analyze_trace(
+            trace_dir,
+            flops_per_step=getattr(telemetry, "flops_per_train_step", None),
+            bytes_per_step=getattr(telemetry, "bytes_per_train_step", None),
+            world_size=world_size or getattr(telemetry, "last_world_size", 1),
+            dispatches_per_step=getattr(telemetry, "dispatches_per_train_step", 1),
+            peaks=detect_peaks(
+                getattr(prof, "peak_tflops", None), getattr(prof, "peak_gbps", None)
+            ),
+        )
+    except Exception:
+        return None
+    if telemetry is not None:
+        telemetry.record_prof(record)
+    return record
+
+
+class StepProfiler:
+    """Scheduled in-run capture windows, parsed and folded as they land.
+
+    State machine (one capture at a time): ``tick`` starts a capture when
+    ``policy_step`` crosses the next schedule point; a timer thread bounds
+    the window at ``window_s`` (stopping the trace exactly the way the
+    flight recorder's capture window does); whichever of the timer or the
+    next ``tick`` runs first finalizes — stop, parse, roofline, fold. A
+    short run that never reaches another boundary is finalized by
+    :meth:`close` from ``Telemetry.finalize``, so a profiled run always
+    lands its numbers.
+    """
+
+    def __init__(self, pcfg: Dict[str, Any], telemetry):
+        pcfg = dict(pcfg or {})
+        self.every_n_steps = int(pcfg.get("every_n_steps", 0) or 0)
+        ws = pcfg.get("window_s", 10.0)
+        #: 0/null = no timer cap — the window runs to the next log boundary
+        self.window_s = float(ws) if ws else 0.0
+        mc = pcfg.get("max_captures", 4)
+        self.max_captures = int(mc) if mc is not None else 4
+        self.peak_tflops = pcfg.get("peak_tflops") or None
+        self.peak_gbps = pcfg.get("peak_gbps") or None
+        self.enabled = self.every_n_steps > 0
+        self.telemetry = telemetry
+        self.captures = 0
+        self.failed = 0
+        self.last: Optional[Dict[str, Any]] = None
+        self._next_at = self.every_n_steps
+        self._lock = threading.Lock()
+        self._active: Optional[Dict[str, Any]] = None
+        self._parse_threads: list = []
+
+    # -- the entrypoint hook --------------------------------------------------
+
+    def tick(self, policy_step: int, world_size: int = 1) -> None:
+        if not self.enabled:
+            return
+        if self._active is not None:
+            self._finalize()
+            return
+        # failed attempts count toward the cap too: a persistently
+        # unparseable trace must not re-open profiler windows all run long
+        if policy_step >= self._next_at and self.captures + self.failed < self.max_captures:
+            self._start(policy_step, world_size)
+            # schedule strictly forward even if boundaries lag the cadence
+            while self._next_at <= policy_step:
+                self._next_at += self.every_n_steps
+
+    def _start(self, policy_step: int, world_size: int) -> None:
+        run_dir = getattr(self.telemetry, "run_dir", None)
+        if run_dir is None or not try_begin_capture():
+            return
+        out_dir = os.path.join(run_dir, "telemetry", "prof", f"step_{policy_step}")
+        try:
+            import jax
+
+            jax.profiler.start_trace(os.path.abspath(out_dir))
+        except Exception:
+            end_capture()
+            return
+        timer = None
+        if self.window_s > 0:
+            timer = threading.Timer(self.window_s, self._stop_trace)
+            timer.daemon = True
+        with self._lock:
+            self._active = {
+                "dir": out_dir,
+                "step": int(policy_step),
+                "world_size": max(int(world_size), 1),
+                "timer": timer,
+                "stopped": False,
+                # set once stop_trace has RETURNED (the xplane is on disk):
+                # a finalize racing the timer thread must not parse earlier
+                "stop_done": threading.Event(),
+            }
+        if timer is not None:
+            timer.start()
+
+    def _stop_trace(self) -> bool:
+        """Stop the live trace exactly once; True when this call stopped it.
+
+        Releases the process-wide capture guard as soon as the trace is
+        stopped — the parse only needs the directory, and holding the slot
+        until the next tick would refuse a flight-recorder anomaly window
+        for minutes on a slow log cadence."""
+        with self._lock:
+            active = self._active
+            if active is None or active["stopped"]:
+                return False
+            active["stopped"] = True
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        end_capture()
+        active["stop_done"].set()
+        return True
+
+    def _finalize(self, wait: bool = False) -> None:
+        self._stop_trace()  # no-op (incl. the guard release) if the timer won
+        with self._lock:
+            active, self._active = self._active, None
+        if active is None:
+            return
+        if active["timer"] is not None:
+            active["timer"].cancel()
+
+        def _work() -> None:
+            # if the timer thread won the stop race, its stop_trace may still
+            # be serializing the xplane — parsing before it lands loses the
+            # capture
+            active["stop_done"].wait(timeout=30.0)
+            record = parse_and_fold(
+                active["dir"], self.telemetry, world_size=active["world_size"]
+            )
+            with self._lock:
+                if record is None:
+                    self.failed += 1
+                else:
+                    record["step"] = active["step"]  # _prof_last holds this dict
+                    self.captures += 1
+                    self.last = record
+            if record is None:
+                return
+            try:
+                from sheeprl_tpu.obs.live import atomic_write_json
+
+                atomic_write_json(
+                    os.path.join(os.path.dirname(active["dir"]), f"capture_{active['step']}.json"),
+                    record,
+                )
+            except OSError:
+                pass  # a full disk must not take the run down
+
+        if wait:
+            _work()
+            return
+        # a big trace decodes in pure Python for seconds — off the training
+        # thread (the flight recorder's capture does the same); close() joins
+        thread = threading.Thread(target=_work, name="obs-prof-parse", daemon=True)
+        with self._lock:
+            self._parse_threads = [t for t in self._parse_threads if t.is_alive()]
+            self._parse_threads.append(thread)
+        thread.start()
+
+    def close(self) -> None:
+        """Finalize any in-flight capture and join EVERY in-flight parse
+        (Telemetry.finalize calls this before assembling the summary — a
+        slow earlier parse must land its numbers too, not just the newest)."""
+        if self._active is not None:
+            self._finalize(wait=True)
+        with self._lock:
+            threads = list(self._parse_threads)
+        for thread in threads:
+            if thread.is_alive():
+                thread.join(timeout=60.0)
+
+
+def profile_tick(*, policy_step: int, world_size: int = 1) -> None:
+    """The per-entrypoint profiling hook: advance the in-run capture
+    scheduler. Call at the same log boundary as ``log_sps_metrics``
+    (``tools/lint_telemetry.py`` enforces the pairing); a no-op unless
+    ``metric.telemetry.profile.every_n_steps`` is set."""
+    from sheeprl_tpu.obs.telemetry import get_telemetry
+
+    telemetry = get_telemetry()
+    if telemetry is None:
+        return
+    # remembered so an anomaly (flight-recorder) capture parsed outside any
+    # tick scales per-unit numbers with the run's real world size
+    telemetry.last_world_size = max(int(world_size), 1)
+    prof = getattr(telemetry, "prof", None)
+    if prof is not None:
+        prof.tick(policy_step, world_size)

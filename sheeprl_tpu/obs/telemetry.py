@@ -44,6 +44,14 @@ key                       meaning
                           fell back to in-process sync stepping
 ``phase_percentiles``     per-phase ``p50/p95/p99`` span durations (ms) from
                           the streaming histograms (``obs/hist.py``)
+``device_ms_per_step``    profiled device time per train-step unit from the
+                          latest in-run capture (``obs/prof``; null until a
+                          ``metric.telemetry.profile`` window landed)
+``mfu_device_pct``        MFU against measured *device* time (vs ``mfu``'s
+                          timed-wall basis) from the same capture
+``roofline_verdict``      ``compute-bound`` / ``memory-bound`` /
+                          ``dispatch-bound`` binding-constraint verdict
+``prof_captures``         in-run profile captures parsed this run
 ``flight_dumps``          flight-recorder evidence files written
 ``crashed``               True when the entrypoint raised; ``exception``
                           then carries the type and message
@@ -108,6 +116,7 @@ class Telemetry:
         )
         self.histograms_enabled = bool(tcfg.get("histograms", True))
         self._flight_cfg = dict(tcfg.get("flight", {}) or {})
+        self._profile_cfg = dict(tcfg.get("profile", {}) or {})
 
         self.counters = _counters.Counters()
         self.tracer: Optional[TraceWriter] = None
@@ -136,11 +145,27 @@ class Telemetry:
         #: per-device FLOPs actually executed — the MFU numerator against the
         #: single-chip `peak_tflops`
         self.flops_per_train_step: Optional[float] = None
+        #: bytes accessed per train-step unit (same convention) — the
+        #: bandwidth numerator of the in-run roofline (obs/prof)
+        self.bytes_per_train_step: Optional[float] = None
+        #: program dispatches per train-step unit (families that loop a
+        #: single-gradient-step program register per_rank_gradient_steps)
+        self.dispatches_per_train_step = 1
         self._flops_attempted = False
+        # in-run device-profile capture (obs/prof/capture.py); built in
+        # start() so profile_tick is a no-op on un-instrumented runs
+        self.prof = None
+        self._prof_last: Optional[Dict[str, Any]] = None
+        #: last world_size seen at a profile_tick — anomaly-capture parses
+        #: (obs/prof.parse_and_fold) scale per-unit numbers with it
+        self.last_world_size = 1
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
+        from sheeprl_tpu.obs.prof.capture import StepProfiler
+
+        self.prof = StepProfiler(self._profile_cfg, self)
         _counters.install(self.counters)
         if self.poll_interval_s > 0:
             self.poller = _counters.DevicePoller(self.poll_interval_s)
@@ -322,6 +347,42 @@ class Telemetry:
         if flops_per_step:
             self.flops_per_train_step = float(flops_per_step)
 
+    def set_train_cost(
+        self,
+        flops_per_step: Optional[float],
+        bytes_per_step: Optional[float] = None,
+        dispatches_per_step: int = 1,
+    ) -> None:
+        """Register the train program's full analytic cost (FLOPs + bytes
+        accessed, per train-step unit) — ``obs.register_train_cost`` calls
+        this; the bytes side feeds the roofline's bandwidth axis and
+        ``dispatches_per_step`` maps profiled per-execution device time back
+        onto train-step units (obs/prof/capture.py)."""
+        self.set_train_flops(flops_per_step)
+        if bytes_per_step:
+            self.bytes_per_train_step = float(bytes_per_step)
+        self.dispatches_per_train_step = max(int(dispatches_per_step), 1)
+
+    def record_prof(self, record: Dict[str, Any]) -> None:
+        """Latest in-run profile result (StepProfiler / flight-recorder
+        capture) — folded into summary(), live.json, and telemetry.json.
+        A window that caught no train execution (``device_ms_per_step``
+        null) never replaces an earlier measured one, and a slow parse of an
+        OLD capture landing out of order never replaces a newer measured
+        one: the run summary keeps the best, freshest evidence."""
+        prev = self._prof_last
+        if record.get("device_ms_per_step") is None and prev is not None:
+            return
+        if (
+            prev is not None
+            and prev.get("device_ms_per_step") is not None
+            and isinstance(prev.get("step"), int)
+            and isinstance(record.get("step"), int)
+            and record["step"] < prev["step"]
+        ):
+            return
+        self._prof_last = record
+
     def needs_train_flops(self) -> bool:
         """Should the algorithm spend one AOT cost-analysis on its program?"""
         return not self._flops_attempted and self.flops_per_train_step is None
@@ -353,6 +414,7 @@ class Telemetry:
             ),
             "mfu_peak_tflops": self.peak_tflops,
             "flops_per_train_step": self.flops_per_train_step,
+            "bytes_per_train_step": self.bytes_per_train_step,
             "env_seconds": round(self.env_seconds, 3),
             "train_seconds": round(self.train_seconds, 3),
             "stage_seconds": round(self.stage_seconds, 3),
@@ -368,6 +430,28 @@ class Telemetry:
         )
         out["flight_dumps"] = self.flight.dumps if self.flight is not None else 0
         out["flight_suppressed"] = self.flight.suppressed if self.flight is not None else 0
+        # in-run device profile (obs/prof): the latest capture's headline
+        # numbers as first-class summary keys, the detail as a sub-dict
+        p = self._prof_last
+        out["device_ms_per_step"] = p.get("device_ms_per_step") if p else None
+        out["mfu_device_pct"] = p.get("mfu_device_pct") if p else None
+        out["roofline_verdict"] = p.get("roofline_verdict") if p else None
+        out["prof_captures"] = self.prof.captures if self.prof is not None else 0
+        if p is not None:
+            out["prof"] = {
+                k: p.get(k)
+                for k in (
+                    "step",
+                    "source",
+                    "train_module",
+                    "achieved_gbps",
+                    "bandwidth_util_pct",
+                    "arithmetic_intensity",
+                    "busy_frac",
+                    "window_ms",
+                )
+            }
+            out["prof"]["peaks"] = (p.get("peaks") or {}).get("label")
         if self.tracer is not None and self.tracer.path:
             out["trace_file"] = self.tracer.path
         return out
@@ -404,6 +488,8 @@ class Telemetry:
         if self._finalized:
             return None
         self._finalized = True
+        if self.prof is not None:
+            self.prof.close()  # an in-flight capture still lands its numbers
         for dog in self._watchdogs:
             dog.stop()
         if self.prom is not None:
@@ -472,6 +558,16 @@ class Telemetry:
             + (f" · MFU {s['mfu']}%" if s["mfu"] is not None else "")
             + f" · non-finite {s['nonfinite_metrics']} · stalls {s['stalls']}",
         ]
+        if s.get("device_ms_per_step") is not None:
+            lines.append(
+                f"  device {s['device_ms_per_step']} ms/step"
+                + (
+                    f" · MFU(dev) {s['mfu_device_pct']}%"
+                    if s.get("mfu_device_pct") is not None
+                    else ""
+                )
+                + f" · {s.get('roofline_verdict')}"
+            )
         if s.get("env_steps_async") or s.get("env_worker_restarts"):
             lines.append(
                 f"  async envs: {s['env_steps_async']} steps · "

@@ -1,0 +1,142 @@
+"""Regenerate the miniature xplane fixtures for the parser golden tests.
+
+    python tests/test_obs/fixtures/make_mini_xplane.py
+
+Hand-encodes the protobuf wire format (the same schema subset
+``sheeprl_tpu/obs/prof/xplane.py`` decodes — encoder and decoder are
+deliberately independent implementations so the golden test exercises real
+wire bytes, not a round-trip through the parser's own writer).
+
+Two fixtures:
+
+- ``mini.xplane.pb`` — a TPU device plane: an ``XLA Modules`` line with 3
+  executions of ``jit_train_step(1)`` at 4 ms each over a 14 ms window, a
+  ``Steps`` line (3 × 4.5 ms), and an ``XLA Ops`` line with a nested pair
+  (``fusion.1`` 4 ms containing ``fusion.2`` 1 ms) plus a ``copy.3``
+  (0.5 ms) for the stack-sweep self-time check.
+- ``mini_host.xplane.pb`` — a CPU host plane: ``PjitFunction(shmapped)``
+  dispatch spans emitted as nested near-duplicate pairs (what jax 0.4.37
+  actually writes), which the outermost-merge must collapse to 2
+  executions of 2 ms.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field: int, wire: int) -> bytes:
+    return varint((field << 3) | wire)
+
+
+def field_varint(field: int, value: int) -> bytes:
+    return tag(field, 0) + varint(value)
+
+
+def field_bytes(field: int, payload: bytes) -> bytes:
+    return tag(field, 2) + varint(len(payload)) + payload
+
+
+def field_str(field: int, s: str) -> bytes:
+    return field_bytes(field, s.encode())
+
+
+def event(meta_id: int, offset_ps: int, dur_ps: int) -> bytes:
+    return field_varint(1, meta_id) + field_varint(2, offset_ps) + field_varint(3, dur_ps)
+
+
+def line(name: str, events) -> bytes:
+    payload = field_str(2, name)
+    for ev in events:
+        payload += field_bytes(4, event(*ev))
+    return payload
+
+
+def event_metadata_entry(meta_id: int, name: str) -> bytes:
+    meta = field_varint(1, meta_id) + field_str(2, name)
+    return field_varint(1, meta_id) + field_bytes(2, meta)
+
+
+def plane(name: str, lines, metadata) -> bytes:
+    payload = field_str(2, name)
+    for ln in lines:
+        payload += field_bytes(3, ln)
+    for meta_id, meta_name in metadata.items():
+        payload += field_bytes(4, event_metadata_entry(meta_id, meta_name))
+    return payload
+
+
+def xspace(planes) -> bytes:
+    return b"".join(field_bytes(1, p) for p in planes)
+
+
+MS = 10**9  # ps per ms
+
+
+def device_fixture() -> bytes:
+    metadata = {1: "jit_train_step(1)", 2: "fusion.1", 3: "fusion.2", 4: "copy.3", 5: "1"}
+    modules = line(
+        "XLA Modules",
+        [(1, 0, 4 * MS), (1, 5 * MS, 4 * MS), (1, 10 * MS, 4 * MS)],
+    )
+    steps = line(
+        "Steps",
+        [(5, 0, 9 * MS // 2), (5, 5 * MS, 9 * MS // 2), (5, 10 * MS, 9 * MS // 2)],
+    )
+    ops = line(
+        "XLA Ops",
+        [
+            (2, 0, 4 * MS),            # fusion.1: 4 ms total ...
+            (3, 1 * MS, 1 * MS),       # ... containing fusion.2 (1 ms)
+            (4, 5 * MS, MS // 2),      # copy.3: 0.5 ms
+        ],
+    )
+    return xspace(
+        [plane("/device:TPU:0 (e)", [modules, steps, ops], metadata)]
+    )
+
+
+def host_fixture() -> bytes:
+    metadata = {1: "PjitFunction(shmapped)", 2: "TfrtCpuExecutable::Execute"}
+    # each dispatch = nested near-duplicate PjitFunction pair (observed jax
+    # 0.4.37 behavior) + an unrelated Execute span the parser must ignore
+    python_line = line(
+        "python",
+        [
+            (1, 0, 2 * MS),
+            (1, MS // 20, 2 * MS - MS // 10),
+            (2, MS // 10, 2 * MS - MS // 5),
+            (1, 3 * MS, 2 * MS),
+            (1, 3 * MS + MS // 20, 2 * MS - MS // 10),
+            (2, 3 * MS + MS // 10, 2 * MS - MS // 5),
+        ],
+    )
+    return xspace([plane("/host:CPU", [python_line], metadata)])
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name, payload in (
+        ("mini.xplane.pb", device_fixture()),
+        ("mini_host.xplane.pb", host_fixture()),
+    ):
+        path = os.path.join(here, name)
+        with open(path, "wb") as f:
+            f.write(payload)
+        print(f"wrote {path} ({len(payload)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -108,6 +108,75 @@ def test_ppo_run_with_telemetry_writes_trace_and_summary(tmp_path, monkeypatch):
     assert get_tracer() is None
 
 
+def test_sac_profiled_run_lands_device_ms_in_telemetry(tmp_path, monkeypatch):
+    """In-run device profiling end-to-end (obs/prof): a SAC CPU run with
+    ``metric.telemetry.profile.every_n_steps`` set must capture an xplane
+    window at a log boundary, auto-parse it (CPU host-plane fallback), and
+    land ``device_ms_per_step`` + a roofline verdict in telemetry.json plus
+    a per-capture artifact under telemetry/prof/."""
+    monkeypatch.chdir(tmp_path)
+    cli.run(
+        [
+            "exp=sac",
+            "env=gym",
+            "env.id=Pendulum-v1",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "env.num_envs=1",
+            "dry_run=False",
+            "total_steps=64",
+            "per_rank_batch_size=4",
+            "algo.learning_starts=2",
+            "algo.hidden_size=8",
+            "algo.run_test=False",
+            "fabric.devices=1",
+            "fabric.accelerator=cpu",
+            "buffer.size=128",
+            "buffer.memmap=False",
+            "checkpoint.every=1000000",
+            "checkpoint.save_last=False",
+            "metric.log_every=16",
+            "metric.telemetry.enabled=true",
+            "metric.telemetry.live_interval_s=0",
+            "metric.telemetry.poll_interval_s=0",
+            "metric.telemetry.profile.every_n_steps=8",
+            f"root_dir={tmp_path}/logs",
+            "run_name=prof_e2e",
+        ]
+    )
+
+    (summary_path,) = glob.glob(
+        os.path.join("logs", "runs", f"{tmp_path}/logs", "prof_e2e", "*", "telemetry.json")
+    )
+    summary = json.load(open(summary_path))
+    assert summary["prof_captures"] >= 1
+    assert summary["device_ms_per_step"] is not None
+    assert summary["device_ms_per_step"] > 0
+    assert summary["roofline_verdict"] in (
+        "compute-bound", "memory-bound", "dispatch-bound", "unknown"
+    )
+    # the cost side registered, so the device-time MFU is computable too
+    assert summary["flops_per_train_step"]
+    assert summary["bytes_per_train_step"]
+    assert summary["mfu_device_pct"] is not None
+    prof = summary["prof"]
+    assert prof["source"] in ("host", "device")
+    assert prof["train_module"]  # the SAC train program was attributed
+    # per-capture artifact next to the trace
+    artifacts = glob.glob(
+        os.path.join(os.path.dirname(summary_path), "telemetry", "prof", "capture_*.json")
+    )
+    assert artifacts, "expected a telemetry/prof/capture_<step>.json artifact"
+    # the summary holds the LAST capture; glob order is filesystem-dependent
+    latest = max(artifacts, key=lambda p: int(p.rsplit("_", 1)[1].split(".")[0]))
+    record = json.load(open(latest))
+    assert record["device_ms_per_step"] == summary["device_ms_per_step"]
+
+    from sheeprl_tpu.obs.telemetry import get_telemetry
+
+    assert get_telemetry() is None  # torn down
+
+
 def test_crash_path_records_exception_in_telemetry_json(tmp_path, monkeypatch):
     """When the entrypoint raises, the finally-path finalize must still write
     telemetry.json, with ``crashed: true`` and the exception type next to the

@@ -150,6 +150,43 @@ def test_bench_compare_with_fewer_than_two_rounds_is_a_noop(tmp_path):
     assert bench_compare.main(["--dir", str(tmp_path)]) == 0
 
 
+def test_bench_compare_diffs_profiled_device_time_and_mfu(tmp_path, capsys):
+    """The roofline sub-metrics ride the evidence lines unit-directionally:
+    device_ms_per_step is lower-better, mfu_pct higher-better — a line whose
+    wall-clock held steady but whose profiled device time bloated >10% must
+    still flag."""
+    bench_compare = _load_tool("bench_compare")
+    _write_round(
+        tmp_path,
+        1,
+        [
+            {"metric": "dv3", "value": 50.0, "unit": "steps/s",
+             "device_ms_per_step": 10.0, "mfu_pct": 30.0},
+            {"metric": "sac", "value": 20.0, "unit": "s",
+             "telemetry": {"device_ms_per_step": 4.0, "mfu_device_pct": 12.0}},
+        ],
+    )
+    _write_round(
+        tmp_path,
+        2,
+        [
+            # wall rate unchanged, device time 20% slower + MFU 20% lower
+            {"metric": "dv3", "value": 50.0, "unit": "steps/s",
+             "device_ms_per_step": 12.0, "mfu_pct": 24.0},
+            # telemetry-folded variant improves: no flag
+            {"metric": "sac", "value": 20.0, "unit": "s",
+             "telemetry": {"device_ms_per_step": 3.8, "mfu_device_pct": 13.0}},
+        ],
+    )
+    rc = bench_compare.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSION dv3.device_ms_per_step" in out
+    assert "REGRESSION dv3.mfu_pct" in out
+    assert "REGRESSION sac" not in out
+    assert "telemetry.device_ms_per_step" in out  # diffed, just not flagged
+
+
 # -- lint_telemetry ad-hoc clock rule ----------------------------------------
 
 

@@ -79,17 +79,31 @@ def goodness_change(old: Dict[str, Any], new: Dict[str, Any]) -> Optional[float]
 
 def _sub_metrics(line: Dict[str, Any]) -> Dict[str, Tuple[float, bool]]:
     """Diffable sub-metrics riding on an evidence line beyond ``value``:
-    the computed ``sps`` (higher-better) and the folded phase tails
-    (``telemetry.*_p50_ms``/``*_p95_ms``, lower-better) — so a line like
-    the plane's carries regression coverage for its latency decomposition,
-    not just its wall-clock."""
+    the computed ``sps`` (higher-better), the folded phase tails
+    (``telemetry.*_p50_ms``/``*_p95_ms``, lower-better), and the profiled
+    roofline numbers (``device_ms_per_step`` lower-better, ``mfu_pct``
+    higher-better — on the line itself or folded under ``telemetry``) — so
+    a bench line carries regression coverage for its device-time
+    decomposition, not just its wall-clock."""
     out: Dict[str, Tuple[float, bool]] = {}
     if isinstance(line.get("sps"), (int, float)):
         out["sps"] = (float(line["sps"]), True)
+    # profiled device time / MFU on the evidence line itself (bench_dreamer)
+    for key, higher in (
+        ("device_ms_per_step", False),
+        ("mfu_pct", True),
+        ("mfu_device_pct", True),
+    ):
+        if isinstance(line.get(key), (int, float)) and line[key] > 0:
+            out[key] = (float(line[key]), higher)
     tel = line.get("telemetry")
     if isinstance(tel, dict):
         for key, val in tel.items():
-            if key.endswith("_ms") and isinstance(val, (int, float)) and val > 0:
+            if not isinstance(val, (int, float)) or val <= 0:
+                continue
+            if key in ("mfu", "mfu_pct", "mfu_device_pct"):
+                out[f"telemetry.{key}"] = (float(val), True)
+            elif key.endswith("_ms") or key == "device_ms_per_step":
                 out[f"telemetry.{key}"] = (float(val), False)
     return out
 

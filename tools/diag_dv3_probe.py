@@ -11,41 +11,41 @@ imagination path (prior/recurrent/reward wiring) loses the action.
 Also reports the reward head on the TRAINING posteriors (should track the
 data rewards) for contrast.
 """
-import importlib
 import sys
 
-import gymnasium as gym
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 sys.path.insert(0, "/root/repo")
-from sheeprl_tpu.config.engine import compose
-from sheeprl_tpu.fabric import Fabric
 from tests.test_algos.test_policy_improvement import _SIZES, _action_reward_batch
 
 N_STEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 170
 
-cfg = compose("config", overrides=[
-    "exp=dreamer_v3", "env=dummy", "env.id=discrete_dummy", *_SIZES,
-    "algo.world_model.stochastic_size=8",
-    "algo.world_model.discrete_size=8",
-    "algo.actor.optimizer.lr=1e-2",
-])
-fabric = Fabric(devices=1, accelerator="cpu")
-agent_mod = importlib.import_module("sheeprl_tpu.algos.dreamer_v3.agent")
-algo_mod = importlib.import_module("sheeprl_tpu.algos.dreamer_v3.dreamer_v3")
+# setup through the shared profile harness (obs/prof/harness.py) — the same
+# compose -> Fabric -> build_agent -> build_train_fn wiring this tool used
+# to hand-roll; the probe keeps its own action-0-pays batch and train loop
+from sheeprl_tpu.obs.prof.harness import build_harness
+
 from sheeprl_tpu.algos.dreamer_v3.agent import WorldModel
 from sheeprl_tpu.distributions.distributions import TwoHotEncodingDistribution
 
-obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
-world_model, actor, critic, params = agent_mod.build_agent(
-    cfg, (4,), False, obs_space, jax.random.PRNGKey(0)
+_h = build_harness(
+    "dv3",
+    exp="dreamer_v3",
+    actions=4,
+    overrides=[
+        *_SIZES,
+        "algo.world_model.stochastic_size=8",
+        "algo.world_model.discrete_size=8",
+        "algo.actor.optimizer.lr=1e-2",
+        "fabric.accelerator=cpu",
+    ],
 )
-world_tx, actor_tx, critic_tx, agent_state = algo_mod.build_optimizers_and_state(cfg, params)
-train_fn = algo_mod.build_train_fn(
-    world_model, actor, critic, world_tx, actor_tx, critic_tx, cfg, fabric, (4,), False
-)
+cfg, fabric = _h.cfg, _h.fabric
+world_model, actor, critic = (_h.pieces[k] for k in ("world_model", "actor", "critic"))
+train_fn = _h.pieces["train_fn"]
+agent_state = _h.state
 rng = np.random.default_rng(0)
 np_batch = _action_reward_batch(16, 8, 4, rng, True)
 batch = {k: jnp.asarray(v) for k, v in np_batch.items()}

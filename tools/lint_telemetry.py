@@ -16,7 +16,12 @@ lint fails when a file under ``sheeprl_tpu/algos/`` re-grows its own copy:
   ``time.monotonic()``, under any import alias) — the span phases already
   time the hot loops and feed the streaming histograms/flight recorder;
   private deltas measure the same thing invisibly. For the env-gated
-  loop-latency printout use ``obs.LoopProbe``.
+  loop-latency printout use ``obs.LoopProbe``;
+- a ``log_sps_metrics`` call without a matching ``profile_tick`` call in
+  the same file — the in-run device-profile scheduler (``obs/prof``)
+  advances at the log boundary, so an entrypoint that logs rates but never
+  ticks the profiler silently opts out of ``device_ms_per_step``/roofline
+  coverage.
 
 AST-based, so comments and docstrings mentioning the metric names are fine.
 
@@ -65,12 +70,35 @@ def _clock_aliases(tree: ast.AST) -> tuple:
     return modules, names
 
 
+def _call_names(tree: ast.AST) -> dict:
+    """Called-function name -> first call line number."""
+    out: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if name is not None and name not in out:
+                out[name] = node.lineno
+    return out
+
+
 def lint_file(path: str) -> list:
     src = open(path).read()
     tree = ast.parse(src, filename=path)
     docstrings = _docstring_nodes(tree)
     clock_modules, clock_names = _clock_aliases(tree)
     findings = []
+    calls = _call_names(tree)
+    if "log_sps_metrics" in calls and "profile_tick" not in calls:
+        findings.append(
+            (calls["log_sps_metrics"],
+             "log_sps_metrics without profile_tick — the in-run profiler "
+             "(sheeprl_tpu.obs.profile_tick) must advance at the same log "
+             "boundary or this entrypoint has no device_ms_per_step/roofline "
+             "coverage")
+        )
     for node in ast.walk(tree):
         if (
             isinstance(node, ast.Constant)

@@ -1,73 +1,24 @@
 """Summarize a jax.profiler xplane trace: per-step device time + hottest ops.
 
-    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python tools/parse_xplane.py <trace_dir> [n_steps]
+    python tools/parse_xplane.py <trace_dir> [n_steps]
 
-Reads the newest ``*.xplane.pb`` under <trace_dir>/plugins/profile/*/ with
-the proto bundled in tensorflow (the tensorboard-plugin-profile converter is
-version-incompatible in this image). Self-times are computed with a stack
-sweep over the nested 'XLA Ops' events; 'Async XLA Ops' durations overlap
-and must not be summed.
+Thin CLI shim over :mod:`sheeprl_tpu.obs.prof.xplane` — the parser proper
+(self-contained protobuf wire decoding, no tensorflow import or
+``PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION`` dance, TPU/GPU device plane with
+a CPU host-plane fallback) lives in the package so the in-run profiler,
+``bench_dreamer.py``, ``tools/roofline_report.py``, and this tool share one
+implementation. ``summarize`` keeps its legacy name and divide-by-n output
+keys for existing consumers.
 """
 
 from __future__ import annotations
 
-import collections
-import glob
+import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def summarize(trace_dir: str, n_steps: int = 5) -> dict:
-    """Parse the newest xplane under ``trace_dir``.
-
-    Returns ``{"modules_us_per_step", "steps_us_per_step", "top_ops"}`` —
-    ``modules_us_per_step`` (the 'XLA Modules' line) is the trustworthy
-    per-step device time; ``top_ops`` maps op name -> self-time us/step.
-    Requires ``PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python`` to be set
-    before any protobuf import (the caller's job).
-    """
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-    files = sorted(glob.glob(f"{trace_dir}/plugins/profile/*/*.xplane.pb"))
-    if not files:
-        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
-    xs = xplane_pb2.XSpace()
-    with open(files[-1], "rb") as f:
-        xs.ParseFromString(f.read())
-    plane = next((p for p in xs.planes if "TPU" in p.name or "GPU" in p.name), None)
-    if plane is None:
-        raise FileNotFoundError(
-            f"no TPU/GPU plane in {files[-1]} (planes: {[p.name for p in xs.planes]})"
-            " — device profiles only; the host-CPU plane has no 'XLA Modules' line"
-        )
-    ev_meta = plane.event_metadata
-
-    out: dict = {"modules_us_per_step": None, "steps_us_per_step": None, "top_ops": {}}
-    denom = max(n_steps, 1)
-    for line in plane.lines:
-        if line.name == "XLA Modules":
-            out["modules_us_per_step"] = sum(e.duration_ps for e in line.events) / 1e6 / denom
-        elif line.name == "Steps":
-            out["steps_us_per_step"] = sum(e.duration_ps for e in line.events) / 1e6 / denom
-
-    ops_line = next((l for l in plane.lines if l.name == "XLA Ops"), None)
-    if ops_line is not None:
-        evs = sorted(
-            (e.offset_ps, e.offset_ps + e.duration_ps, ev_meta[e.metadata_id].name)
-            for e in ops_line.events
-        )
-        self_time: collections.Counter = collections.Counter()
-        stack = []
-        for start, end, name in evs:
-            while stack and stack[-1][1] <= start:
-                stack.pop()
-            if stack:
-                self_time[stack[-1][2]] -= min(end, stack[-1][1]) - start
-            self_time[name] += end - start
-            stack.append((start, end, name))
-        out["top_ops"] = {
-            name: ps / 1e6 / denom for name, ps in self_time.most_common(30)
-        }
-    return out
+from sheeprl_tpu.obs.prof.xplane import summarize  # noqa: F401 — re-export
 
 
 def main(trace_dir: str, n_steps: int = 5) -> None:
@@ -75,12 +26,21 @@ def main(trace_dir: str, n_steps: int = 5) -> None:
         s = summarize(trace_dir, n_steps)
     except FileNotFoundError as exc:
         sys.exit(str(exc))
+    print(f"source: {s['source']} plane ({s['plane']})")
     for key in ("steps_us_per_step", "modules_us_per_step"):
-        if s[key] is not None:
+        if s.get(key) is not None:
             print(f"{key}: {s[key]:.0f} us/step")
-    print("\ntop self-time ops (us/step):")
-    for name, us in list(s["top_ops"].items())[:20]:
-        print(f"  {us:9.1f}  {name[:140]}")
+    print("\nper-module attribution (ms/exec x execs):")
+    for name, m in sorted(
+        s["modules"].items(), key=lambda kv: kv[1]["total_ms"], reverse=True
+    )[:10]:
+        print(
+            f"  {m['ms_per_exec']:9.3f} x {m['execs']:<5d} [{m['phase']:<8s}] {name[:100]}"
+        )
+    if s["top_ops"]:
+        print("\ntop self-time ops (us/step):")
+        for name, us in list(s["top_ops"].items())[:20]:
+            print(f"  {us:9.1f}  {name[:140]}")
 
 
 if __name__ == "__main__":

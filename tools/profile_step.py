@@ -1,82 +1,101 @@
-"""Capture + summarize an XLA device profile of a Dreamer train step.
+"""Capture + summarize an XLA device profile of any family's train step.
 
-Usage (on the TPU host):
+    python tools/profile_step.py --exp dv3 [config overrides...]
+    python tools/profile_step.py --exp sac --tiny --steps 10
 
-    python tools/profile_step.py [config overrides...]
-    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python tools/parse_xplane.py /tmp/dv3_trace
+Was hard-wired to DV3's agent/train builders; now any family in
+``sheeprl_tpu.obs.prof.harness.FAMILIES`` (dv1/dv2/dv3, the P2E exploration
+variants, sac, ppo) builds through the shared harness — the same real
+``build_agent``/``build_train_fn`` wiring the training loop dispatches.
+The capture uses the same ``profiler_capture`` scope the flight recorder
+and the in-run ``StepProfiler`` open; parsing + roofline go through
+``sheeprl_tpu.obs.prof`` (no tensorflow needed, CPU host-plane fallback).
 
-Wall-clock through the remote-attach tunnel is noisy (dispatch round trips,
-shared relay); the xplane's 'XLA Modules' line is the trustworthy per-step
-device time. See howto/logs_and_checkpoints.md for trace capture inside
-training runs (metric.profiler=<dir>).
+Wall-clock through a remote-attach tunnel is noisy (dispatch round trips,
+shared relay); the profiled per-execution device time is the trustworthy
+number. See howto/profiling.md.
 """
 
 from __future__ import annotations
 
+import argparse
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import gymnasium as gym
-import jax
-import jax.numpy as jnp
-import numpy as np
 
+def profile_family(
+    family: str,
+    overrides=(),
+    tiny: bool = False,
+    steps: int = 5,
+    out_dir: str = None,
+    warmup: int = 1,
+):
+    """Build, warm up, capture ``steps`` dispatches, parse, roofline.
 
-def main(out_dir: str = "/tmp/dv3_trace") -> None:
-    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
-    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
-        build_optimizers_and_state,
-        build_train_fn,
-    )
-    from sheeprl_tpu.config.engine import compose
-    from sheeprl_tpu.fabric import Fabric
-
-    jax.config.update("jax_default_device", jax.devices("cpu")[0])
-    cfg = compose(
-        "config",
-        overrides=[
-            "exp=dreamer_v3_100k_ms_pacman",
-            "env=dummy",
-            "env.id=discrete_dummy",
-            "metric.log_level=0",
-            "checkpoint.every=1000000",
-            "fabric.precision=bf16-mixed",
-            *sys.argv[1:],
-        ],
-    )
-    fabric = Fabric(devices=1, accelerator="auto", precision=cfg.fabric.precision)
-    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
-    wm, actor, critic, params = build_agent(cfg, (9,), False, obs_space, jax.random.PRNGKey(0))
-    wtx, atx, ctx, state = build_optimizers_and_state(cfg, params)
-    state = jax.device_put(state, fabric.replicated)
-    train_fn = build_train_fn(wm, actor, critic, wtx, atx, ctx, cfg, fabric, (9,), False)
-
-    T, B = int(cfg.per_rank_sequence_length), int(cfg.per_rank_batch_size)
-    rng = np.random.default_rng(0)
-    batch = jax.device_put(
-        {
-            "rgb": jnp.asarray(rng.integers(0, 256, (T, B, 3, 64, 64)).astype(np.uint8)),
-            "actions": jnp.asarray(np.eye(9, dtype=np.float32)[rng.integers(0, 9, (T, B))]),
-            "rewards": jnp.asarray(rng.normal(size=(T, B, 1)).astype(np.float32)),
-            "dones": jnp.zeros((T, B, 1), jnp.float32),
-            "is_first": jnp.zeros((T, B, 1), jnp.float32),
-        },
-        fabric.sharding(None, fabric.data_axis),
-    )
-    state, m = train_fn(state, batch, jax.random.PRNGKey(99), jnp.float32(1.0))
-    float(np.asarray(m["Loss/world_model_loss"]))  # finish compile+warmup
-    # the same capture scope the flight recorder opens on an anomaly
-    # (sheeprl_tpu/obs/live.py) — one implementation of start/stop_trace
+    Returns the :func:`sheeprl_tpu.obs.prof.capture.analyze_trace` record
+    (plus ``family``/``flops_per_dispatch``/``bytes_per_dispatch``).
+    """
     from sheeprl_tpu.obs.live import profiler_capture
+    from sheeprl_tpu.obs.prof.capture import analyze_trace
+    from sheeprl_tpu.obs.prof.harness import build_harness
+    from sheeprl_tpu.obs.prof.roofline import detect_peaks
 
+    harness = build_harness(family, overrides=overrides, tiny=tiny)
+    out_dir = out_dir or f"/tmp/{family}_trace"
+    harness.run(warmup)  # compile + warmup outside the capture window
     with profiler_capture(out_dir):
-        for i in range(5):
-            state, m = train_fn(state, batch, jax.random.PRNGKey(i), jnp.float32(0.02))
-        float(np.asarray(m["Loss/world_model_loss"]))
-    print(f"trace written to {out_dir}; parse with tools/parse_xplane.py")
+        harness.run(steps)
+    cost = harness.cost() or {}
+    record = analyze_trace(
+        out_dir,
+        flops_per_step=cost.get("flops"),
+        bytes_per_step=cost.get("bytes_accessed"),
+        world_size=1,
+        dispatches_per_step=1,
+        peaks=detect_peaks(),
+    )
+    record["family"] = family
+    record["flops_per_dispatch"] = cost.get("flops")
+    record["bytes_per_dispatch"] = cost.get("bytes_accessed")
+    # UNIT NOTE: the harness dispatches the single-gradient-step program, so
+    # this record's device_ms_per_step is per GRADIENT STEP. The in-run key
+    # in telemetry.json is per train-step UNIT — per_rank_gradient_steps
+    # dispatches for the looped families (DV1/DV2/P2E), a whole burst for
+    # DV3 — so the two differ by that factor on multi-step configs.
+    record["unit"] = "ms per gradient step (one dispatch)"
+    return record
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--exp", default="dv3",
+        help="family to profile (sheeprl_tpu.obs.prof.harness.FAMILIES)",
+    )
+    parser.add_argument("--steps", type=int, default=5, help="captured dispatches")
+    parser.add_argument("--warmup", type=int, default=1, help="uncaptured warmup dispatches")
+    parser.add_argument("--tiny", action="store_true", help="CPU-scale model sizes")
+    parser.add_argument("--out", default=None, help="trace dir (default /tmp/<exp>_trace)")
+    parser.add_argument(
+        "overrides", nargs="*", help="extra config overrides (hydra-style k=v)"
+    )
+    args = parser.parse_args(argv)
+
+    record = profile_family(
+        args.exp, overrides=args.overrides, tiny=args.tiny,
+        steps=args.steps, out_dir=args.out, warmup=args.warmup,
+    )
+    print(json.dumps(record, indent=2, default=str))
+    print(
+        f"\ntrace in {record['trace_dir']} — re-parse with "
+        "tools/parse_xplane.py", file=sys.stderr,
+    )
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
