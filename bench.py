@@ -246,6 +246,18 @@ def _phase_tails(tel) -> dict:
     for key in ("device_ms_per_step", "mfu_device_pct", "roofline_verdict"):
         if tel.get(key) is not None:
             out[key] = tel[key]
+    # distributed observability (obs/dist): host-collective wall time and
+    # the data-staleness percentiles — the actor-learner health numbers.
+    # The staleness keys keep a legitimate 0.0 (zero lag IS the healthy
+    # reading); comms_ms 0 just means no host collectives ran — noise.
+    for key in ("sample_age_p95_s", "policy_lag_p95"):
+        if tel.get(key) is not None:
+            out[key] = tel[key]
+    if tel.get("comms_ms"):
+        out["comms_ms"] = tel["comms_ms"]
+    prof = tel.get("prof") or {}
+    if prof.get("comms_ms_per_step") is not None:
+        out["comms_ms_per_step"] = prof["comms_ms_per_step"]
     return out
 
 

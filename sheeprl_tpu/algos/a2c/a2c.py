@@ -41,6 +41,7 @@ from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import count_h2d, log_sps_metrics, profile_tick, span
+from sheeprl_tpu.obs.dist import pmean
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_tensor, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -93,13 +94,13 @@ def build_update_fn(
             params, opt_state = carry
             batch = jax.tree_util.tree_map(lambda x: x[idx], data)
             (_, metrics), grads = grad_fn(params, batch)
-            grads = jax.lax.pmean(grads, axis)
+            grads = pmean(grads, axis)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), metrics
 
         (params, opt_state), metrics = jax.lax.scan(mb_step, (params, opt_state), mb_idx)
-        metrics = jax.lax.pmean(jnp.mean(metrics, axis=0), axis)
+        metrics = pmean(jnp.mean(metrics, axis=0), axis)
         return params, opt_state, metrics
 
     shmapped = shard_map(

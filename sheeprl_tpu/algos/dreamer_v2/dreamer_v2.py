@@ -67,6 +67,7 @@ from sheeprl_tpu.obs import (
     shape_specs,
     span,
 )
+from sheeprl_tpu.obs.dist import pmean
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -312,7 +313,7 @@ def build_train_fn(
         (wm_loss, (wm_metrics, posteriors, recurrents)), wm_grads = jax.value_and_grad(
             wm_loss_fn, has_aux=True
         )(params["world_model"], data, k_wm)
-        wm_grads = jax.lax.pmean(wm_grads, axis)
+        wm_grads = pmean(wm_grads, axis)
         wm_updates, wm_opt = world_tx.update(wm_grads, opt["world_model"], params["world_model"])
         wm_params = optax.apply_updates(params["world_model"], wm_updates)
 
@@ -326,7 +327,7 @@ def build_train_fn(
             true_continue,
             k_img,
         )
-        actor_grads = jax.lax.pmean(actor_grads, axis)
+        actor_grads = pmean(actor_grads, axis)
         actor_updates, actor_opt = actor_tx.update(actor_grads, opt["actor"], params["actor"])
         actor_params = optax.apply_updates(params["actor"], actor_updates)
 
@@ -336,7 +337,7 @@ def build_train_fn(
             aux["lambda_values"],
             aux["discount"],
         )
-        critic_grads = jax.lax.pmean(critic_grads, axis)
+        critic_grads = pmean(critic_grads, axis)
         critic_updates, critic_opt = critic_tx.update(critic_grads, opt["critic"], params["critic"])
         critic_params = optax.apply_updates(params["critic"], critic_updates)
 
@@ -348,7 +349,7 @@ def build_train_fn(
         metrics["Grads/world_model"] = optax.global_norm(wm_grads)
         metrics["Grads/actor"] = optax.global_norm(actor_grads)
         metrics["Grads/critic"] = optax.global_norm(critic_grads)
-        metrics = jax.lax.pmean(metrics, axis)
+        metrics = pmean(metrics, axis)
 
         new_state = {
             "params": {

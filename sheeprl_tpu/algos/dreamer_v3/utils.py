@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.obs.dist import instrumented_all_gather as dist_all_gather
+
 sg = jax.lax.stop_gradient
 
 AGGREGATOR_KEYS = {
@@ -69,7 +71,7 @@ def update_moments(
     """
     x = sg(x)
     if axis_name is not None:
-        x = jax.lax.all_gather(x, axis_name)
+        x = dist_all_gather(x, axis_name)
     low = jnp.quantile(x, percentile_low)
     high = jnp.quantile(x, percentile_high)
     new_low = decay * state["low"] + (1 - decay) * low

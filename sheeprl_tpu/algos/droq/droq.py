@@ -42,11 +42,13 @@ from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.staging import make_replay_staging
+from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import log_sps_metrics, profile_tick, span
+from sheeprl_tpu.obs.dist import pmean
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -96,7 +98,7 @@ def build_train_fn(
             return sum(((q[..., i : i + 1] - td_target) ** 2).mean() for i in range(n_critics))
 
         qf_loss, qf_grads = jax.value_and_grad(qf_loss_fn)(state["critics"])
-        qf_grads = jax.lax.pmean(qf_grads, axis)
+        qf_grads = pmean(qf_grads, axis)
         qf_updates, qf_opt = qf_tx.update(qf_grads, qf_opt, state["critics"])
         critics = optax.apply_updates(state["critics"], qf_updates)
         targets = jax.tree_util.tree_map(
@@ -125,7 +127,7 @@ def build_train_fn(
         (actor_loss, logprob), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
             state["actor"]
         )
-        actor_grads = jax.lax.pmean(actor_grads, axis)
+        actor_grads = pmean(actor_grads, axis)
         actor_updates, actor_opt = actor_tx.update(actor_grads, opt_states["actor"], state["actor"])
         actor_params = optax.apply_updates(state["actor"], actor_updates)
 
@@ -133,13 +135,13 @@ def build_train_fn(
             return entropy_loss(log_alpha, jax.lax.stop_gradient(logprob), tgt_entropy)
 
         alpha_loss, alpha_grad = jax.value_and_grad(alpha_loss_fn)(state["log_alpha"])
-        alpha_grad = jax.lax.pmean(alpha_grad, axis)
+        alpha_grad = pmean(alpha_grad, axis)
         alpha_updates, alpha_opt = alpha_tx.update(alpha_grad, opt_states["alpha"], state["log_alpha"])
         log_alpha = optax.apply_updates(state["log_alpha"], alpha_updates)
 
         state = {**state, "actor": actor_params, "log_alpha": log_alpha}
         opt_states = {"actor": actor_opt, "qf": qf_opt, "alpha": alpha_opt}
-        metrics = jax.lax.pmean(
+        metrics = pmean(
             jnp.stack([jnp.mean(qf_losses), actor_loss, alpha_loss]), axis
         )
         return state, opt_states, metrics
@@ -254,14 +256,6 @@ def main(fabric, cfg: Dict[str, Any]):
 
     scale_j, bias_j = jnp.asarray(action_scale), jnp.asarray(action_bias)
 
-    @jax.jit
-    def policy_fn(actor_params, obs, key):
-        # key advances inside the jitted call: one host dispatch per env step
-        key, sub = jax.random.split(key)
-        mean, std = actor.apply({"params": actor_params}, obs)
-        actions, _ = squash_sample(mean, std, sub, scale_j, bias_j)
-        return actions, key
-
     actor_mirror = HostParamMirror.from_cfg(agent_state["actor"], fabric, cfg)
     play_actor = actor_mirror(agent_state["actor"])
 
@@ -297,20 +291,24 @@ def main(fabric, cfg: Dict[str, Any]):
     per_rank_gradient_steps = int(cfg.algo.per_rank_gradient_steps)
     root_key, play_key = jax.random.split(root_key)
     play_key = actor_mirror.put_key(play_key)
+    # burst acting (envs/rollout, howto/rollout_engine.md): K env steps per
+    # device dispatch; 1 (the default) reproduces the per-step path exactly
+    act_burst = max(int(cfg.env.get("act_burst", 1) or 1), 1)
 
-    for update in range(start_step, num_updates + 1):
-        policy_step += n_envs
+    # The acting loop body as one host function — env step, SAME_STEP
+    # final_obs fixup, episode logging, buffer add: the old per-step block
+    # verbatim. The BurstActor scans it K times per dispatch through an
+    # ordered io_callback; the random prefill calls it directly.
+    state_box = {"obs": obs, "policy_step": policy_step}
 
+    def _host_env_step(actions):
+        actions = np.asarray(actions)
+        state_box["policy_step"] += n_envs
         with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
-            if update <= learning_starts:
-                actions = envs.action_space.sample()
-            else:
-                actions_j, play_key = policy_fn(play_actor, obs, play_key)
-                actions = np.asarray(actions_j)
             next_o, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
-            dones = np.logical_or(terminated, truncated)
+        dones = np.logical_or(terminated, truncated)
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             fi = infos["final_info"]
@@ -322,7 +320,9 @@ def main(fabric, cfg: Dict[str, Any]):
                     if aggregator and not aggregator.disabled:
                         aggregator.update("Rewards/rew_avg", ep_rew)
                         aggregator.update("Game/ep_len_avg", ep_len)
-                    fabric.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+                    fabric.print(
+                        f"Rank-0: policy_step={state_box['policy_step']}, reward_env_{i}={ep_rew}"
+                    )
 
         next_obs = concat_obs(next_o, cfg.mlp_keys.encoder, n_envs)
         real_next_obs = next_obs.copy()
@@ -332,7 +332,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     real_next_obs[idx] = concat_obs(final_obs, cfg.mlp_keys.encoder, 1)[0]
 
         step_data = {
-            "observations": obs[None],
+            "observations": state_box["obs"][None],
             "actions": np.asarray(actions, np.float32).reshape(1, n_envs, -1),
             "rewards": np.asarray(rewards, np.float32).reshape(1, n_envs, 1),
             "dones": np.asarray(dones, np.float32).reshape(1, n_envs, 1),
@@ -340,9 +340,41 @@ def main(fabric, cfg: Dict[str, Any]):
         if not cfg.buffer.sample_next_obs:
             step_data["next_observations"] = real_next_obs[None]
         rb.add(step_data)
-        obs = next_obs
+        state_box["obs"] = next_obs
+        return next_obs
 
-        if update > learning_starts:
+    def _act_fn(actor_params, a_obs, key):
+        # key advances inside the jitted burst: same discipline as the old
+        # per-step policy_fn, so K=1 is bitwise the per-step path
+        key, sub = jax.random.split(key)
+        mean, std = actor.apply({"params": actor_params}, a_obs)
+        actions, _ = squash_sample(mean, std, sub, scale_j, bias_j)
+        return (actions,), key
+
+    burst_actor = BurstActor(_act_fn, _host_env_step, obs)
+
+    update = start_step
+    while update <= num_updates:
+        if update <= learning_starts:
+            n_act = 1
+            _host_env_step(envs.action_space.sample())
+        else:
+            n_act = max(min(act_burst, num_updates - update + 1), 1)
+            with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+                _, play_key = burst_actor.rollout(
+                    play_actor, state_box["obs"], play_key, n_act
+                )
+        policy_step = state_box["policy_step"]
+        first = update
+        update += n_act
+        last = update - 1
+
+        # one train round per update index the burst covered (K=1 reduces to
+        # the reference per-update cadence; the per-update actor batch and
+        # target-EMA semantics stay exact for every K)
+        for u in range(first, last + 1):
+            if u <= learning_starts:
+                continue
             # both bursts arrive as device arrays: ring-gathered from HBM, or
             # host-sampled + device_put overlapped with the previous burst
             critic_batch = staging.sample_device(
@@ -372,7 +404,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.update("Loss/alpha_loss", losses[2])
 
         if cfg.metric.log_level > 0 and (
-            policy_step - last_log >= cfg.metric.log_every or update == num_updates
+            policy_step - last_log >= cfg.metric.log_every or last == num_updates
         ):
             if aggregator and not aggregator.disabled:
                 metrics_dict = aggregator.compute()
@@ -392,12 +424,12 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
+        if should_checkpoint(cfg, policy_step, last_checkpoint, last, num_updates):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": jax.device_get(agent_state),
                 "opt_states": jax.device_get(opt_states),
-                "update": update * world_size,
+                "update": last * world_size,
                 "batch_size": cfg.per_rank_batch_size * world_size,
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,

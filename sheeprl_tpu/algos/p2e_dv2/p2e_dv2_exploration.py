@@ -65,6 +65,7 @@ from sheeprl_tpu.obs import (
     shape_specs,
     span,
 )
+from sheeprl_tpu.obs.dist import pmean
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -264,14 +265,14 @@ def build_train_fn(
         (wm_loss, (wm_metrics, posteriors, recurrents)), wm_grads = jax.value_and_grad(
             wm_loss_fn, has_aux=True
         )(params["world_model"], data, k_wm)
-        wm_grads = jax.lax.pmean(wm_grads, axis)
+        wm_grads = pmean(wm_grads, axis)
         wm_updates, wm_opt = txs["world_model"].update(wm_grads, opt["world_model"], params["world_model"])
         wm_params = optax.apply_updates(params["world_model"], wm_updates)
 
         ens_loss, ens_grads = jax.value_and_grad(ensemble_loss_fn)(
             params["ensembles"], posteriors, recurrents, data["actions"]
         )
-        ens_grads = jax.lax.pmean(ens_grads, axis)
+        ens_grads = pmean(ens_grads, axis)
         ens_updates, ens_opt = txs["ensembles"].update(ens_grads, opt["ensembles"], params["ensembles"])
         ens_params = optax.apply_updates(params["ensembles"], ens_updates)
 
@@ -293,7 +294,7 @@ def build_train_fn(
             params["actor_exploration"], wm_params, target_expl,
             posteriors, recurrents, true_continue, k_expl, intrinsic_reward_fn,
         )
-        a_expl_grads = jax.lax.pmean(a_expl_grads, axis)
+        a_expl_grads = pmean(a_expl_grads, axis)
         a_expl_updates, a_expl_opt = txs["actor_exploration"].update(
             a_expl_grads, opt["actor_exploration"], params["actor_exploration"]
         )
@@ -303,7 +304,7 @@ def build_train_fn(
             params["critic_exploration"],
             aux_expl["trajectories"], aux_expl["lambda_values"], aux_expl["discount"],
         )
-        ce_grads = jax.lax.pmean(ce_grads, axis)
+        ce_grads = pmean(ce_grads, axis)
         ce_updates, ce_opt = txs["critic_exploration"].update(
             ce_grads, opt["critic_exploration"], params["critic_exploration"]
         )
@@ -316,7 +317,7 @@ def build_train_fn(
             params["actor_task"], wm_params, target_task,
             posteriors, recurrents, true_continue, k_task, extrinsic_reward_fn,
         )
-        a_task_grads = jax.lax.pmean(a_task_grads, axis)
+        a_task_grads = pmean(a_task_grads, axis)
         a_task_updates, a_task_opt = txs["actor_task"].update(
             a_task_grads, opt["actor_task"], params["actor_task"]
         )
@@ -326,7 +327,7 @@ def build_train_fn(
             params["critic_task"],
             aux_task["trajectories"], aux_task["lambda_values"], aux_task["discount"],
         )
-        ct_grads = jax.lax.pmean(ct_grads, axis)
+        ct_grads = pmean(ct_grads, axis)
         ct_updates, ct_opt = txs["critic_task"].update(ct_grads, opt["critic_task"], params["critic_task"])
         critic_task_params = optax.apply_updates(params["critic_task"], ct_updates)
 
@@ -345,7 +346,7 @@ def build_train_fn(
         metrics["Grads/critic_exploration"] = optax.global_norm(ce_grads)
         metrics["Grads/actor_task"] = optax.global_norm(a_task_grads)
         metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
-        metrics = jax.lax.pmean(metrics, axis)
+        metrics = pmean(metrics, axis)
 
         new_state = {
             "params": {

@@ -73,6 +73,7 @@ from sheeprl_tpu.obs import (
     shape_specs,
     span,
 )
+from sheeprl_tpu.obs.dist import pmean
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -385,7 +386,7 @@ def build_train_fn(
         (wm_loss, (wm_metrics, posteriors, recurrents)), wm_grads = jax.value_and_grad(
             wm_loss_fn, has_aux=True
         )(params["world_model"], data, k_wm)
-        wm_grads = jax.lax.pmean(wm_grads, axis)
+        wm_grads = pmean(wm_grads, axis)
         wm_updates, wm_opt = txs["world_model"].update(
             wm_grads, opt["world_model"], params["world_model"]
         )
@@ -395,7 +396,7 @@ def build_train_fn(
         ens_loss, ens_grads = jax.value_and_grad(ensemble_loss_fn)(
             params["ensembles"], posteriors, recurrents, data["actions"]
         )
-        ens_grads = jax.lax.pmean(ens_grads, axis)
+        ens_grads = pmean(ens_grads, axis)
         ens_updates, ens_opt = txs["ensembles"].update(
             ens_grads, opt["ensembles"], params["ensembles"]
         )
@@ -411,7 +412,7 @@ def build_train_fn(
             params["critics_exploration"], posteriors, recurrents,
             true_continue, agent_state["moments"]["exploration"], k_expl,
         )
-        a_expl_grads = jax.lax.pmean(a_expl_grads, axis)
+        a_expl_grads = pmean(a_expl_grads, axis)
         a_expl_updates, a_expl_opt = txs["actor_exploration"].update(
             a_expl_grads, opt["actor_exploration"], params["actor_exploration"]
         )
@@ -429,7 +430,7 @@ def build_train_fn(
                 aux_expl["critics"][k]["lambda_values"],
                 aux_expl["discount"],
             )
-            c_grads = jax.lax.pmean(c_grads, axis)
+            c_grads = pmean(c_grads, axis)
             c_updates, c_opt = txs["critics_exploration"].update(
                 c_grads, opt["critics_exploration"][k],
                 params["critics_exploration"][k]["module"],
@@ -449,7 +450,7 @@ def build_train_fn(
             posteriors, recurrents, true_continue,
             agent_state["moments"]["task"], k_task,
         )
-        a_task_grads = jax.lax.pmean(a_task_grads, axis)
+        a_task_grads = pmean(a_task_grads, axis)
         a_task_updates, a_task_opt = txs["actor_task"].update(
             a_task_grads, opt["actor_task"], params["actor_task"]
         )
@@ -460,7 +461,7 @@ def build_train_fn(
             params["critic_task"], target_task,
             aux_task["trajectories"], aux_task["lambda_values"], aux_task["discount"],
         )
-        ct_grads = jax.lax.pmean(ct_grads, axis)
+        ct_grads = pmean(ct_grads, axis)
         ct_updates, ct_opt = txs["critic_task"].update(
             ct_grads, opt["critic_task"], params["critic_task"]
         )
@@ -478,7 +479,7 @@ def build_train_fn(
         metrics["Grads/actor_exploration"] = optax.global_norm(a_expl_grads)
         metrics["Grads/actor_task"] = optax.global_norm(a_task_grads)
         metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
-        metrics = jax.lax.pmean(metrics, axis)
+        metrics = pmean(metrics, axis)
 
         new_state = {
             "params": {

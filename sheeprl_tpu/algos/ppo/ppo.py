@@ -62,6 +62,7 @@ from sheeprl_tpu.obs import (
     shape_specs,
     span,
 )
+from sheeprl_tpu.obs.dist import pmean
 from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
@@ -143,7 +144,7 @@ def build_update_fn(
                 params, opt_state = carry
                 batch = jax.tree_util.tree_map(lambda x: x[idx], data)
                 (_, metrics), grads = grad_fn(params, batch, clip_coef, ent_coef)
-                grads = jax.lax.pmean(grads, axis)
+                grads = pmean(grads, axis)
                 updates, opt_state = tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 return (params, opt_state), metrics
@@ -152,7 +153,7 @@ def build_update_fn(
             return carry, metrics
 
         (params, opt_state), metrics = jax.lax.scan(epoch_step, (params, opt_state), ep_keys)
-        metrics = jax.lax.pmean(jnp.mean(metrics, axis=(0, 1)), axis)
+        metrics = pmean(jnp.mean(metrics, axis=(0, 1)), axis)
         return params, opt_state, metrics
 
     data_spec = P() if share else P(axis)

@@ -46,6 +46,7 @@ from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import count_h2d, log_sps_metrics, profile_tick, span
+from sheeprl_tpu.obs.dist import pmean
 from sheeprl_tpu.utils.optim import set_lr
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_tensor, polynomial_decay, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
@@ -115,7 +116,7 @@ def build_update_fn(
                 batch = jax.tree_util.tree_map(lambda x: x[:, idx], seq_data)
                 hc = (init_hc["c"][idx], init_hc["h"][idx])
                 (_, metrics), grads = grad_fn(params, batch, hc, clip_coef, ent_coef)
-                grads = jax.lax.pmean(grads, axis)
+                grads = pmean(grads, axis)
                 updates, opt_state = tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 return (params, opt_state), metrics
@@ -124,7 +125,7 @@ def build_update_fn(
             return carry, metrics
 
         (params, opt_state), metrics = jax.lax.scan(epoch_step, (params, opt_state), ep_keys)
-        metrics = jax.lax.pmean(jnp.mean(metrics, axis=(0, 1)), axis)
+        metrics = pmean(jnp.mean(metrics, axis=(0, 1)), axis)
         return params, opt_state, metrics
 
     shmapped = shard_map(

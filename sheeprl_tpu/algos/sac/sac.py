@@ -73,6 +73,7 @@ from sheeprl_tpu.obs import (
     shape_specs,
     span,
 )
+from sheeprl_tpu.obs.dist import pmean
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -120,7 +121,7 @@ def build_train_fn(
             return critic_loss(q, td_target, n_critics)
 
         qf_loss, qf_grads = jax.value_and_grad(qf_loss_fn)(state["critics"])
-        qf_grads = jax.lax.pmean(qf_grads, axis)
+        qf_grads = pmean(qf_grads, axis)
         qf_updates, qf_opt = qf_tx.update(qf_grads, opt_states["qf"], state["critics"])
         critics = optax.apply_updates(state["critics"], qf_updates)
 
@@ -143,7 +144,7 @@ def build_train_fn(
         (actor_loss, logprob), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
             state["actor"]
         )
-        actor_grads = jax.lax.pmean(actor_grads, axis)
+        actor_grads = pmean(actor_grads, axis)
         actor_updates, actor_opt = actor_tx.update(actor_grads, opt_states["actor"], state["actor"])
         actor_params = optax.apply_updates(state["actor"], actor_updates)
 
@@ -152,7 +153,7 @@ def build_train_fn(
             return entropy_loss(log_alpha, jax.lax.stop_gradient(logprob), tgt_entropy)
 
         alpha_loss, alpha_grad = jax.value_and_grad(alpha_loss_fn)(state["log_alpha"])
-        alpha_grad = jax.lax.pmean(alpha_grad, axis)
+        alpha_grad = pmean(alpha_grad, axis)
         alpha_updates, alpha_opt = alpha_tx.update(alpha_grad, opt_states["alpha"], state["log_alpha"])
         log_alpha = optax.apply_updates(state["log_alpha"], alpha_updates)
 
@@ -172,7 +173,7 @@ def build_train_fn(
         (state, opt_states, _), metrics = jax.lax.scan(
             one_step, (state, opt_states, do_ema), (batch, keys)
         )
-        metrics = jax.lax.pmean(jnp.mean(metrics, axis=0), axis)
+        metrics = pmean(jnp.mean(metrics, axis=0), axis)
         return state, opt_states, metrics
 
     shmapped = shard_map(

@@ -37,11 +37,13 @@ from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.staging import make_replay_staging
+from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import log_sps_metrics, profile_tick, span
+from sheeprl_tpu.obs.dist import pmean
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -115,7 +117,7 @@ def build_train_fn(
         qf_loss, qf_grads = jax.value_and_grad(qf_loss_fn)(
             {"encoder": state["encoder"], "qfs": state["qfs"]}
         )
-        qf_grads = jax.lax.pmean(qf_grads, axis)
+        qf_grads = pmean(qf_grads, axis)
         qf_updates, qf_opt = txs["qf"].update(
             qf_grads, opts["qf"], {"encoder": state["encoder"], "qfs": state["qfs"]}
         )
@@ -152,7 +154,7 @@ def build_train_fn(
         (actor_loss, logprob), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
             state["actor"]
         )
-        actor_grads = jax.lax.pmean(actor_grads, axis)
+        actor_grads = pmean(actor_grads, axis)
         actor_updates, actor_opt = txs["actor"].update(actor_grads, opts["actor"], state["actor"])
         actor_params = where_tree(
             gates["do_actor"], optax.apply_updates(state["actor"], actor_updates), state["actor"]
@@ -163,7 +165,7 @@ def build_train_fn(
             return entropy_loss(log_alpha, sg(logprob), tgt_entropy)
 
         alpha_loss, alpha_grad = jax.value_and_grad(alpha_loss_fn)(state["log_alpha"])
-        alpha_grad = jax.lax.pmean(alpha_grad, axis)
+        alpha_grad = pmean(alpha_grad, axis)
         alpha_updates, alpha_opt = txs["alpha"].update(alpha_grad, opts["alpha"], state["log_alpha"])
         log_alpha = jnp.where(
             gates["do_actor"], optax.apply_updates(state["log_alpha"], alpha_updates), state["log_alpha"]
@@ -190,7 +192,7 @@ def build_train_fn(
         recon_loss, recon_grads = jax.value_and_grad(recon_loss_fn)(
             {"encoder": enc_params, "decoder": state["decoder"]}
         )
-        recon_grads = jax.lax.pmean(recon_grads, axis)
+        recon_grads = pmean(recon_grads, axis)
         enc_updates, enc_opt = txs["encoder"].update(
             recon_grads["encoder"], opts["encoder"], enc_params
         )
@@ -231,7 +233,7 @@ def build_train_fn(
         g = jax.tree_util.tree_leaves(batch)[0].shape[0]
         keys = jax.random.split(key, g)
         (state, opts, _), metrics = jax.lax.scan(one_step, (state, opts, gates), (batch, keys))
-        metrics = jax.lax.pmean(jnp.mean(metrics, axis=0), axis)
+        metrics = pmean(jnp.mean(metrics, axis=0), axis)
         return state, opts, metrics
 
     shmapped = shard_map(
@@ -353,15 +355,6 @@ def main(fabric, cfg: Dict[str, Any]):
 
     scale_j, bias_j = jnp.asarray(action_scale), jnp.asarray(action_bias)
 
-    @jax.jit
-    def policy_fn(agent_params, obs, key):
-        # key advances inside the jitted call: one host dispatch per env step
-        key, sub = jax.random.split(key)
-        feat = encoder.apply({"params": agent_params["encoder"]}, obs)
-        mean, std = actor_trunk.apply({"params": agent_params["actor"]}, feat)
-        actions, _ = squash_sample(mean, std, sub, scale_j, bias_j)
-        return actions, key
-
     def _acting_subtree(p):
         return {"encoder": p["encoder"], "actor": p["actor"]}
 
@@ -409,20 +402,24 @@ def main(fabric, cfg: Dict[str, Any]):
     actor_every = int(cfg.algo.actor.network_frequency) // policy_steps_per_update + 1
     decoder_every = int(cfg.algo.decoder.update_freq) // policy_steps_per_update + 1
 
-    for update in range(start_step, num_updates + 1):
-        policy_step += n_envs
+    # burst acting (envs/rollout, howto/rollout_engine.md): K env steps per
+    # device dispatch; 1 (the default) reproduces the per-step path exactly
+    act_burst = max(int(cfg.env.get("act_burst", 1) or 1), 1)
 
+    # The acting loop body as one host function — env step, SAME_STEP
+    # final_obs fixup, episode logging, buffer add: the old per-step block
+    # verbatim. The BurstActor scans it K times per dispatch through an
+    # ordered io_callback; the random prefill calls it directly.
+    state_box = {"obs": obs, "policy_step": policy_step}
+
+    def _host_env_step(actions):
+        actions = np.asarray(actions)
+        state_box["policy_step"] += n_envs
         with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
-            if update <= learning_starts:
-                actions = envs.action_space.sample()
-            else:
-                norm_obs = normalize_obs_jnp(obs, cnn_keys)
-                actions_j, play_key = policy_fn(play_params, norm_obs, play_key)
-                actions = np.asarray(actions_j)
             next_o, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
-            dones = np.logical_or(terminated, truncated)
+        dones = np.logical_or(terminated, truncated)
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             fi = infos["final_info"]
@@ -434,7 +431,9 @@ def main(fabric, cfg: Dict[str, Any]):
                     if aggregator and not aggregator.disabled:
                         aggregator.update("Rewards/rew_avg", ep_rew)
                         aggregator.update("Game/ep_len_avg", ep_len)
-                    fabric.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+                    fabric.print(
+                        f"Rank-0: policy_step={state_box['policy_step']}, reward_env_{i}={ep_rew}"
+                    )
 
         next_obs_np = {k: np.asarray(next_o[k]) for k in next_o}
         real_next_obs = {k: v.copy() for k, v in next_obs_np.items()}
@@ -448,7 +447,7 @@ def main(fabric, cfg: Dict[str, Any]):
         next_obs = prepare_obs(next_obs_np, cnn_keys, mlp_keys, n_envs)
         real_next = prepare_obs(real_next_obs, cnn_keys, mlp_keys, n_envs)
 
-        step_data = {k: obs[k][None] for k in obs_keys}
+        step_data = {k: state_box["obs"][k][None] for k in obs_keys}
         step_data["actions"] = np.asarray(actions, np.float32).reshape(1, n_envs, -1)
         step_data["rewards"] = np.asarray(rewards, np.float32).reshape(1, n_envs, 1)
         step_data["dones"] = np.asarray(dones, np.float32).reshape(1, n_envs, 1)
@@ -456,11 +455,45 @@ def main(fabric, cfg: Dict[str, Any]):
             for k in obs_keys:
                 step_data[f"next_{k}"] = real_next[k][None]
         rb.add(step_data)
+        state_box["obs"] = next_obs
+        return next_obs
 
-        obs = next_obs
+    def _act_fn(agent_params, a_obs, key):
+        # key advances inside the jitted burst (same discipline as the old
+        # per-step policy_fn, so K=1 is bitwise the per-step path); the
+        # uint8→[0,1] normalize moved inside the traced program — same math
+        key, sub = jax.random.split(key)
+        norm_obs = normalize_obs_jnp(a_obs, cnn_keys)
+        feat = encoder.apply({"params": agent_params["encoder"]}, norm_obs)
+        mean, std = actor_trunk.apply({"params": agent_params["actor"]}, feat)
+        actions, _ = squash_sample(mean, std, sub, scale_j, bias_j)
+        return (actions,), key
 
-        if update >= learning_starts:
-            training_steps = learning_starts if update == learning_starts else 1
+    burst_actor = BurstActor(_act_fn, _host_env_step, obs)
+
+    update = start_step
+    while update <= num_updates:
+        if update <= learning_starts:
+            n_act = 1
+            _host_env_step(envs.action_space.sample())
+        else:
+            n_act = max(min(act_burst, num_updates - update + 1), 1)
+            with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+                _, play_key = burst_actor.rollout(
+                    play_params, state_box["obs"], play_key, n_act
+                )
+        policy_step = state_box["policy_step"]
+        first = update
+        update += n_act
+        last = update - 1
+
+        # one train round per update index the burst covered (K=1 reduces to
+        # the reference per-update cadence; the ema/actor/decoder gates use
+        # the exact per-update index, so the cadences stay bitwise for any K)
+        for u in range(first, last + 1):
+            if u < learning_starts:
+                continue
+            training_steps = learning_starts if u == learning_starts else 1
             g_total = training_steps * per_rank_gradient_steps
             # [G, B*world, ...] device arrays: ring-gathered from HBM, or
             # host-sampled + device_put overlapped with the previous burst
@@ -475,9 +508,9 @@ def main(fabric, cfg: Dict[str, Any]):
             with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 root_key, train_key = jax.random.split(root_key)
                 gates = {
-                    "do_ema": jnp.bool_(update % ema_every == 0),
-                    "do_actor": jnp.bool_(update % actor_every == 0),
-                    "do_decoder": jnp.bool_(update % decoder_every == 0),
+                    "do_ema": jnp.bool_(u % ema_every == 0),
+                    "do_actor": jnp.bool_(u % actor_every == 0),
+                    "do_decoder": jnp.bool_(u % decoder_every == 0),
                 }
                 agent_state, opt_states, losses = train_fn(
                     agent_state, opt_states, batch, train_key, gates
@@ -493,7 +526,7 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.update("Loss/reconstruction_loss", losses[3])
 
         if cfg.metric.log_level > 0 and (
-            policy_step - last_log >= cfg.metric.log_every or update == num_updates
+            policy_step - last_log >= cfg.metric.log_every or last == num_updates
         ):
             if aggregator and not aggregator.disabled:
                 metrics_dict = aggregator.compute()
@@ -513,12 +546,12 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
+        if should_checkpoint(cfg, policy_step, last_checkpoint, last, num_updates):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": jax.device_get(agent_state),
                 "opt_states": jax.device_get(opt_states),
-                "update": update * world_size,
+                "update": last * world_size,
                 "batch_size": cfg.per_rank_batch_size * world_size,
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,

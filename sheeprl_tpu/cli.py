@@ -203,18 +203,20 @@ def check_configs(cfg) -> None:
         )
 
     # burst acting (env.act_burst, envs/rollout) is consumed by the coupled
-    # SAC/PPO loops and the decoupled plane players; elsewhere a >1 value
-    # would silently act per-step — the exact silent-ignore trap the
+    # SAC-family/PPO loops and the decoupled plane players; elsewhere a >1
+    # value would silently act per-step — the exact silent-ignore trap the
     # resume-override accounting closes, so warn
     if int(cfg.env.get("act_burst", 1) or 1) > 1 and algo_name not in (
         "sac",
+        "sac_ae",
+        "droq",
         "ppo",
         "sac_decoupled",
         "ppo_decoupled",
     ):
         warnings.warn(
             f"env.act_burst={cfg.env.act_burst} is only consumed by the "
-            f"SAC/PPO rollout paths (coupled loops and plane players); "
+            f"SAC-family/PPO rollout paths (coupled loops and plane players); "
             f"'{algo_name}' acts per-step (howto/rollout_engine.md)",
             UserWarning,
         )

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Type, Union
 
 import numpy as np
 
+from sheeprl_tpu.obs.dist import staleness as _staleness
 from sheeprl_tpu.utils.memmap import MemmapArray, validate_memmap_mode
 
 Arrays = Dict[str, Union[np.ndarray, MemmapArray]]
@@ -77,6 +78,10 @@ class ReplayBuffer:
         self._full = False
         self._rng: np.random.Generator = np.random.default_rng()
         self._write_lock: Optional[Any] = None
+        # data-staleness lineage (obs/dist/staleness): per-time-row wall
+        # clock of the add that wrote it, allocated lazily on the first add
+        # of an instrumented run — un-instrumented runs never pay the array
+        self._add_ts: Optional[np.ndarray] = None
 
     # -- properties -------------------------------------------------------
 
@@ -195,6 +200,11 @@ class ReplayBuffer:
             idxes = np.arange(start, start + write_len) % self._buffer_size
             for k, v in data.items():
                 self._buf[k][idxes] = v[-write_len:]
+            ts = _staleness.take_add_stamp()
+            if ts is not None:
+                if self._add_ts is None:
+                    self._add_ts = np.zeros(self._buffer_size, np.float64)
+                self._add_ts[idxes] = ts
             if self._pos + data_len >= self._buffer_size:
                 self._full = True
             self._pos = next_pos
@@ -216,6 +226,13 @@ class ReplayBuffer:
         with self._write_lock or nullcontext():
             if self._buf is None:
                 self._allocate({k: np.asarray(v)[None] for k, v in example_rows.items()})
+            ts = _staleness.take_add_stamp()
+            if ts is not None:
+                if self._add_ts is None:
+                    self._add_ts = np.zeros(self._buffer_size, np.float64)
+                write_len = min(steps, self._buffer_size)
+                start = self._pos + steps - write_len
+                self._add_ts[np.arange(start, start + write_len) % self._buffer_size] = ts
             if self._pos + steps >= self._buffer_size:
                 self._full = True
             self._pos = (self._pos + steps) % self._buffer_size
@@ -274,7 +291,22 @@ class ReplayBuffer:
         else:
             envs_arr = np.asarray(envs, dtype=np.int64)
             e_idx = envs_arr[rng.integers(0, len(envs_arr), size=total)]
+        self._observe_sample_ages(t_idx)
         return t_idx, e_idx
+
+    def _observe_sample_ages(self, t_idx: np.ndarray) -> None:
+        """Feed the drawn rows' ages into the staleness histogram — one
+        chokepoint under host sampling AND the device-ring planners (both
+        route their index plans through plan_transitions/plan_starts)."""
+        if self._add_ts is not None and _staleness.installed() is not None:
+            import time
+
+            stamps = self._add_ts[t_idx]
+            # rows that predate instrumentation (a resumed buffer snapshot)
+            # carry stamp 0 — their "age" would be the unix epoch
+            stamps = stamps[stamps > 0.0]
+            if stamps.size:
+                _staleness.observe_sample_ages(time.time() - stamps)
 
     def sample(
         self,
@@ -420,14 +452,18 @@ class SequentialReplayBuffer(ReplayBuffer):
                     f"{self._buffer_size}"
                 )
             offsets = rng.integers(0, max_offset + 1, size=total)
-            return (self._pos + offsets) % self._buffer_size
+            starts = (self._pos + offsets) % self._buffer_size
+            self._observe_sample_ages(starts)
+            return starts
         max_start = self._pos - effective_len
         if max_start < 0:
             raise ValueError(
                 f"Cannot sample a sequence of length {sequence_length}: the buffer only "
                 f"contains {self._pos} steps"
             )
-        return rng.integers(0, max_start + 1, size=total)
+        starts = rng.integers(0, max_start + 1, size=total)
+        self._observe_sample_ages(starts)
+        return starts
 
     def sample(
         self,

@@ -45,6 +45,7 @@ import numpy as np
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer
 from sheeprl_tpu.data.device_ring import DeviceRingReplay, DeviceRingTransitions
 from sheeprl_tpu.obs.counters import add_prefetch, add_ring_gather, count_h2d
+from sheeprl_tpu.obs.dist.staleness import note_queue_depth
 
 __all__ = ["HostStaging", "ReplayStaging", "RingStaging", "make_replay_staging"]
 
@@ -245,6 +246,9 @@ class HostStaging(ReplayStaging):
             while len(self._pending) > self.MAX_PENDING:
                 # a stale pending burst pins device memory; drop oldest-first
                 self._pending.pop(next(iter(self._pending))).cancel()
+        # staleness gauge (obs/dist): in-flight prefetched bursts — 0 means
+        # the pipeline is running dry, MAX_PENDING means it is saturated
+        note_queue_depth("staging_prefetch", len(self._pending))
         return batch
 
     def force_done_last(self, env: int) -> None:

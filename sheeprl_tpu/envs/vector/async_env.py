@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import atexit
 import multiprocessing
+import os
 import time
 import warnings
 import weakref
@@ -86,9 +87,17 @@ def _worker(
     conn,
     slabs: SharedStepSlabs,
     autoreset: bool,
+    trace_spec: Optional[Tuple[str, int, str]] = None,
 ) -> None:
     """Worker loop: build the env, then serve reset/step commands, writing
-    results into the shared slot the parent names on each command."""
+    results into the shared slot the parent names on each command.
+
+    Per-worker observability (obs/dist): every worker counts its served
+    steps and busy seconds and reports them on the ``close`` handshake (the
+    parent folds them into the pool's ``envpool_*`` telemetry source), and
+    — on instrumented runs with tracing — writes its own clock-aligned
+    trace file (``trace_envworker<i>*.jsonl``) so ``tools/trace_view.py``
+    shows learner + players + workers on one Perfetto timeline."""
     import signal
 
     # the parent owns shutdown: a preemption SIGTERM/SIGINT fans out to the
@@ -100,6 +109,19 @@ def _worker(
     except (ValueError, OSError):  # pragma: no cover - non-main-thread spawn
         pass
 
+    tracer = None
+    if trace_spec is not None:
+        try:
+            # the obs package is jax-free at import time, so this stays a
+            # lightweight import inside the env host process
+            from sheeprl_tpu.obs.spans import TraceWriter
+
+            path, pid, name = trace_spec
+            tracer = TraceWriter(path, xla_annotations=False, pid=pid, process_name=name)
+        except Exception:
+            tracer = None
+    stats = {"steps": 0, "busy_s": 0.0}
+
     env: Optional[gym.Env] = None
     try:
         env = thunk()
@@ -108,6 +130,10 @@ def _worker(
         while True:
             cmd, payload = conn.recv()
             if cmd == "close":
+                try:
+                    conn.send(("stats", dict(stats), None, None))
+                except (BrokenPipeError, OSError):
+                    pass
                 break
             slot = payload["slot"]
             if cmd == "reset":
@@ -119,6 +145,7 @@ def _worker(
                 trunc_view[slot, index] = False
                 conn.send(("ok", info, None, None))
             elif cmd == "step":
+                t0 = time.perf_counter()
                 obs, reward, terminated, truncated, info = env.step(payload["action"])
                 final_obs = final_info = None
                 if autoreset and (terminated or truncated):
@@ -126,6 +153,10 @@ def _worker(
                     # info channel, the slab gets the freshly-reset obs
                     final_obs, final_info = obs, info
                     obs, info = env.reset()
+                stats["steps"] += 1
+                stats["busy_s"] += time.perf_counter() - t0
+                if tracer is not None:
+                    tracer.complete("env_step", "env", t0)
                 for key, arr in obs_view.items():
                     arr[slot, index] = obs[key]
                 rew_view[slot, index] = reward
@@ -142,6 +173,11 @@ def _worker(
         except (BrokenPipeError, OSError):
             pass
     finally:
+        if tracer is not None:
+            try:
+                tracer.close()
+            except Exception:
+                pass
         if env is not None:
             try:
                 env.close()
@@ -187,9 +223,17 @@ class AsyncSharedMemVectorEnv(VectorEnv):
         worker_timeout_s: float = 60.0,
         max_worker_restarts: int = 3,
         restart_window_s: float = 300.0,
+        trace_dir: Optional[str] = None,
+        pool_name: Optional[str] = None,
     ):
         self.env_fns = list(env_fns)
         self.num_envs = len(self.env_fns)
+        # distributed observability (obs/dist): per-worker trace files land
+        # under trace_dir when the run is tracing; pool_name keys the pool's
+        # telemetry source in the merged per-source breakdown
+        self._trace_dir = trace_dir
+        self.pool_name = pool_name or f"envpool_{os.getpid()}"
+        self.worker_stats: Dict[int, Dict[str, Any]] = {}
         self.worker_timeout_s = float(worker_timeout_s)
         self.max_worker_restarts = int(max_worker_restarts)
         self.restart_window_s = float(restart_window_s)
@@ -234,10 +278,26 @@ class AsyncSharedMemVectorEnv(VectorEnv):
 
     def _spawn_worker(self, index: int) -> None:
         parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        trace_spec = None
+        if self._trace_dir:
+            gen = self._restart_counts[index] if hasattr(self, "_restart_counts") else 0
+            suffix = f"_g{gen}" if gen else ""
+            trace_spec = (
+                os.path.join(self._trace_dir, f"trace_envworker{index}{suffix}.jsonl"),
+                1000 + index,  # distinct Perfetto track vs learner/players
+                f"envworker{index}",
+            )
         proc = self._ctx.Process(
             target=_worker,
             name=f"vecenv-worker-{index}",
-            args=(index, CloudpickleWrapper(self.env_fns[index]), child_conn, self._slabs, True),
+            args=(
+                index,
+                CloudpickleWrapper(self.env_fns[index]),
+                child_conn,
+                self._slabs,
+                True,
+                trace_spec,
+            ),
             daemon=True,
         )
         proc.start()
@@ -541,6 +601,7 @@ class AsyncSharedMemVectorEnv(VectorEnv):
                 except Exception:
                     pass
             self._sync_envs = None
+            self._publish_pool_source()
             return
         try:
             from sheeprl_tpu.ckpt import preemption_requested
@@ -558,6 +619,25 @@ class AsyncSharedMemVectorEnv(VectorEnv):
                 except (BrokenPipeError, OSError):
                     pass
         deadline = time.perf_counter() + join_budget
+        # collect the per-worker stats reply each worker sends on the close
+        # handshake (steps served, env busy seconds) — best-effort within
+        # the join budget, a dead/hung worker just reports nothing. Drain
+        # any unconsumed step/reset replies first (a teardown between
+        # dispatch and collect leaves a stale 'ok' queued ahead of 'stats').
+        for i, conn in enumerate(self._conns):
+            if conn is None:
+                continue
+            try:
+                while True:
+                    remaining = max(min(deadline - time.perf_counter(), 1.0), 0.0)
+                    if not conn.poll(remaining):
+                        break
+                    msg = conn.recv()
+                    if msg[0] == "stats" and isinstance(msg[1], dict):
+                        self.worker_stats[i] = msg[1]
+                        break
+            except (EOFError, BrokenPipeError, ConnectionResetError, OSError):
+                pass
         for proc in self._procs:
             remaining = deadline - time.perf_counter()
             if proc is None or remaining <= 0:
@@ -565,6 +645,42 @@ class AsyncSharedMemVectorEnv(VectorEnv):
             proc.join(timeout=remaining)
         for i in range(self.num_envs):
             self._kill_worker(i)
+        self._publish_pool_source()
+
+    def _publish_pool_source(self) -> None:
+        """Fold this pool's per-worker stats into the merged telemetry view
+        (obs/dist/aggregate): published into the process-local source
+        registry — the learner's telemetry picks it up directly; inside a
+        plane player it lands in the player's final sidecar as
+        ``env_pools`` and is lifted to ``player<k>/<pool>`` at the merge —
+        and mirrored as a sidecar file when a telemetry run dir exists."""
+        try:
+            from sheeprl_tpu.obs.dist import aggregate as _aggregate
+            from sheeprl_tpu.obs.telemetry import get_telemetry
+
+            snap = {
+                "num_envs": self.num_envs,
+                "worker_restarts": self.worker_restarts,
+                "degraded_to_sync": bool(self.degraded_to_sync),
+                "workers": {
+                    str(i): {
+                        "steps": int(self.worker_stats.get(i, {}).get("steps", 0)),
+                        "busy_s": round(
+                            float(self.worker_stats.get(i, {}).get("busy_s", 0.0)), 3
+                        ),
+                        "restarts": int(self._restart_counts[i]),
+                    }
+                    for i in range(self.num_envs)
+                },
+            }
+            _aggregate.publish_source(self.pool_name, snap)
+            tel = get_telemetry()
+            if tel is not None and tel.run_dir:
+                _aggregate.write_sidecar(
+                    os.path.join(tel.run_dir, "telemetry"), self.pool_name, snap
+                )
+        except Exception:
+            pass  # telemetry must never break env teardown
 
     def close(self, **kwargs) -> None:
         self.close_extras(**kwargs)

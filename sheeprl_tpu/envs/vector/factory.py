@@ -37,6 +37,7 @@ drifted; the per-algo ``evaluate.py`` files hand-rolled yet another
 
 from __future__ import annotations
 
+import os
 from typing import Callable, List, Optional, Sequence
 
 import gymnasium as gym
@@ -149,7 +150,13 @@ def _build_thunks(
     return thunks
 
 
-def vectorize_thunks(thunks: Sequence[Callable[[], gym.Env]], cfg, env_seeds_list=None):
+def vectorize_thunks(
+    thunks: Sequence[Callable[[], gym.Env]],
+    cfg,
+    env_seeds_list=None,
+    log_dir: Optional[str] = None,
+    rank: int = 0,
+):
     """Wrap prebuilt thunks in the configured vector backend (the factory's
     lower half — diagnostics/tools that need custom thunks enter here)."""
     mode = resolve_vectorization(cfg)
@@ -165,6 +172,26 @@ def vectorize_thunks(thunks: Sequence[Callable[[], gym.Env]], cfg, env_seeds_lis
     if mode == "async":
         from sheeprl_tpu.envs.vector.async_env import AsyncSharedMemVectorEnv
 
+        # distributed observability (obs/dist): on tracing runs each worker
+        # writes its own clock-aligned trace file under <log_dir>/telemetry,
+        # and the pool reports per-worker stats as source `envpool_r<rank>`
+        trace_dir = None
+        try:
+            from sheeprl_tpu.obs.spans import get_tracer
+            from sheeprl_tpu.obs.telemetry import get_telemetry
+
+            tel = get_telemetry()
+            tracer = get_tracer()
+            tracing = (tel is not None and tel.trace_enabled) or (
+                # plane player processes run no Telemetry but do carry a
+                # file-backed tracer (plane/worker.child_main) — their env
+                # workers trace alongside it
+                tel is None and tracer is not None and tracer.path
+            )
+            if tracing and log_dir:
+                trace_dir = os.path.join(log_dir, "telemetry")
+        except Exception:
+            trace_dir = None
         return AsyncSharedMemVectorEnv(
             thunks,
             env_seeds=env_seeds_list,
@@ -172,6 +199,8 @@ def vectorize_thunks(thunks: Sequence[Callable[[], gym.Env]], cfg, env_seeds_lis
             worker_timeout_s=float(cfg.env.get("worker_timeout_s", 60.0) or 0.0),
             max_worker_restarts=int(cfg.env.get("max_worker_restarts", 3)),
             restart_window_s=float(cfg.env.get("restart_window_s", 300.0) or 0.0),
+            trace_dir=trace_dir,
+            pool_name=f"envpool_r{int(rank)}",
         )
     from gymnasium.vector import AsyncVectorEnv, AutoresetMode
 
@@ -223,7 +252,13 @@ def make_vector_env(
         prefix,
         restart_on_exception,
     )
-    return vectorize_thunks(thunks, cfg, env_seeds_list=env_seeds(cfg.seed, rank, n_envs))
+    return vectorize_thunks(
+        thunks,
+        cfg,
+        env_seeds_list=env_seeds(cfg.seed, rank, n_envs),
+        log_dir=log_dir if is_zero else None,
+        rank=rank,
+    )
 
 
 def make_eval_env(

@@ -297,14 +297,21 @@ class Fabric:
         return optimizers if len(optimizers) > 1 else optimizers[0]
 
     # ------------------------------------------------------------------
-    # host-level collectives (cross-process; in-step collectives are XLA's)
+    # host-level collectives (cross-process; in-step collectives are XLA's).
+    # Every multi-process branch runs inside a measured comms span
+    # (obs/dist/comms.py): payload bytes, wall time, and achieved wire GB/s
+    # land in telemetry.json as comms_ms/comms_bytes + a per-kind breakdown
+    # — the instrumentation ROADMAP item 2's measured scaling study needs.
     # ------------------------------------------------------------------
 
     def barrier(self, name: str = "") -> None:
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices(name or "fabric-barrier")
+            from sheeprl_tpu.obs.dist.comms import collective_span
+
+            with collective_span("barrier"):
+                multihost_utils.sync_global_devices(name or "fabric-barrier")
 
     def all_gather(self, tree: Any) -> Any:
         """Gather a host-side pytree across processes → leaves with a new
@@ -313,18 +320,79 @@ class Fabric:
             return jax.tree_util.tree_map(lambda x: np.asarray(x)[None], tree)
         from jax.experimental import multihost_utils
 
-        return jax.tree_util.tree_map(
-            lambda x: np.asarray(multihost_utils.process_allgather(np.asarray(x))), tree
-        )
+        from sheeprl_tpu.obs.counters import tree_nbytes
+        from sheeprl_tpu.obs.dist.comms import collective_span
+
+        tree = jax.tree_util.tree_map(np.asarray, tree)
+        with collective_span(
+            "all_gather", payload_bytes=tree_nbytes(tree) * jax.process_count()
+        ):
+            return jax.tree_util.tree_map(
+                lambda x: np.asarray(multihost_utils.process_allgather(x)), tree
+            )
 
     def broadcast(self, tree: Any, src: int = 0) -> Any:
         if jax.process_count() == 1:
             return tree
         from jax.experimental import multihost_utils
 
-        return jax.tree_util.tree_map(
-            lambda x: np.asarray(multihost_utils.broadcast_one_to_all(np.asarray(x))), tree
-        )
+        from sheeprl_tpu.obs.counters import tree_nbytes
+        from sheeprl_tpu.obs.dist.comms import collective_span
+
+        tree = jax.tree_util.tree_map(np.asarray, tree)
+        with collective_span("broadcast", payload_bytes=tree_nbytes(tree)):
+            return jax.tree_util.tree_map(
+                lambda x: np.asarray(multihost_utils.broadcast_one_to_all(x)), tree
+            )
+
+    def all_reduce(self, tree: Any, op: str = "sum") -> Any:
+        """Sum (or mean) a host-side float pytree across processes with a
+        REAL on-the-wire all-reduce: leaves are committed to the world mesh
+        sharded over ``data`` and reduced by one jitted cross-process
+        program — the same collective XLA inserts for gradient syncs, so
+        timing this call measures the actual link (``tools/bench_comms.py``
+        times the 33 MB gradient payload through exactly this path).
+        Single-process: identity for ``sum``/``mean`` over one participant.
+        """
+        if op not in ("sum", "mean"):
+            raise ValueError(f"fabric.all_reduce supports op='sum'|'mean', got {op!r}")
+        if jax.process_count() == 1:
+            return jax.tree_util.tree_map(np.asarray, tree)
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        from sheeprl_tpu.obs.counters import tree_nbytes
+        from sheeprl_tpu.obs.dist.comms import collective_span
+
+        n_local = max(len(self.local_devices), 1)
+        denom = np.float32(n_local * (jax.process_count() if op == "mean" else 1))
+        reduce_fn = getattr(self, "_allreduce_fn", None)
+        if reduce_fn is None:
+            # cached so repeated calls (the bench's timed repeats) hit the
+            # jit cache instead of recompiling per call
+            reduce_fn = jax.jit(
+                lambda g, d: jnp.sum(g, axis=0) / d,
+                out_shardings=NamedSharding(self.mesh, P()),
+            )
+            self._allreduce_fn = reduce_fn
+
+        def _reduce_one(x: Any) -> np.ndarray:
+            x = np.asarray(x)  # plain float/int/list leaves are fine
+            if x.dtype.kind != "f":
+                x = x.astype(np.float32)
+            # every local device contributes one copy of this process's
+            # leaf; the global sum therefore counts each process n_local
+            # times — divided back out through `denom`
+            local = np.broadcast_to(x[None], (n_local, *x.shape))
+            garr = multihost_utils.host_local_array_to_global_array(
+                local, self.mesh, P(self.data_axis)
+            )
+            out = reduce_fn(garr, denom)
+            return np.asarray(jax.device_get(out.addressable_data(0)))
+
+        payload = tree_nbytes(jax.tree_util.tree_map(np.asarray, tree))
+        with collective_span("all_reduce", payload_bytes=payload):
+            return jax.tree_util.tree_map(_reduce_one, tree)
 
     # ------------------------------------------------------------------
     # checkpointing (reference fabric.save/load → Orbax pytree checkpoint)

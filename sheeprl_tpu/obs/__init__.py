@@ -50,6 +50,8 @@ from sheeprl_tpu.obs.counters import (
     staged_device_put,
     tree_nbytes,
 )
+from sheeprl_tpu.obs.dist.comms import collective_span, pmean, psum
+from sheeprl_tpu.obs.dist.staleness import StalenessTracker
 from sheeprl_tpu.obs.health import NonFiniteGuard, StallWatchdog
 from sheeprl_tpu.obs.hist import HistogramSet, StreamingHist
 from sheeprl_tpu.obs.live import (
@@ -87,6 +89,7 @@ __all__ = [
     "NonFiniteGuard",
     "PEAK_TFLOPS_BF16",
     "PromServer",
+    "StalenessTracker",
     "StallWatchdog",
     "StreamingHist",
     "Telemetry",
@@ -103,6 +106,7 @@ __all__ = [
     "add_prefetch",
     "add_ring_gather",
     "add_rollout_burst",
+    "collective_span",
     "count_h2d",
     "cost_flops",
     "device_memory_stats",
@@ -112,9 +116,11 @@ __all__ = [
     "log_sps_metrics",
     "mfu_pct",
     "note_plane_policy_version",
+    "pmean",
     "profile_tick",
     "profiler_capture",
     "prometheus_text",
+    "psum",
     "register_train_cost",
     "set_tracer",
     "setup_telemetry",

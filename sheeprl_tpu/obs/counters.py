@@ -118,10 +118,38 @@ class Counters:
         self.plane_traj_slabs = 0
         self.plane_policy_version = 0
         self.plane_player_restarts = 0
+        # distributed comms (obs/dist/comms.py): host-level collectives
+        # (fabric all-reduce/all-gather/broadcast/barrier) — total ops,
+        # payload bytes, wall ms, plus a per-kind breakdown with the last
+        # and best achieved wire GB/s (in-jit collectives are attributed by
+        # the xplane comms parser instead, obs/prof)
+        self.comms_ops = 0
+        self.comms_bytes = 0
+        self.comms_ms = 0.0
+        self.comms_by_kind: Dict[str, Dict[str, Any]] = {}
 
     def add(self, field: str, amount) -> None:
         with self._lock:
             setattr(self, field, getattr(self, field) + amount)
+
+    def add_comms(
+        self, kind: str, payload_bytes: int, ms: float, gbps: Optional[float] = None
+    ) -> None:
+        """Record one host-level collective (obs/dist/comms.py)."""
+        with self._lock:
+            self.comms_ops += 1
+            self.comms_bytes += int(payload_bytes)
+            self.comms_ms += float(ms)
+            k = self.comms_by_kind.setdefault(
+                kind, {"ops": 0, "bytes": 0, "ms": 0.0, "last_gbps": None, "best_gbps": None}
+            )
+            k["ops"] += 1
+            k["bytes"] += int(payload_bytes)
+            k["ms"] += float(ms)
+            if gbps is not None:
+                k["last_gbps"] = round(gbps, 3)
+                if k["best_gbps"] is None or gbps > k["best_gbps"]:
+                    k["best_gbps"] = round(gbps, 3)
 
     def as_dict(self) -> Dict[str, Any]:
         with self._lock:
@@ -151,6 +179,13 @@ class Counters:
                 "plane_traj_slabs": self.plane_traj_slabs,
                 "plane_policy_version": self.plane_policy_version,
                 "plane_player_restarts": self.plane_player_restarts,
+                "comms_ops": self.comms_ops,
+                "comms_bytes": self.comms_bytes,
+                "comms_ms": round(self.comms_ms, 3),
+                "comms": {
+                    kind: {**v, "ms": round(v["ms"], 3)}
+                    for kind, v in sorted(self.comms_by_kind.items())
+                },
             }
 
 

@@ -1,0 +1,57 @@
+"""Distributed observability plane: measured collectives, cross-process
+telemetry aggregation, and data-staleness lineage.
+
+The repo runs as a multi-process system — ``jax.distributed`` ranks
+(``fabric.py``), actor–learner plane players (``sheeprl_tpu/plane``), async
+env workers (``envs/vector``) — but the PR-1/4/8 observability layers were
+learner-process-centric. This package is the systemwide half
+(``howto/distributed_obs.md``):
+
+- :mod:`~sheeprl_tpu.obs.dist.comms` — host-level collective spans
+  (payload bytes, wall time, achieved GB/s vs the device-link peak registry)
+  wrapped around the fabric collectives, plus the in-jit ``pmean``/``psum``
+  chokepoints every algo train step routes its gradient sync through
+  (enforced by ``tools/lint_telemetry.py``; device time attributed by the
+  xplane comms parser in ``obs/prof``);
+- :mod:`~sheeprl_tpu.obs.dist.aggregate` — the rank-0/learner-side merge of
+  counters, histograms, and live snapshots from every source process
+  (ranks, plane players, env-worker pools) into ONE ``telemetry.json`` /
+  ``live.json`` view with a per-source breakdown;
+- :mod:`~sheeprl_tpu.obs.dist.staleness` — trajectory lineage: rows are
+  stamped at env-step/slab-commit time and training batches carry
+  ``sample_age_s`` and ``policy_lag_versions`` percentiles plus
+  slab/prefetch queue-depth gauges.
+
+Like the rest of ``obs``, everything here is a no-op until
+``setup_telemetry`` installs it.
+"""
+
+from sheeprl_tpu.obs.dist.aggregate import (
+    merge_into_summary,
+    publish_source,
+    read_sidecars,
+    source_snapshots,
+    write_sidecar,
+)
+from sheeprl_tpu.obs.dist.comms import (
+    all_gather as instrumented_all_gather,
+    collective_span,
+    pmean,
+    psum,
+    wire_bytes,
+)
+from sheeprl_tpu.obs.dist.staleness import StalenessTracker
+
+__all__ = [
+    "StalenessTracker",
+    "collective_span",
+    "instrumented_all_gather",
+    "merge_into_summary",
+    "pmean",
+    "psum",
+    "publish_source",
+    "read_sidecars",
+    "source_snapshots",
+    "wire_bytes",
+    "write_sidecar",
+]

@@ -106,6 +106,35 @@ class StreamingHist:
             if value > self.max:
                 self.max = value
 
+    def record_many(self, values) -> None:
+        """Record a whole array of observations vectorized (one ``np.log2``
+        over the batch instead of a Python call per element — the
+        staleness tracker observes every sampled row's age this way).
+        Bucketing is bit-identical to :meth:`record`: both compute
+        ``floor(log2(v) × 8)`` in float64."""
+        import numpy as np
+
+        vals = np.asarray(values, dtype=np.float64).reshape(-1)
+        if vals.size == 0:
+            return
+        pos = vals[vals > 0.0]
+        n_zero = int(vals.size - pos.size)
+        if pos.size:
+            idxs = np.floor(np.log2(pos) * _LOG_SCALE).astype(np.int64)
+            uniq, counts = np.unique(idxs, return_counts=True)
+            total, mx = float(pos.sum()), float(pos.max())
+        else:
+            uniq = counts = ()
+            total, mx = 0.0, 0.0
+        with self._lock:
+            self.n += int(vals.size)
+            self.zero += n_zero
+            for idx, c in zip(uniq, counts):
+                self.counts[int(idx)] = self.counts.get(int(idx), 0) + int(c)
+            self.sum += total
+            if mx > self.max:
+                self.max = mx
+
     def quantile(self, q: float) -> Optional[float]:
         """The ``q``-quantile (0..1) as the geometric mid of its bucket."""
         with self._lock:

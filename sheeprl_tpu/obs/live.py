@@ -216,7 +216,13 @@ def prometheus_text(snap: Dict[str, Any], prefix: str = "sheeprl") -> str:
 
     Scalars become ``<prefix>_<key>``; the per-phase percentile map becomes
     ``<prefix>_phase_duration_ms{phase="...",quantile="..."}`` plus a
-    ``.._count`` series; rolling rates ``<prefix>_rolling_<key>``.
+    ``.._count`` series; rolling rates ``<prefix>_rolling_<key>``. The
+    distributed sections (obs/dist) label instead of flattening: per-kind
+    collectives as ``<prefix>_comms_*{kind="..."}``, staleness percentiles
+    as ``<prefix>_sample_age_seconds{quantile="..."}`` /
+    ``<prefix>_policy_lag_versions{quantile="..."}``, queue gauges as
+    ``<prefix>_queue_depth{queue="..."}``, and every merged source
+    process's numeric counters as ``<prefix>_<key>{source="player0"}``.
     """
     lines = []
 
@@ -225,8 +231,9 @@ def prometheus_text(snap: Dict[str, Any], prefix: str = "sheeprl") -> str:
             return
         lines.append(f"{prefix}_{name}{labels} {float(value):g}")
 
+    skip = ("phase_percentiles", "rolling", "watchdog_beat_age_s", "comms", "staleness", "sources")
     for key, value in sorted(snap.items()):
-        if key in ("phase_percentiles", "rolling", "watchdog_beat_age_s"):
+        if key in skip:
             continue
         emit(_prom_name(key), value)
     for key, value in (snap.get("rolling") or {}).items():
@@ -242,6 +249,30 @@ def prometheus_text(snap: Dict[str, Any], prefix: str = "sheeprl") -> str:
                 pct.get(q_key),
                 '{phase="%s",quantile="%s"}' % (phase, q),
             )
+    for kind, rec in sorted((snap.get("comms") or {}).items()):
+        lbl = '{kind="%s"}' % kind
+        emit("comms_kind_ops", rec.get("ops"), lbl)
+        emit("comms_kind_bytes", rec.get("bytes"), lbl)
+        emit("comms_kind_ms", rec.get("ms"), lbl)
+        emit("comms_achieved_gbps", rec.get("last_gbps"), lbl)
+    stale = snap.get("staleness") or {}
+    for section, series, unit in (
+        ("sample_age_s", "sample_age_seconds", "s"),
+        ("policy_lag_versions", "policy_lag_versions", "v"),
+    ):
+        pct = stale.get(section) or {}
+        emit(f"{series}_count", pct.get("count"))
+        for q_key, q in ((f"p50_{unit}", "0.5"), (f"p95_{unit}", "0.95"), (f"p99_{unit}", "0.99")):
+            emit(series, pct.get(q_key), '{quantile="%s"}' % q)
+    for queue, gauge in sorted((stale.get("queue_depth") or {}).items()):
+        emit("queue_depth", gauge.get("last"), '{queue="%s"}' % queue)
+        emit("queue_depth_max", gauge.get("max"), '{queue="%s"}' % queue)
+    for source, src_snap in sorted((snap.get("sources") or {}).items()):
+        if not isinstance(src_snap, dict):
+            continue
+        lbl = '{source="%s"}' % source
+        for key, value in sorted(src_snap.items()):
+            emit(_prom_name(key), value, lbl)
     return "\n".join(lines) + "\n"
 
 

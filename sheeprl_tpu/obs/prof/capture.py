@@ -94,13 +94,23 @@ def analyze_trace(
     top = sorted(
         summary["modules"].items(), key=lambda kv: kv[1]["total_ms"], reverse=True
     )[:8]
+    # compute-vs-comms split of the profiled device time: collective-op
+    # self-time (all-reduce/all-gather/... HLO categories, obs/prof/xplane)
+    # attributed per train-step unit. Collectives run inside the train
+    # program, so the per-exec share divides by the train module's execs.
+    device_ms = round(ms_per_exec * dps / ws, 3) if ms_per_exec is not None else None
+    comms_total = summary.get("comms_ms_total")
+    comms_ms = compute_ms = None
+    if device_ms is not None and comms_total is not None and rec and rec["execs"]:
+        comms_ms = round(comms_total / rec["execs"] * dps / ws, 4)
+        compute_ms = round(max(device_ms - comms_ms, 0.0), 4)
     return {
         "trace_dir": trace_dir,
         "source": summary["source"],
         "train_module": train,
-        "device_ms_per_step": (
-            round(ms_per_exec * dps / ws, 3) if ms_per_exec is not None else None
-        ),
+        "device_ms_per_step": device_ms,
+        "comms_ms_per_step": comms_ms,
+        "compute_ms_per_step": compute_ms,
         "mfu_device_pct": roofline["mfu_pct"],
         "achieved_gbps": roofline["achieved_gbps"],
         "bandwidth_util_pct": roofline["bandwidth_util_pct"],

@@ -25,8 +25,10 @@ from typing import Any, Dict, Optional
 
 __all__ = [
     "DEVICE_PEAKS",
+    "LINK_PEAKS",
     "cost_bytes",
     "cost_of",
+    "detect_link_peaks",
     "detect_peaks",
     "roofline_analyze",
 ]
@@ -46,6 +48,55 @@ DEVICE_PEAKS = (
     (r"V100", {"label": "V100", "peak_tflops": 125.0, "peak_gbps": 900.0}),
     (r"RTX 3080|GeForce RTX 3080", {"label": "RTX 3080", "peak_tflops": 59.5, "peak_gbps": 760.0}),
 )
+
+
+#: device_kind pattern -> inter-chip link peak, GB/s per link per direction
+#: (ICI for TPUs from the public specs — the same ballpark
+#: tools/bench_scaling.py projects with; NVLink-generation numbers for the
+#: GPUs). The comms instrumentation (obs/dist/comms.py) reports achieved
+#: wire GB/s against this as `link_util_pct`.
+LINK_PEAKS = (
+    (r"TPU v6|Trillium", 90.0),
+    (r"TPU v5p", 100.0),
+    (r"TPU v5|v5 ?lite", 45.0),
+    (r"TPU v4", 50.0),
+    (r"TPU v3", 70.0),
+    (r"TPU v2", 62.5),
+    (r"H100", 450.0),
+    (r"A100", 300.0),
+    (r"V100", 150.0),
+)
+
+
+def detect_link_peaks(link_gbps: Optional[float] = None) -> Dict[str, Any]:
+    """Inter-chip link peak for this host's first jax device.
+
+    Returns ``{label, device_kind, link_gbps, estimated}``. On CPU test
+    meshes the "link" is the host's own memory system (gloo over loopback
+    for multi-process runs) — estimated from the DDR figure so the relative
+    utilization numbers stay meaningful; an explicit ``link_gbps`` override
+    always wins."""
+    kind = "unknown"
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        kind = getattr(dev, "device_kind", dev.platform)
+    except Exception:
+        pass
+    out: Dict[str, Any] = {"device_kind": kind, "estimated": False}
+    for pattern, gbps in LINK_PEAKS:
+        if re.search(pattern, kind, re.I):
+            out.update({"label": kind, "link_gbps": gbps})
+            break
+    else:
+        # CPU / unknown device: loopback collectives bottleneck on memcpy
+        # bandwidth — reuse the estimated DDR figure, flagged estimated
+        out.update({"label": f"{kind} (estimated link)", "link_gbps": _cpu_peaks()["peak_gbps"], "estimated": True})
+    if link_gbps:
+        out["link_gbps"] = float(link_gbps)
+        out["estimated"] = False
+    return out
 
 
 def _cpu_peaks() -> Dict[str, Any]:

@@ -249,13 +249,13 @@ def _module_records(
     return out
 
 
-def _top_ops(plane: Dict[str, Any], limit: int = 30) -> Dict[str, float]:
-    """Self-time (ms, summed over the capture) of the hottest XLA ops via a
-    stack sweep over the nested 'XLA Ops' events. 'Async XLA Ops' durations
-    overlap and must not be summed — that line is deliberately ignored."""
+def _op_self_times(plane: Dict[str, Any]) -> "collections.Counter":
+    """Per-op self-time (ps) via a stack sweep over the nested 'XLA Ops'
+    events. 'Async XLA Ops' durations overlap and must not be summed —
+    that line is deliberately ignored."""
     ops_line = next((l for l in plane["lines"] if l["name"] == "XLA Ops"), None)
     if ops_line is None:
-        return {}
+        return collections.Counter()
     names = plane["event_names"]
     evs = sorted(
         (off, off + dur, names.get(mid, f"op_{mid}"))
@@ -270,7 +270,30 @@ def _top_ops(plane: Dict[str, Any], limit: int = 30) -> Dict[str, float]:
             self_time[stack[-1][2]] -= min(end, stack[-1][1]) - start
         self_time[name] += end - start
         stack.append((start, end, name))
-    return {name: ps / 1e9 for name, ps in self_time.most_common(limit)}
+    return self_time
+
+
+def _top_ops(plane: Dict[str, Any], limit: int = 30) -> Dict[str, float]:
+    """Self-time (ms, summed over the capture) of the hottest XLA ops."""
+    return {name: ps / 1e9 for name, ps in _op_self_times(plane).most_common(limit)}
+
+
+#: HLO op-name categories that are collective communication — the device
+#: time XLA spends moving gradients/activations between chips rather than
+#: computing (sync-variant names like `all-reduce-start`/`-done` and fused
+#: spellings like `all-reduce.1` / `fusion.all-reduce` all match)
+_COLLECTIVE_OP = re.compile(
+    r"all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all",
+    re.I,
+)
+
+
+def _collective_ms(self_times: "collections.Counter") -> float:
+    """Total collective-op self-time (ms) over one capture — the comms half
+    of the compute-vs-comms split in profiled `device_ms_per_step`."""
+    return sum(
+        ps for name, ps in self_times.items() if _COLLECTIVE_OP.search(name)
+    ) / 1e9
 
 
 def summarize_space(planes: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -312,7 +335,13 @@ def summarize_space(planes: List[Dict[str, Any]]) -> Dict[str, Any]:
             if steps_line is not None
             else None
         )
-        out["top_ops"] = _top_ops(device_plane)
+        self_times = _op_self_times(device_plane)
+        out["top_ops"] = {
+            name: ps / 1e9 for name, ps in self_times.most_common(30)
+        }
+        # collective-op device time: present (possibly 0.0) whenever the
+        # trace carries an op line, None when ops were not recorded at all
+        out["comms_ms_total"] = round(_collective_ms(self_times), 4) if self_times else None
         return out
 
     # CPU fallback: PjitFunction(...) dispatch spans on the host plane
@@ -334,6 +363,7 @@ def summarize_space(planes: List[Dict[str, Any]]) -> Dict[str, Any]:
     out = _assemble(host_plane, "host", modules, events)
     out["steps_ms_total"] = None
     out["top_ops"] = {}
+    out["comms_ms_total"] = None  # host dispatch spans carry no op split
     return out
 
 

@@ -66,7 +66,14 @@ class TraceWriter:
     keeps its flight recorder armed.
     """
 
-    def __init__(self, path: Optional[str] = None, xla_annotations: bool = True, ring=None):
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        xla_annotations: bool = True,
+        ring=None,
+        pid: Optional[int] = None,
+        process_name: Optional[str] = None,
+    ):
         self.path = path
         self.xla_annotations = bool(xla_annotations)
         self.ring = ring
@@ -79,12 +86,18 @@ class TraceWriter:
         self._buffer: list[str] = []
         self._origin = time.perf_counter()
         self._named_threads: set[int] = set()
-        try:
-            import jax
+        if pid is not None:
+            # explicit track id: plane players and env workers must not
+            # collide with the learner's pid 0 in a merged Perfetto view
+            # (and must not import jax just to pick a number)
+            self._pid = int(pid)
+        else:
+            try:
+                import jax
 
-            self._pid = int(jax.process_index())
-        except Exception:
-            self._pid = 0
+                self._pid = int(jax.process_index())
+            except Exception:
+                self._pid = 0
         # wall-clock anchor so tools/trace_view.py can align per-rank files
         # captured by processes with different perf_counter origins
         self._emit(
@@ -95,6 +108,16 @@ class TraceWriter:
                 "args": {"unix_ts": time.time()},
             }
         )
+        if process_name:
+            # Perfetto/chrome://tracing label the whole track with this
+            self._emit(
+                {
+                    "ph": "M",
+                    "name": "process_name",
+                    "pid": self._pid,
+                    "args": {"name": process_name},
+                }
+            )
 
     # -- time ---------------------------------------------------------------
 

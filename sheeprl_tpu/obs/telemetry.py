@@ -115,10 +115,12 @@ class Telemetry:
             int(tcfg.get("serve_port") or 0) or None
         )
         self.histograms_enabled = bool(tcfg.get("histograms", True))
+        self.staleness_enabled = bool(tcfg.get("staleness", True))
         self._flight_cfg = dict(tcfg.get("flight", {}) or {})
         self._profile_cfg = dict(tcfg.get("profile", {}) or {})
 
         self.counters = _counters.Counters()
+        self.staleness = None  # StalenessTracker, built in start()
         self.tracer: Optional[TraceWriter] = None
         self.poller: Optional[_counters.DevicePoller] = None
         self.guard: Optional[NonFiniteGuard] = None
@@ -163,10 +165,16 @@ class Telemetry:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
+        from sheeprl_tpu.obs.dist import aggregate as _aggregate
+        from sheeprl_tpu.obs.dist import staleness as _staleness
         from sheeprl_tpu.obs.prof.capture import StepProfiler
 
         self.prof = StepProfiler(self._profile_cfg, self)
         _counters.install(self.counters)
+        _aggregate.clear_sources()
+        if self.staleness_enabled:
+            self.staleness = _staleness.StalenessTracker()
+            _staleness.install(self.staleness)
         if self.poll_interval_s > 0:
             self.poller = _counters.DevicePoller(self.poll_interval_s)
             self.poller.start()
@@ -210,14 +218,17 @@ class Telemetry:
             # event stream: run the writer file-less from the start
             self._open_tracer(None)
 
-    def _open_tracer(self, path: Optional[str]) -> None:
+    def _open_tracer(self, path: Optional[str], process_name: Optional[str] = None) -> None:
         if self.tracer is not None:
             return
         file_path = path if self.trace_enabled else None
         if file_path is None and self.flight is None:
             return
         self.tracer = TraceWriter(
-            file_path, xla_annotations=self.xla_annotations, ring=self.flight
+            file_path,
+            xla_annotations=self.xla_annotations,
+            ring=self.flight,
+            process_name=process_name,
         )
         set_tracer(self.tracer)
 
@@ -238,11 +249,14 @@ class Telemetry:
                 tel_dir, tag="" if self._rank == 0 else f"_r{self._rank}"
             )
         if self._rank != 0:
-            self._open_tracer(os.path.join(tel_dir, f"trace_rank{self._rank}.jsonl"))
+            self._open_tracer(
+                os.path.join(tel_dir, f"trace_rank{self._rank}.jsonl"),
+                process_name=f"rank{self._rank}",
+            )
             return
         if self.summary_path is None:
             self.summary_path = os.path.join(log_dir, "telemetry.json")
-        self._open_tracer(os.path.join(tel_dir, "trace.jsonl"))
+        self._open_tracer(os.path.join(tel_dir, "trace.jsonl"), process_name="learner")
         if self.live_interval_s > 0 or self.serve_port:
             self.live = LiveExporter(
                 self._live_snapshot,
@@ -322,6 +336,17 @@ class Telemetry:
             for dog in self._watchdogs
             for role, info in dog.beat_ages().items()
         }
+        # fold any source sidecars already on disk (exited players, closed
+        # env pools, other ranks) into the live view too — live.json is the
+        # same merged shape as the final telemetry.json. Staleness dumps are
+        # NOT merged here (that exact merge runs once, at finalize — doing
+        # it per live write would double-count).
+        if self.run_dir:
+            from sheeprl_tpu.obs.dist import aggregate as _aggregate
+
+            _aggregate.merge_into_summary(
+                snap, os.path.join(self.run_dir, "telemetry"), None
+            )
         return snap
 
     # -- run accounting -----------------------------------------------------
@@ -444,6 +469,8 @@ class Telemetry:
                     "step",
                     "source",
                     "train_module",
+                    "comms_ms_per_step",
+                    "compute_ms_per_step",
                     "achieved_gbps",
                     "bandwidth_util_pct",
                     "arithmetic_intensity",
@@ -452,6 +479,19 @@ class Telemetry:
                 )
             }
             out["prof"]["peaks"] = (p.get("peaks") or {}).get("label")
+        # distributed observability (obs/dist): data-staleness lineage plus
+        # the per-source breakdown of every process feeding this run
+        staleness = self.staleness.summary() if self.staleness is not None else None
+        out["staleness"] = staleness
+        age = (staleness or {}).get("sample_age_s") or {}
+        lag = (staleness or {}).get("policy_lag_versions") or {}
+        out["sample_age_p95_s"] = age.get("p95_s")
+        out["policy_lag_p95"] = lag.get("p95_v")
+        from sheeprl_tpu.obs.dist import aggregate as _aggregate
+
+        sources = _aggregate.source_snapshots()
+        if sources:
+            out["sources"] = sources
         if self.tracer is not None and self.tracer.path:
             out["trace_file"] = self.tracer.path
         return out
@@ -482,6 +522,33 @@ class Telemetry:
             except Exception:
                 pass  # a torn/foreign dump must not break finalize
 
+    def _merge_sources(self, summary: Dict[str, Any]) -> None:
+        """Cross-process telemetry merge (obs/dist/aggregate): ranks > 0
+        dump a full summary sidecar; rank 0 folds every sidecar (ranks,
+        plane players, env pools) plus the live source registry into this
+        run's final summary — ONE merged ``telemetry.json`` with summed
+        rank counters, merged staleness percentiles, and a per-source
+        breakdown under ``sources``."""
+        from sheeprl_tpu.obs.dist import aggregate as _aggregate
+
+        tel_dir = os.path.join(self.run_dir, "telemetry") if self.run_dir else None
+        if self._rank != 0:
+            if tel_dir is not None:
+                sidecar = dict(summary)
+                if self.staleness is not None:
+                    sidecar["staleness_dump"] = self.staleness.to_dict()
+                _aggregate.write_sidecar(tel_dir, f"rank{self._rank}", sidecar)
+            return
+        _aggregate.merge_into_summary(summary, tel_dir, self.staleness)
+        if self.staleness is not None:
+            # rank staleness dumps merged above — refresh the percentiles
+            staleness = self.staleness.summary()
+            summary["staleness"] = staleness
+            age = (staleness or {}).get("sample_age_s") or {}
+            lag = (staleness or {}).get("policy_lag_versions") or {}
+            summary["sample_age_p95_s"] = age.get("p95_s")
+            summary["policy_lag_p95"] = lag.get("p95_v")
+
     def finalize(
         self, print_summary: bool = True, error: Optional[BaseException] = None
     ) -> Optional[Dict[str, Any]]:
@@ -505,6 +572,7 @@ class Telemetry:
         _counters.set_compile_hook(None)
         self._sync_rank_hists()
         summary = self.summary()
+        self._merge_sources(summary)
         summary["crashed"] = error is not None
         if error is not None:
             summary["exception"] = f"{type(error).__name__}: {error}"[:300]
@@ -513,6 +581,10 @@ class Telemetry:
             self.tracer.close()
         _counters.install(None)
         _hist.install(None)
+        from sheeprl_tpu.obs.dist import staleness as _staleness
+
+        if _staleness.installed() is self.staleness:
+            _staleness.install(None)
         if self.summary_enabled and self.summary_path and self._rank == 0:
             os.makedirs(os.path.dirname(os.path.abspath(self.summary_path)), exist_ok=True)
             with open(self.summary_path, "w") as f:
@@ -573,6 +645,30 @@ class Telemetry:
                 f"  async envs: {s['env_steps_async']} steps · "
                 f"{s['env_worker_restarts']} worker restart(s)"
                 + (" · DEGRADED TO SYNC" if s.get("env_degraded_to_sync") else "")
+            )
+        if s.get("comms_ops"):
+            best = max(
+                (k.get("best_gbps") or 0.0 for k in (s.get("comms") or {}).values()),
+                default=0.0,
+            )
+            lines.append(
+                f"  comms: {s['comms_ops']} collective(s) · "
+                f"{fmt_bytes(s['comms_bytes'])} payload · {s['comms_ms']:.1f} ms"
+                + (f" · best {best:.2f} GB/s wire" if best else "")
+            )
+        stale = s.get("staleness") or {}
+        if stale.get("sample_age_s") or stale.get("policy_lag_versions"):
+            age = stale.get("sample_age_s") or {}
+            lag = stale.get("policy_lag_versions") or {}
+            bits = []
+            if age.get("p95_s") is not None:
+                bits.append(f"sample age p50/p95 {age['p50_s']:.2f}/{age['p95_s']:.2f} s")
+            if lag.get("p95_v") is not None:
+                bits.append(f"policy lag p95 {lag['p95_v']:.1f} version(s)")
+            lines.append("  staleness: " + " · ".join(bits))
+        if s.get("sources"):
+            lines.append(
+                f"  sources merged: {', '.join(sorted(s['sources']))}"
             )
         if s.get("plane_traj_slabs") or s.get("plane_player_restarts"):
             lines.append(

@@ -37,6 +37,9 @@ class BurstPayload:
     n_valid: int
     policy_version: int
     ep_stats: List[Tuple[float, float]] = field(default_factory=list)
+    #: wall clock of the player's commit (staleness lineage — mirrors
+    #: SlabHandle.commit_ts so both transports carry the same stamp)
+    commit_ts: float = 0.0
 
     def release(self) -> None:  # symmetric with SlabHandle
         pass
@@ -70,6 +73,10 @@ class LocalBurstQueue:
             return self._q.get(timeout=timeout)
         except _queue.Empty:
             return None
+
+    def depth(self) -> int:
+        """Committed bursts waiting for the learner (backpressure gauge)."""
+        return self._q.qsize()
 
     def drain(self) -> None:
         """Unblock a player stuck on a full queue during shutdown."""

@@ -29,6 +29,7 @@ error, not silent corruption.
 from __future__ import annotations
 
 import queue as _queue
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -93,6 +94,10 @@ class SlabHandle:
     ep_stats: List[Tuple[float, float]]
     _ring: Optional["TrajSlabRing"]
     _slot: int
+    #: wall clock of the player's commit — the staleness lineage stamp: the
+    #: learner hands it to the replay buffer so sample age is measured from
+    #: collection, not from the learner-side copy (obs/dist/staleness)
+    commit_ts: float = 0.0
 
     def release(self) -> None:
         if self._ring is not None:
@@ -172,8 +177,23 @@ class TrajSlabRing:
         ep_stats: Optional[List[Tuple[float, float]]] = None,
     ) -> None:
         self._filled.put(
-            (int(slot), int(first_update), int(n_valid), int(policy_version), list(ep_stats or []))
+            (
+                int(slot),
+                int(first_update),
+                int(n_valid),
+                int(policy_version),
+                list(ep_stats or []),
+                time.time(),
+            )
         )
+
+    def depth(self) -> Optional[int]:
+        """Committed slabs waiting for the learner (None where the platform
+        hides Queue.qsize) — the plane's backpressure gauge."""
+        try:
+            return int(self._filled.qsize())
+        except (NotImplementedError, OSError):
+            return None
 
     # -- learner side --------------------------------------------------------
 
@@ -181,7 +201,7 @@ class TrajSlabRing:
         """Next committed slab, or ``None`` on timeout (the supervisor uses
         short timeouts to interleave liveness checks with the wait)."""
         try:
-            slot, first_update, n_valid, version, ep_stats = self._filled.get(
+            slot, first_update, n_valid, version, ep_stats, commit_ts = self._filled.get(
                 timeout=timeout
             )
         except _queue.Empty:
@@ -194,6 +214,7 @@ class TrajSlabRing:
             ep_stats=ep_stats,
             _ring=self,
             _slot=slot,
+            commit_ts=commit_ts,
         )
 
     def close(self) -> None:

@@ -28,6 +28,8 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from sheeprl_tpu.obs.counters import add_plane_player_restart, add_plane_slabs, installed
+from sheeprl_tpu.obs.dist import aggregate as _aggregate
+from sheeprl_tpu.obs.dist import staleness as _staleness
 from sheeprl_tpu.plane.local import LocalBurstQueue, LocalPlayerHandle
 from sheeprl_tpu.plane.publish import (
     POLICY_DIR,
@@ -44,14 +46,31 @@ __all__ = [
     "resolve_plane_players",
 ]
 
-#: player-process counter fields folded into the learner's counters at exit
+#: player-process counter fields folded into the learner's counters — the
+#: supervisor folds DELTAS of these from each player's periodic cumulative
+#: snapshots (and the final one at exit), so the learner totals stay
+#: current mid-run without double counting; the raw snapshot additionally
+#: lands as source `player<k>` in the merged live/telemetry view
 _FOLDED_COUNTERS = (
     "env_steps_async",
     "env_worker_restarts",
     "env_degraded_to_sync",
     "act_dispatches",
     "rollout_bursts",
+    "env_steps_jax",
 )
+
+
+def _observe_burst_staleness(plane, policy_version: int, commit_ts: float, depth) -> None:
+    """Staleness lineage of one received burst (obs/dist/staleness): how
+    many published versions behind the collecting policy was, how deep the
+    slab queue sat, and the commit stamp the next ``rb.add`` should carry."""
+    published = getattr(plane, "_published_version", None)
+    if published is not None and policy_version >= 0:
+        _staleness.observe_policy_lag(max(published - int(policy_version), 0))
+    _staleness.note_queue_depth("plane_slab_queue", depth)
+    if commit_ts:
+        _staleness.stamp_next_add(commit_ts)
 
 
 def resolve_plane_players(cfg) -> int:
@@ -155,6 +174,7 @@ class LocalPlane:
         # same hard deadline as ProcessPlane.recv: a wedged player thread
         # (hung env step) must fail the run, not stall it silently forever
         self.recv_timeout_s = float(pcfg.get("recv_timeout_s", 300.0) or 0.0)
+        self._published_version: Optional[int] = None
 
     @property
     def stop(self):
@@ -169,6 +189,7 @@ class LocalPlane:
 
         with span("Time/policy_publish_time", phase="publish"):
             self.channel.publish(version, params)
+        self._published_version = int(version)
 
     def recv(self, idx: int, expected_first: int):
         """Next burst from the (single) player; raises if the thread died."""
@@ -184,6 +205,9 @@ class LocalPlane:
                         f"plane protocol drift: learner expected the burst at update "
                         f"{expected_first}, player sent {payload.first_update}"
                     )
+                _observe_burst_staleness(
+                    self, payload.policy_version, payload.commit_ts, self._queue.depth()
+                )
                 return payload
             self._handle.check()
             if not self._handle.alive():
@@ -245,6 +269,14 @@ class ProcessPlane:
         self.stop = self._mp.Event()
         self._events = self._mp.Queue()
         self._telemetry_enabled = installed() is not None
+        from sheeprl_tpu.obs import get_telemetry
+
+        tel = get_telemetry()
+        self._trace_enabled = bool(tel is not None and tel.trace_enabled)
+        self._published_version: Optional[int] = None
+        #: last cumulative counter snapshot per player — folding deltas
+        #: keeps the learner totals current without double counting
+        self._last_snaps: Dict[int, Dict[str, Any]] = {}
 
         self.publisher = PolicyPublisher(
             os.path.join(log_dir, POLICY_DIR),
@@ -291,6 +323,7 @@ class ProcessPlane:
             "scalars": self.scalars,
             "prng_impl": _prng_impl(),
             "telemetry": self._telemetry_enabled,
+            "trace": self._trace_enabled,
         }
         from sheeprl_tpu.plane.worker import child_main
 
@@ -312,6 +345,7 @@ class ProcessPlane:
 
         with span("Time/policy_publish_time", phase="publish"):
             self.publisher.publish(version, params)
+        self._published_version = int(version)
 
     # -- receive + fault tolerance -------------------------------------------
 
@@ -334,6 +368,12 @@ class ProcessPlane:
                         f"{expected_first} from player {idx}, got {handle.first_update}"
                     )
                 add_plane_slabs()
+                _observe_burst_staleness(
+                    self,
+                    handle.policy_version,
+                    handle.commit_ts,
+                    self._rings[idx].depth(),
+                )
                 return handle
             proc = self._procs[idx]
             if proc is not None and not proc.is_alive():
@@ -363,6 +403,9 @@ class ProcessPlane:
             "latest published policy" + (f"; error:\n{err}" if err else "")
         )
         add_plane_player_restart()
+        # the replacement's counters restart at zero — the delta fold must
+        # restart with them or its first snapshot looks like no progress
+        self._last_snaps.pop(idx, None)
         from sheeprl_tpu.obs import get_telemetry
 
         telemetry = get_telemetry()
@@ -382,16 +425,26 @@ class ProcessPlane:
             if kind == "error":
                 self._errors[int(idx)] = str(payload)
             elif kind == "telemetry":
-                self._fold_counters(payload)
+                self._fold_counters(int(idx), payload)
 
-    def _fold_counters(self, snap: Dict[str, Any]) -> None:
-        counters = installed()
-        if counters is None or not isinstance(snap, dict):
+    def _fold_counters(self, idx: int, snap: Dict[str, Any]) -> None:
+        """Fold one player's cumulative counter snapshot: the learner's
+        counters advance by the DELTA since that player's previous snapshot
+        (players now report periodically, not only at exit), and the raw
+        snapshot is published as source ``player<idx>`` for the merged
+        live.json / telemetry.json breakdown (obs/dist/aggregate)."""
+        if not isinstance(snap, dict):
             return
+        _aggregate.publish_source(f"player{idx}", snap)
+        counters = installed()
+        if counters is None:
+            return
+        last = self._last_snaps.get(idx, {})
         for field in _FOLDED_COUNTERS:
-            amount = snap.get(field, 0)
-            if amount:
-                counters.add(field, int(amount))
+            delta = int(snap.get(field, 0) or 0) - int(last.get(field, 0) or 0)
+            if delta > 0:  # a respawned player's counters restart at 0
+                counters.add(field, delta)
+        self._last_snaps[idx] = snap
 
     def check(self) -> None:
         self._drain_events()

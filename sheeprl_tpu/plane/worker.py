@@ -65,6 +65,8 @@ class LocalWriter:
         return None, views
 
     def commit(self, token_views, first_update, n_valid, version, ep_stats, stop=None) -> None:
+        import time
+
         from sheeprl_tpu.plane.local import BurstPayload
 
         data, views = token_views
@@ -75,6 +77,7 @@ class LocalWriter:
                 n_valid=int(n_valid),
                 policy_version=int(version),
                 ep_stats=list(ep_stats or []),
+                commit_ts=time.time(),
             ),
             stop=stop,
         )
@@ -125,6 +128,11 @@ class PlayerContext:
     # has no telemetry installed and is covered by the learner-side
     # plane.recv_timeout_s deadline instead.)
     watchdog: Any = None
+    #: process mode only: rate-limited callable pushing this player's
+    #: cumulative counter snapshot to the learner's event queue, so the
+    #: merged live.json carries a fresh per-player breakdown mid-run
+    #: (obs/dist/aggregate; the supervisor folds counter DELTAS)
+    telemetry_sink: Any = None
     _wd_role: str = field(default="", init=False, repr=False)
 
     def orphaned(self) -> bool:
@@ -226,6 +234,11 @@ class PlayerContext:
             ep_stats,
             stop=self.halt,
         )
+        if self.telemetry_sink is not None:
+            try:
+                self.telemetry_sink()
+            except Exception:
+                pass  # telemetry must never take a player down
         self.beat()
 
 
@@ -265,8 +278,28 @@ def child_main(spec: Dict[str, Any]) -> None:
     idx = int(spec["player_idx"])
     events = spec["events"]
     counters = hists = None
+    tracer = None
     if spec.get("telemetry"):
         counters, hists = _install_player_telemetry()
+        if spec.get("trace") and spec.get("log_dir"):
+            # the player's own span timeline (env steps, rollout bursts,
+            # policy waits) — clock_sync-anchored so tools/trace_view.py
+            # merges it onto the learner's Perfetto view; pid 100+idx keeps
+            # the track distinct from the learner (pid 0) and env workers
+            from sheeprl_tpu.obs.spans import TraceWriter, set_tracer
+
+            try:
+                tracer = TraceWriter(
+                    os.path.join(
+                        spec["log_dir"], "telemetry", f"trace_rank0_player{idx}.jsonl"
+                    ),
+                    xla_annotations=False,
+                    pid=100 + idx,
+                    process_name=f"player{idx}",
+                )
+                set_tracer(tracer)
+            except OSError:
+                tracer = None
 
     from sheeprl_tpu.plane.slabs import PlaneClosed
     from sheeprl_tpu.plane.publish import PolicyPoller
@@ -290,6 +323,23 @@ def child_main(spec: Dict[str, Any]) -> None:
         process_mode=True,
         parent_pid=os.getppid(),
     )
+
+    if counters is not None:
+        # periodic cumulative snapshots → the learner folds counter deltas
+        # and publishes the raw snapshot as source `player<idx>` (live.json
+        # breakdown while the run is still going)
+        sink_state = {"last": 0.0}
+
+        def _telemetry_sink(min_interval_s: float = 10.0) -> None:
+            import time as _time
+
+            now = _time.monotonic()
+            if now - sink_state["last"] < min_interval_s:
+                return
+            sink_state["last"] = now
+            events.put((idx, "telemetry", counters.as_dict()))
+
+        ctx.telemetry_sink = _telemetry_sink
 
     module_name, fn_name = str(spec["entry"]).split(":")
     run_player = getattr(importlib.import_module(module_name), fn_name)
@@ -323,6 +373,31 @@ def child_main(spec: Dict[str, Any]) -> None:
                     ),
                     hists.to_dict(),
                 )
+            except Exception:
+                pass
+        if counters is not None and spec.get("log_dir"):
+            # final per-player sidecar for the learner's finalize-time merge
+            # (obs/dist/aggregate): the whole counter dict, phase tails, and
+            # the env pools this player ran in-process (the pool published
+            # into this process's source registry at close — run_player's
+            # finally closed the envs before we got here)
+            try:
+                from sheeprl_tpu.obs.dist import aggregate as _aggregate
+
+                sidecar = dict(counters.as_dict())
+                sidecar["phase_percentiles"] = hists.percentiles() if hists else {}
+                sidecar["restart_count"] = int(spec.get("restart_count", 0))
+                pools = _aggregate.source_snapshots()
+                if pools:
+                    sidecar["env_pools"] = pools
+                _aggregate.write_sidecar(
+                    os.path.join(spec["log_dir"], "telemetry"), f"player{idx}", sidecar
+                )
+            except Exception:
+                pass
+        if tracer is not None:
+            try:
+                tracer.close()
             except Exception:
                 pass
     sys.exit(rc)

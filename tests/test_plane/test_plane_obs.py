@@ -1,0 +1,114 @@
+"""Merged distributed telemetry over the actor–learner plane (ISSUE 9).
+
+The acceptance gate: ONE merged ``telemetry.json``/``live.json`` covering
+learner + plane players + env workers in a 2-player plane run, with the
+plane SAC run reporting ``sample_age_s`` and ``policy_lag_versions``
+percentiles — plus the multi-source trace merge (learner + players +
+env workers on one clock-aligned Perfetto timeline).
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sheeprl_tpu import cli
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _plane_args(tmp_path):
+    return [
+        "exp=sac_decoupled",
+        "plane.num_players=2",
+        "fabric.devices=2",
+        "fabric.accelerator=cpu",
+        "env.id=Pendulum-v1",
+        "env.num_envs=2",
+        "env.capture_video=False",
+        "env.vectorization=async",
+        "buffer.memmap=False",
+        "buffer.size=1024",
+        "buffer.prefetch=False",
+        "per_rank_batch_size=8",
+        "total_steps=320",
+        "algo.learning_starts=96",
+        "algo.hidden_size=8",
+        "algo.run_test=False",
+        "metric.log_level=0",
+        "metric.log_every=1000000",
+        "checkpoint.every=1000000",
+        "checkpoint.save_last=False",
+        "metric=telemetry",
+        "metric.telemetry.poll_interval_s=0",
+        "metric.telemetry.live_interval_s=5",
+        f"root_dir={tmp_path}/obs",
+        "run_name=test",
+    ]
+
+
+def test_two_player_plane_run_merges_all_sources(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cli.run(_plane_args(tmp_path))
+
+    t_files = glob.glob(f"{tmp_path}/obs/**/telemetry.json", recursive=True)
+    assert t_files, "telemetry.json missing"
+    run_dir = os.path.dirname(sorted(t_files)[-1])
+    doc = json.load(open(sorted(t_files)[-1]))
+
+    # ONE merged view: learner counters + every source process
+    sources = doc.get("sources") or {}
+    assert "player0" in sources and "player1" in sources, sorted(sources)
+    pools = [s for s in sources if "envpool" in s]
+    assert pools, f"no env-worker pool source in {sorted(sources)}"
+    # env workers report per-worker detail through the player sidecars
+    lifted = [s for s in pools if "/" in s]
+    assert lifted, sorted(pools)
+    workers = sources[lifted[0]]["workers"]
+    assert sum(int(w["steps"]) for w in workers.values()) > 0
+    # players' shared counters were folded into the learner totals
+    assert doc["env_steps_async"] > 0
+    assert sources["player0"]["act_dispatches"] > 0
+
+    # staleness lineage: the plane SAC run reports both distributions
+    stale = doc.get("staleness") or {}
+    assert stale.get("sample_age_s", {}).get("p95_s") is not None
+    assert stale.get("policy_lag_versions", {}).get("p95_v") is not None
+    assert doc.get("sample_age_p95_s") is not None
+    assert "plane_slab_queue" in stale.get("queue_depth", {})
+
+    # live.json carries the same merged shape (final write is post-drain)
+    live = json.load(open(os.path.join(run_dir, "telemetry", "live.json")))
+    live_sources = live.get("sources") or {}
+    assert "player0" in live_sources and "player1" in live_sources
+    assert any("envpool" in s for s in live_sources)
+
+    # the multi-source trace merge: learner + players + env workers align
+    # onto one Perfetto timeline with distinct process tracks
+    trace_files = glob.glob(os.path.join(run_dir, "telemetry", "trace*.jsonl"))
+    names = [os.path.basename(p) for p in trace_files]
+    assert "trace.jsonl" in names
+    assert any(n.startswith("trace_rank0_player") for n in names), names
+    assert any(n.startswith("trace_envworker") for n in names), names
+    out_path = str(tmp_path / "merged_trace.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"), run_dir, "-o", out_path],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    merged = json.load(open(out_path))["traceEvents"]
+    pids = {e.get("pid") for e in merged}
+    assert 0 in pids  # learner
+    assert any(isinstance(p, int) and 100 <= p < 1000 for p in pids)  # players
+    assert any(isinstance(p, int) and p >= 1000 for p in pids)  # env workers
+    proc_names = {
+        (e.get("args") or {}).get("name")
+        for e in merged
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert {"learner", "player0"} <= proc_names, proc_names

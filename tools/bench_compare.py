@@ -77,23 +77,40 @@ def goodness_change(old: Dict[str, Any], new: Dict[str, Any]) -> Optional[float]
     return 1.0 - nv / ov
 
 
+#: higher-is-better sub-metric keys (everything in _LOWER_KEYS or ending
+#: in _ms/_s is lower-better)
+_HIGHER_KEYS = ("mfu", "mfu_pct", "mfu_device_pct", "achieved_allreduce_gbps")
+#: distributed-observability keys (obs/dist) diffed unit-directionally:
+#: collective wall time, staleness percentiles, and the comms/compute split
+#: of profiled device time
+_LOWER_KEYS = (
+    "device_ms_per_step",
+    "comms_ms",
+    "comms_ms_per_step",
+    "sample_age_p95_s",
+    "policy_lag_p95",
+)
+
+
 def _sub_metrics(line: Dict[str, Any]) -> Dict[str, Tuple[float, bool]]:
     """Diffable sub-metrics riding on an evidence line beyond ``value``:
     the computed ``sps`` (higher-better), the folded phase tails
-    (``telemetry.*_p50_ms``/``*_p95_ms``, lower-better), and the profiled
+    (``telemetry.*_p50_ms``/``*_p95_ms``, lower-better), the profiled
     roofline numbers (``device_ms_per_step`` lower-better, ``mfu_pct``
-    higher-better — on the line itself or folded under ``telemetry``) — so
-    a bench line carries regression coverage for its device-time
-    decomposition, not just its wall-clock."""
+    higher-better), and the distributed-observability keys
+    (``comms_ms``/``comms_ms_per_step``/``sample_age_p95_s``/
+    ``policy_lag_p95`` lower-better, ``achieved_allreduce_gbps``
+    higher-better) — on the line itself or folded under ``telemetry`` — so
+    a bench line carries regression coverage for its device-time and
+    staleness decomposition, not just its wall-clock."""
     out: Dict[str, Tuple[float, bool]] = {}
     if isinstance(line.get("sps"), (int, float)):
         out["sps"] = (float(line["sps"]), True)
-    # profiled device time / MFU on the evidence line itself (bench_dreamer)
-    for key, higher in (
-        ("device_ms_per_step", False),
-        ("mfu_pct", True),
-        ("mfu_device_pct", True),
-    ):
+    # directional keys on the evidence line itself (bench_dreamer,
+    # bench_comms rows)
+    for key, higher in [(k, False) for k in _LOWER_KEYS] + [
+        (k, True) for k in _HIGHER_KEYS if k != "mfu"
+    ]:
         if isinstance(line.get(key), (int, float)) and line[key] > 0:
             out[key] = (float(line[key]), higher)
     tel = line.get("telemetry")
@@ -101,9 +118,9 @@ def _sub_metrics(line: Dict[str, Any]) -> Dict[str, Tuple[float, bool]]:
         for key, val in tel.items():
             if not isinstance(val, (int, float)) or val <= 0:
                 continue
-            if key in ("mfu", "mfu_pct", "mfu_device_pct"):
+            if key in _HIGHER_KEYS:
                 out[f"telemetry.{key}"] = (float(val), True)
-            elif key.endswith("_ms") or key == "device_ms_per_step":
+            elif key in _LOWER_KEYS or key.endswith("_ms") or key.endswith("_p95_s"):
                 out[f"telemetry.{key}"] = (float(val), False)
     return out
 

@@ -41,14 +41,15 @@ ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
 #: recurrent/stateful players). The decoupled entrypoints (sac_decoupled,
 #: ppo_decoupled) were delisted when their players moved onto the
 #: actor–learner plane acting through BurstActor (sheeprl_tpu/plane,
-#: algos/{sac,ppo}/player.py). Keep in sync with howto/rollout_engine.md's
-#: support matrix.
+#: algos/{sac,ppo}/player.py); droq and sac_ae were delisted when their
+#: coupled acting loops moved onto the shared BurstActor (K=1 default is
+#: bitwise the old per-step path). Keep in sync with
+#: howto/rollout_engine.md's support matrix.
 GRANDFATHERED = {
     "a2c/a2c.py",
     "dreamer_v1/dreamer_v1.py",
     "dreamer_v2/dreamer_v2.py",
     "dreamer_v3/dreamer_v3.py",
-    "droq/droq.py",
     "p2e_dv1/p2e_dv1_exploration.py",
     "p2e_dv1/p2e_dv1_finetuning.py",
     "p2e_dv2/p2e_dv2_exploration.py",
@@ -56,7 +57,6 @@ GRANDFATHERED = {
     "p2e_dv3/p2e_dv3_exploration.py",
     "p2e_dv3/p2e_dv3_finetuning.py",
     "ppo_recurrent/ppo_recurrent.py",
-    "sac_ae/sac_ae.py",
 }
 
 #: helper files that legitimately step envs per-step (single eval episodes)

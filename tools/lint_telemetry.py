@@ -21,7 +21,15 @@ lint fails when a file under ``sheeprl_tpu/algos/`` re-grows its own copy:
   the same file — the in-run device-profile scheduler (``obs/prof``)
   advances at the log boundary, so an entrypoint that logs rates but never
   ticks the profiler silently opts out of ``device_ms_per_step``/roofline
-  coverage.
+  coverage;
+- a raw collective — ``jax.lax.pmean``/``psum``/``all_gather``/... or a
+  direct ``fabric.all_gather``/``broadcast``/``barrier``/``all_reduce``
+  call — instead of the instrumented chokepoints in
+  ``sheeprl_tpu/obs/dist/comms.py``: in-jit collectives must route through
+  ``obs.dist.pmean``/``psum``/``instrumented_all_gather`` (so the xplane
+  comms attribution is the agreed measurement and a future overlap rewrite
+  is one edit), and host-level collectives through the fabric methods'
+  measured spans only via shared infrastructure, never ad hoc in an algo.
 
 AST-based, so comments and docstrings mentioning the metric names are fine.
 
@@ -41,6 +49,32 @@ ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
 FORBIDDEN_LITERAL_PREFIXES = ("Time/sps_", "Perf/mfu")
 FORBIDDEN_TIMER_CALLS = ("compute", "reset")
 FORBIDDEN_CLOCK_ATTRS = ("time", "perf_counter", "monotonic")
+#: in-jit collective ops that must go through sheeprl_tpu/obs/dist/comms.py
+FORBIDDEN_LAX_COLLECTIVES = (
+    "pmean",
+    "psum",
+    "psum_scatter",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+)
+#: host-level fabric collectives algos must not call ad hoc (shared
+#: infrastructure — utils/, plane/, obs/ — owns those call sites)
+FORBIDDEN_FABRIC_COLLECTIVES = ("all_gather", "all_reduce", "broadcast", "barrier")
+
+
+def _is_lax_base(node: ast.AST) -> bool:
+    """True for ``lax`` or ``jax.lax`` attribute bases."""
+    if isinstance(node, ast.Name):
+        return node.id == "lax"
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "lax"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    )
 
 
 def _docstring_nodes(tree: ast.AST) -> set:
@@ -143,6 +177,41 @@ def lint_file(path: str) -> list:
                      "already time this loop (and feed the histograms/flight "
                      "recorder); for the env-gated loop-latency printout use "
                      "sheeprl_tpu.obs.LoopProbe")
+                )
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in FORBIDDEN_LAX_COLLECTIVES
+                and _is_lax_base(fn.value)
+            ):
+                chokepoint = {
+                    "pmean": "sheeprl_tpu.obs.dist.pmean",
+                    "psum": "sheeprl_tpu.obs.dist.psum",
+                    "all_gather": "sheeprl_tpu.obs.dist.instrumented_all_gather",
+                }.get(fn.attr)
+                findings.append(
+                    (node.lineno,
+                     f"raw jax.lax.{fn.attr}() collective — "
+                     + (
+                         f"route it through {chokepoint}"
+                         if chokepoint
+                         else "add a matching chokepoint to "
+                         "sheeprl_tpu/obs/dist/comms.py and route through it"
+                     )
+                     + " so the comms attribution (obs/prof xplane collective "
+                     "split) measures it")
+                )
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in FORBIDDEN_FABRIC_COLLECTIVES
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "fabric"
+            ):
+                findings.append(
+                    (node.lineno,
+                     f"ad-hoc fabric.{fn.attr}() host collective in an algo "
+                     "entrypoint — host-level collectives belong to shared "
+                     "infrastructure (plane/ckpt/obs), where their measured "
+                     "comms spans are maintained (obs/dist/comms.py)")
                 )
     return findings
 

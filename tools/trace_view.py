@@ -2,13 +2,24 @@
 """Merge telemetry trace JSONL files into one Perfetto-loadable trace.json.
 
 The run tracer writes one Chrome trace-event object per line into
-``<log_dir>/telemetry/trace.jsonl`` (plus ``trace_rank<k>.jsonl`` per extra
-process in multi-host runs). Each file's ``ts`` values are microseconds
-relative to *that tracer's* start, so per-rank files from decoupled runs
-cannot simply be concatenated — this tool aligns them on the ``clock_sync``
-wall-clock anchor every tracer emits at open, shifts each file onto the
-earliest tracer's timeline, and wraps everything in the JSON array Perfetto
-and ``chrome://tracing`` expect. It replaces the old
+``<log_dir>/telemetry/trace.jsonl``, and every other process of a
+distributed run writes its own file in the same dir:
+
+- ``trace_rank<k>.jsonl`` — extra ``jax.distributed`` ranks;
+- ``trace_rank0_player<k>.jsonl`` — actor–learner plane player processes
+  (pid 100+k, labeled ``player<k>``);
+- ``trace_envworker<i>*.jsonl`` — async env-pool workers (pid 1000+i,
+  labeled ``envworker<i>``; a ``_g<n>`` suffix marks post-restart
+  generations).
+
+Each file's ``ts`` values are microseconds relative to *that tracer's*
+start, so the files cannot simply be concatenated — this tool aligns them
+on the ``clock_sync`` wall-clock anchor every tracer emits at open, shifts
+each file onto the earliest tracer's timeline, and wraps everything in the
+JSON array Perfetto and ``chrome://tracing`` expect: ONE view showing the
+learner's train steps, each player's env/rollout spans, and each worker's
+``env_step`` spans on a common clock. The per-process ``process_name``
+metadata every tracer now emits labels the tracks. It replaces the old
 ``jq -s . trace.jsonl > trace.json`` shuffle (which could neither merge nor
 align).
 
