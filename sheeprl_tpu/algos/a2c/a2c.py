@@ -31,6 +31,7 @@ from sheeprl_tpu.algos.ppo.agent import (
     evaluate_actions,
     sample_actions,
 )
+from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
@@ -202,14 +203,15 @@ def main(fabric, cfg: Dict[str, Any]):
         obs_keys=obs_keys,
     )
 
-    @jax.jit
-    def policy_step_fn(params, obs, key):
-        # key advances inside the jitted call: one host dispatch per env step
+    def _act_fn(params, obs, key):
+        # the key advances INSIDE the jitted burst (one dispatch per
+        # env.act_burst env steps); the body is the old per-step
+        # policy_step_fn verbatim, so act_burst=1 reproduces it bitwise
         key, sub = jax.random.split(key)
         norm = normalize_obs(obs, cnn_keys, obs_keys)
         pre_dist, values = agent.apply({"params": params}, norm)
-        actions, real_actions, logprob = sample_actions(pre_dist, is_continuous, sub)
-        return actions, real_actions, logprob, values, key
+        actions, real_actions, _logprob = sample_actions(pre_dist, is_continuous, sub)
+        return (actions, real_actions, values), key
 
     @jax.jit
     def value_fn(params, obs):
@@ -251,59 +253,88 @@ def main(fabric, cfg: Dict[str, Any]):
     root_key, play_key = jax.random.split(root_key)
     play_key = to_host.put_key(play_key)
 
-    for update in range(start_step, num_updates + 1):
-        for _ in range(rollout_steps):
-            policy_step += n_envs
+    # Burst acting (envs/rollout, howto/rollout_engine.md): the acting loop
+    # body below is the old per-step block moved into a host callback; the
+    # BurstActor scans it env.act_burst times per device dispatch.
+    act_burst = max(int(cfg.env.get("act_burst", 1) or 1), 1)
+    state_box = {"obs": next_obs, "policy_step": policy_step}
+    #: (ring row, truncated env ids, prepared final obs) per truncation —
+    #: the V(s') bootstrap is patched into the stored rewards after the
+    #: burst returns (the jitted burst cannot re-enter the device)
+    trunc_events = []
 
-            with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
-                actions_j, real_actions_j, logprob_j, values_j, play_key = policy_step_fn(
-                    play_params, next_obs, play_key
-                )
-                real_actions = np.asarray(real_actions_j)
-                obs, rewards, terminated, truncated, info = envs.step(
-                    real_actions.reshape(envs.action_space.shape)
-                )
+    def _host_env_step(actions, real_actions, values):
+        state_box["policy_step"] += n_envs
+        with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
+            real_actions = np.asarray(real_actions)
+            obs, rewards, terminated, truncated, info = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
 
-                truncated_envs = np.nonzero(truncated)[0]
-                if len(truncated_envs) > 0:
-                    final_obs = info["final_obs"]
-                    t_obs = {
-                        k: np.stack([np.asarray(final_obs[te][k]) for te in truncated_envs])
-                        for k in obs_keys
-                    }
-                    t_obs = prepare_obs(t_obs, cnn_keys, len(truncated_envs))
-                    vals = np.asarray(value_fn(play_params, t_obs)).reshape(-1)
-                    rewards = np.asarray(rewards, dtype=np.float32)
-                    rewards[truncated_envs] += vals
-
-                dones = np.logical_or(terminated, truncated).astype(np.float32)
-                rewards = np.asarray(rewards, dtype=np.float32)
-
-            step_data = {
-                **{k: np.asarray(next_obs[k])[None] for k in obs_keys},
-                "dones": dones.reshape(1, n_envs, 1),
-                "values": np.asarray(values_j).reshape(1, n_envs, 1),
-                "actions": np.asarray(actions_j).reshape(1, n_envs, -1),
-                "rewards": rewards.reshape(1, n_envs, 1),
+        truncated_envs = np.nonzero(truncated)[0]
+        if len(truncated_envs) > 0:
+            # bootstrap V(s') into the reward on truncation, deferred to the
+            # end of the burst
+            final_obs = info["final_obs"]
+            t_obs = {
+                k: np.stack([np.asarray(final_obs[te][k]) for te in truncated_envs])
+                for k in obs_keys
             }
-            rb.add(step_data)
+            t_obs = prepare_obs(t_obs, cnn_keys, len(truncated_envs))
+            trunc_events.append((int(rb._pos), truncated_envs, t_obs))
 
-            next_obs = prepare_obs(obs, cnn_keys, n_envs)
+        dones = np.logical_or(terminated, truncated).astype(np.float32)
+        rewards = np.asarray(rewards, dtype=np.float32)
 
-            if cfg.metric.log_level > 0 and "final_info" in info:
-                fi = info["final_info"]
-                if isinstance(fi, dict) and "episode" in fi:
-                    mask = np.asarray(fi.get("_episode", []), dtype=bool)
-                    for i in np.nonzero(mask)[0]:
-                        ep_rew = float(fi["episode"]["r"][i])
-                        ep_len = float(fi["episode"]["l"][i])
-                        if aggregator and "Rewards/rew_avg" in aggregator:
-                            aggregator.update("Rewards/rew_avg", ep_rew)
-                        if aggregator and "Game/ep_len_avg" in aggregator:
-                            aggregator.update("Game/ep_len_avg", ep_len)
-                        fabric.print(
-                            f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}"
-                        )
+        step_data = {
+            **{k: np.asarray(state_box["obs"][k])[None] for k in obs_keys},
+            "dones": dones.reshape(1, n_envs, 1),
+            "values": np.asarray(values).reshape(1, n_envs, 1),
+            "actions": np.asarray(actions).reshape(1, n_envs, -1),
+            "rewards": rewards.reshape(1, n_envs, 1),
+        }
+        rb.add(step_data)
+
+        state_box["obs"] = prepare_obs(obs, cnn_keys, n_envs)
+
+        if cfg.metric.log_level > 0 and "final_info" in info:
+            fi = info["final_info"]
+            if isinstance(fi, dict) and "episode" in fi:
+                mask = np.asarray(fi.get("_episode", []), dtype=bool)
+                for i in np.nonzero(mask)[0]:
+                    ep_rew = float(fi["episode"]["r"][i])
+                    ep_len = float(fi["episode"]["l"][i])
+                    if aggregator and "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if aggregator and "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                    fabric.print(
+                        f"Rank-0: policy_step={state_box['policy_step']}, reward_env_{i}={ep_rew}"
+                    )
+        return state_box["obs"]
+
+    burst_actor = BurstActor(_act_fn, _host_env_step, next_obs)
+
+    for update in range(start_step, num_updates + 1):
+        remaining = rollout_steps
+        while remaining > 0:
+            n_act = min(act_burst, remaining)
+            with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+                _, play_key = burst_actor.rollout(
+                    play_params, state_box["obs"], play_key, n_act
+                )
+            remaining -= n_act
+        policy_step = state_box["policy_step"]
+
+        # patch the deferred V(s') truncation bootstraps into the stored
+        # rewards (play_params were frozen for the whole rollout, so the
+        # values match what the per-step path computed inline)
+        for row, tr_envs, t_obs in trunc_events:
+            vals = np.asarray(value_fn(play_params, t_obs)).reshape(-1)
+            rewards_buf = rb["rewards"]
+            rewards_buf[row, tr_envs, 0] = rewards_buf[row, tr_envs, 0] + vals
+        trunc_events.clear()
+        next_obs = state_box["obs"]
 
         next_values = value_fn(play_params, next_obs)
         returns, advantages = gae_fn(

@@ -71,10 +71,12 @@ from sheeprl_tpu.obs import (
     log_sps_metrics,
     profile_tick,
     register_train_cost,
+    set_shard_footprint,
     shape_specs,
     span,
 )
 from sheeprl_tpu.obs.dist import pmean
+from sheeprl_tpu.parallel.shard import measured_bytes_per_device
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -92,14 +94,30 @@ def build_train_fn(
     fabric,
     actions_dim: Sequence[int],
     is_continuous: bool,
+    plan=None,
 ):
     """Compile one full DreamerV3 gradient step as a single SPMD program.
 
     Returns ``train_step(agent_state, data, key, tau) -> (agent_state,
     metrics)`` where ``data`` leaves are ``[T, B_total, ...]`` (B sharded over
     the mesh) and ``tau`` is the dynamic target-EMA coefficient (0 = skip).
+
+    ``plan`` (a :class:`~sheeprl_tpu.parallel.shard.ShardingPlan` over the
+    agent-state tree, from ``fabric.shard_plan``) switches the program onto
+    the ``{'data','model'}`` mesh as ONE GSPMD program: no manual shard_map
+    region at all — ``axis=None`` turns the per-shard gradient pmean and the
+    rank-decorrelating fold_in into identities (the loss already spans the
+    global batch, so its gradient IS the all-reduced gradient), params and
+    optimizer state enter via ``in_shardings``/``out_shardings`` with the
+    plan's model-axis specs, and XLA inserts every collective (batch-dim
+    all-reduces on the data axis, all-gather/reduce-scatter on the model
+    axis). This sidesteps the jax-0.4-era partitioner, which CHECK-fails on
+    ``lax.scan`` inside a partially-manual (``auto=``) shard_map region.
+    ``plan=None`` keeps the manual data-parallel shard_map program
+    byte-identical to the pure data-parallel runtime.
     """
-    axis = fabric.data_axis
+    data_axis = fabric.data_axis
+    axis = data_axis if plan is None else None
     cnn_keys = tuple(cfg.cnn_keys.encoder)
     mlp_keys = tuple(cfg.mlp_keys.encoder)
     cnn_dec_keys = tuple(cfg.cnn_keys.decoder)
@@ -351,7 +369,10 @@ def build_train_fn(
     def local_step(agent_state, data, key, tau):
         # de-correlate sampling noise across shards: each device works on a
         # different slice of the batch and must draw different latents
-        key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        if axis is not None:
+            # manual data-parallel program: decorrelate the per-shard noise
+            # (the global GSPMD program draws [B_total] noise from one key)
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
         params = agent_state["params"]
         opt = agent_state["opt"]
 
@@ -427,14 +448,24 @@ def build_train_fn(
         }
         return new_state, metrics
 
-    shmapped = shard_map(
-        local_step,
-        mesh=fabric.mesh,
-        in_specs=(P(), P(None, axis), P(), P()),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    step_fn = jax.jit(shmapped, donate_argnums=(0,))
+    if plan is None:
+        shmapped = shard_map(
+            local_step,
+            mesh=fabric.mesh,
+            in_specs=(P(), P(None, data_axis), P(), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        step_fn = jax.jit(shmapped, donate_argnums=(0,))
+    else:
+        state_sh = plan.shardings()
+        rep = fabric.replicated
+        step_fn = jax.jit(
+            local_step,
+            in_shardings=(state_sh, fabric.sharding(None, data_axis), rep, rep),
+            out_shardings=(state_sh, rep),
+            donate_argnums=(0,),
+        )
 
     # Burst variant: a whole training burst (n_samples gradient steps) as ONE
     # program — a lax.scan over the stacked [n, T, B, ...] batches. On a
@@ -457,14 +488,27 @@ def build_train_fn(
         # the aggregator consumed only the burst's last metrics already
         return state, jax.tree_util.tree_map(lambda m: m[-1], metrics), packed
 
-    burst_shmapped = shard_map(
-        local_burst,
-        mesh=fabric.mesh,
-        in_specs=(P(), P(None, None, axis), P(), P()),
-        out_specs=(P(), P(), P()),
-        check_vma=False,
-    )
-    burst_fn = jax.jit(burst_shmapped, donate_argnums=(0,))
+    if plan is None:
+        burst_shmapped = shard_map(
+            local_burst,
+            mesh=fabric.mesh,
+            in_specs=(P(), P(None, None, data_axis), P(), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        burst_fn = jax.jit(burst_shmapped, donate_argnums=(0,))
+    else:
+        state_sh = plan.shardings()
+        rep = fabric.replicated
+        # the packed acting vector leaves replicated: the player consumes it
+        # whole (single-device burst acting / host mirror), so the all-gather
+        # happens once here instead of at every acting dispatch
+        burst_fn = jax.jit(
+            local_burst,
+            in_shardings=(state_sh, fabric.sharding(None, None, data_axis), rep, rep),
+            out_shardings=(state_sh, rep, rep),
+            donate_argnums=(0,),
+        )
     return TrainProgram(step_fn, burst_fn)
 
 
@@ -604,7 +648,22 @@ def main(fabric, cfg: Dict[str, Any]):
         agent_state = state["agent"]
         expl_decay_steps = int(np.asarray(state["expl_decay_steps"]))
         cfg.per_rank_batch_size = int(np.asarray(state["batch_size"])) // world_size
-    agent_state = jax.device_put(agent_state, fabric.replicated)
+    # Parameter sharding (parallel.model_axis>1): spec-assign the whole agent
+    # state — optax mu/nu mirror the param shapes, so one plan covers params
+    # and optimizer state — and place it model-sharded. A resumed checkpoint
+    # arrives here as full host arrays, so re-planning onto a *different*
+    # model_axis than it was saved under is the same code path (respec +
+    # reshard on load). model_axis=1 keeps the replicated placement untouched.
+    plan = fabric.shard_plan(agent_state)
+    if plan is None:
+        agent_state = jax.device_put(agent_state, fabric.replicated)
+    else:
+        agent_state = plan.place(agent_state)
+    set_shard_footprint(
+        measured_bytes_per_device(agent_state["params"]),
+        measured_bytes_per_device(agent_state["opt"]),
+        fabric.model_axis_size,
+    )
 
     train_fn = build_train_fn(
         world_model,
@@ -617,6 +676,7 @@ def main(fabric, cfg: Dict[str, Any]):
         fabric,
         actions_dim,
         is_continuous,
+        plan=plan,
     )
     # Two acting modes: host-mirrored (player_on_host=True on an accelerator
     # mesh — CPU snapshots refreshed per burst, utils/host.py) or packed
@@ -642,7 +702,13 @@ def main(fabric, cfg: Dict[str, Any]):
     if use_packed_player:
         from jax.flatten_util import ravel_pytree
 
-        pack_fn = jax.jit(lambda t: ravel_pytree(t)[0])
+        # under a sharding plan the packed vector is forced replicated (one
+        # all-gather) so the single-device player consumes it whole
+        pack_fn = (
+            jax.jit(lambda t: ravel_pytree(t)[0])
+            if plan is None
+            else jax.jit(lambda t: ravel_pytree(t)[0], out_shardings=fabric.replicated)
+        )
         play_packed = pack_fn(
             {"wm": agent_state["params"]["world_model"], "actor": agent_state["params"]["actor"]}
         )
@@ -1127,6 +1193,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     ckpt_path=ckpt_path,
                     state=ckpt_state,
                     replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
+                    sharding_meta=plan.describe() if plan is not None else None,
                 )
             if preemption_requested():
                 # SIGTERM/SIGINT: the final checkpoint is saved (the CLI

@@ -30,6 +30,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
 from sheeprl_tpu.algos.ppo_recurrent.agent import (
@@ -240,16 +241,37 @@ def main(fabric, cfg: Dict[str, Any]):
     # jitted programs
     # ------------------------------------------------------------------
 
-    @jax.jit
-    def policy_step_fn(params, obs, prev_actions, is_first, hc, key):
+    def _act_fn(params, carry, key):
+        # the key advances INSIDE the jitted burst (one dispatch per
+        # env.act_burst env steps); the policy body is the old per-step
+        # policy_step_fn verbatim. The LSTM state rides in the carry pytree:
+        # the host callback gets both the pre-step hidden state (recorded as
+        # the sequence-chunk initials) and the post-step one, applies the
+        # done mask exactly where the per-step loop did, and returns the
+        # masked state for the next in-scan act.
+        key, sub = jax.random.split(key)
+        obs = {k: carry[k] for k in obs_keys}
+        hc_in = (carry["hc_c"], carry["hc_h"])
         norm = normalize_obs(obs, cnn_keys, obs_keys)
         seq_obs = {k: v[None] for k, v in norm.items()}
-        pre_dist, values, hc = agent.apply(
-            {"params": params}, seq_obs, prev_actions[None], is_first[None], hc
+        pre_dist, values, hc_out = agent.apply(
+            {"params": params}, seq_obs, carry["prev_actions"][None], carry["is_first"][None], hc_in
         )
         pre_dist = [p[0] for p in pre_dist]
-        actions, real_actions, logprob = sample_actions(pre_dist, is_continuous, key)
-        return actions, real_actions, logprob, values[0], hc
+        actions, real_actions, logprob = sample_actions(pre_dist, is_continuous, sub)
+        cb_args = (
+            actions,
+            real_actions,
+            logprob,
+            values[0],
+            hc_in[0],
+            hc_in[1],
+            hc_out[0],
+            hc_out[1],
+            carry["prev_actions"],
+            carry["is_first"],
+        )
+        return cb_args, key
 
     @jax.jit
     def value_fn(params, obs, prev_actions, is_first, hc):
@@ -293,9 +315,114 @@ def main(fabric, cfg: Dict[str, Any]):
 
     obs = envs.reset(seed=cfg.seed)[0]
     next_obs = prepare_obs(obs, cnn_keys, n_envs)
-    prev_actions = np.zeros((n_envs, act_dim), np.float32)
-    is_first = np.ones((n_envs, 1), np.float32)
-    hc = jax.device_put(agent.initial_hc(n_envs))
+    hc0 = agent.initial_hc(n_envs)
+    carry = {
+        **next_obs,
+        "prev_actions": np.zeros((n_envs, act_dim), np.float32),
+        "is_first": np.ones((n_envs, 1), np.float32),
+        "hc_c": np.asarray(hc0[0], np.float32),
+        "hc_h": np.asarray(hc0[1], np.float32),
+    }
+    root_key, play_key = jax.random.split(root_key)
+
+    # Burst acting (envs/rollout, howto/rollout_engine.md): the acting loop
+    # body below is the old per-step block moved into a host callback; the
+    # BurstActor scans it env.act_burst times per device dispatch. The host
+    # keeps the recurrent bookkeeping it has always owned — hidden-state
+    # recording, done masking, prev_action/is_first resets — and threads
+    # everything back through the burst carry.
+    act_burst = max(int(cfg.env.get("act_burst", 1) or 1), 1)
+    state_box = {"carry": carry, "policy_step": policy_step, "t": 0, "cx": None, "hx": None}
+    #: (ring row, truncated env ids, prepared final obs, actions, unmasked
+    #: hc) per truncation — the V(s') bootstrap is patched into the stored
+    #: rewards after the burst returns (the jitted burst cannot re-enter the
+    #: device)
+    trunc_events = []
+
+    def _host_env_step(
+        actions, real_actions, logprob, values, hc_in_c, hc_in_h, hc_out_c, hc_out_h, prev_actions, is_first
+    ):
+        t = state_box["t"]
+        state_box["t"] = t + 1
+        state_box["policy_step"] += n_envs
+        state_box["cx"][t] = np.asarray(hc_in_c)
+        state_box["hx"][t] = np.asarray(hc_in_h)
+        with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
+            real_actions = np.asarray(real_actions)
+            obs, rewards, terminated, truncated, info = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+
+        actions = np.asarray(actions)
+        hc_out = (np.asarray(hc_out_c), np.asarray(hc_out_h))
+        truncated_envs = np.nonzero(truncated)[0]
+        if len(truncated_envs) > 0:
+            # bootstrap V(s') into the reward on truncation, deferred to the
+            # end of the burst (the pre-mask hidden state and this step's
+            # actions are what the per-step path fed value_fn inline)
+            final_obs = info["final_obs"]
+            t_obs = {
+                k: np.stack([np.asarray(final_obs[te][k]) for te in truncated_envs])
+                for k in obs_keys
+            }
+            t_obs = prepare_obs(t_obs, cnn_keys, len(truncated_envs))
+            t_hc = (hc_out[0][truncated_envs].copy(), hc_out[1][truncated_envs].copy())
+            t_actions = actions[truncated_envs].reshape(len(truncated_envs), -1).copy()
+            trunc_events.append((int(rb._pos), truncated_envs, t_obs, t_actions, t_hc))
+
+        dones = np.logical_or(terminated, truncated).astype(np.float32)
+        rewards = np.asarray(rewards, dtype=np.float32)
+
+        prev_actions = np.asarray(prev_actions)
+        is_first = np.asarray(is_first)
+        step_data = {
+            **{k: np.asarray(state_box["carry"][k])[None] for k in obs_keys},
+            "dones": dones.reshape(1, n_envs, 1),
+            "values": np.asarray(values).reshape(1, n_envs, 1),
+            "actions": actions.reshape(1, n_envs, -1),
+            "prev_actions": prev_actions[None].copy(),
+            "is_first": is_first[None].copy(),
+            "logprobs": np.asarray(logprob).reshape(1, n_envs, 1),
+            "rewards": rewards.reshape(1, n_envs, 1),
+        }
+        rb.add(step_data)
+
+        next_prev_actions = np.array(actions, np.float32).reshape(n_envs, -1)
+        if reset_on_done:
+            next_is_first = dones.reshape(n_envs, 1).copy()
+            next_prev_actions[dones.reshape(-1) > 0] = 0.0
+            if np.any(dones):
+                mask = (1.0 - dones.reshape(n_envs, 1)).astype(np.float32)
+                hc_out = (hc_out[0] * mask, hc_out[1] * mask)
+        else:
+            next_is_first = np.zeros((n_envs, 1), np.float32)
+
+        if cfg.metric.log_level > 0 and "final_info" in info:
+            fi = info["final_info"]
+            if isinstance(fi, dict) and "episode" in fi:
+                mask = np.asarray(fi.get("_episode", []), dtype=bool)
+                for i in np.nonzero(mask)[0]:
+                    ep_rew = float(fi["episode"]["r"][i])
+                    ep_len = float(fi["episode"]["l"][i])
+                    if aggregator and "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if aggregator and "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                    fabric.print(
+                        f"Rank-0: policy_step={state_box['policy_step']}, reward_env_{i}={ep_rew}"
+                    )
+
+        new_carry = {
+            **prepare_obs(obs, cnn_keys, n_envs),
+            "prev_actions": next_prev_actions,
+            "is_first": next_is_first,
+            "hc_c": hc_out[0],
+            "hc_h": hc_out[1],
+        }
+        state_box["carry"] = new_carry
+        return new_carry
+
+    burst_actor = BurstActor(_act_fn, _host_env_step, carry)
 
     for update in range(start_step, num_updates + 1):
         if cfg.algo.anneal_lr:
@@ -310,95 +437,43 @@ def main(fabric, cfg: Dict[str, Any]):
         else:
             lr = cfg.algo.optimizer.lr
 
-        hx_steps = np.empty((rollout_steps, n_envs, agent.rnn_hidden_size), np.float32)
-        cx_steps = np.empty((rollout_steps, n_envs, agent.rnn_hidden_size), np.float32)
+        state_box["hx"] = np.empty((rollout_steps, n_envs, agent.rnn_hidden_size), np.float32)
+        state_box["cx"] = np.empty((rollout_steps, n_envs, agent.rnn_hidden_size), np.float32)
+        state_box["t"] = 0
 
-        for t in range(rollout_steps):
-            policy_step += n_envs
+        remaining = rollout_steps
+        while remaining > 0:
+            n_act = min(act_burst, remaining)
+            with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+                _, play_key = burst_actor.rollout(
+                    params, state_box["carry"], play_key, n_act
+                )
+            remaining -= n_act
+        policy_step = state_box["policy_step"]
+        hx_steps, cx_steps = state_box["hx"], state_box["cx"]
 
-            with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
-                cx_steps[t] = np.asarray(hc[0])
-                hx_steps[t] = np.asarray(hc[1])
-                root_key, step_key = jax.random.split(root_key)
-                actions_j, real_actions_j, logprob_j, values_j, hc = policy_step_fn(
+        # patch the deferred V(s') truncation bootstraps into the stored
+        # rewards (params were frozen for the whole rollout, so the values
+        # match what the per-step path computed inline)
+        for row, tr_envs, t_obs, t_actions, t_hc in trunc_events:
+            vals = np.asarray(
+                value_fn(
                     params,
-                    next_obs,
-                    jnp.asarray(prev_actions),
-                    jnp.asarray(is_first),
-                    hc,
-                    step_key,
+                    t_obs,
+                    jnp.asarray(t_actions),
+                    jnp.zeros((len(tr_envs), 1), jnp.float32),
+                    (jnp.asarray(t_hc[0]), jnp.asarray(t_hc[1])),
                 )
-                real_actions = np.asarray(real_actions_j)
-                obs, rewards, terminated, truncated, info = envs.step(
-                    real_actions.reshape(envs.action_space.shape)
-                )
+            ).reshape(-1)
+            rewards_buf = rb["rewards"]
+            rewards_buf[row, tr_envs, 0] = rewards_buf[row, tr_envs, 0] + vals
+        trunc_events.clear()
 
-                truncated_envs = np.nonzero(truncated)[0]
-                if len(truncated_envs) > 0:
-                    # bootstrap V(s') into the reward on truncation
-                    final_obs = info["final_obs"]
-                    t_obs = {
-                        k: np.stack([np.asarray(final_obs[te][k]) for te in truncated_envs])
-                        for k in obs_keys
-                    }
-                    t_obs = prepare_obs(t_obs, cnn_keys, len(truncated_envs))
-                    t_hc = (
-                        jnp.asarray(np.asarray(hc[0])[truncated_envs]),
-                        jnp.asarray(np.asarray(hc[1])[truncated_envs]),
-                    )
-                    t_actions = jnp.asarray(np.asarray(actions_j)[truncated_envs])
-                    vals = np.asarray(
-                        value_fn(
-                            params,
-                            t_obs,
-                            t_actions,
-                            jnp.zeros((len(truncated_envs), 1), jnp.float32),
-                            t_hc,
-                        )
-                    ).reshape(-1)
-                    rewards = np.asarray(rewards, dtype=np.float32)
-                    rewards[truncated_envs] += vals
-
-                dones = np.logical_or(terminated, truncated).astype(np.float32)
-                rewards = np.asarray(rewards, dtype=np.float32)
-
-            step_data = {
-                **{k: np.asarray(next_obs[k])[None] for k in obs_keys},
-                "dones": dones.reshape(1, n_envs, 1),
-                "values": np.asarray(values_j).reshape(1, n_envs, 1),
-                "actions": np.asarray(actions_j).reshape(1, n_envs, -1),
-                "prev_actions": prev_actions[None].copy(),
-                "is_first": is_first[None].copy(),
-                "logprobs": np.asarray(logprob_j).reshape(1, n_envs, 1),
-                "rewards": rewards.reshape(1, n_envs, 1),
-            }
-            rb.add(step_data)
-
-            next_obs = prepare_obs(obs, cnn_keys, n_envs)
-            prev_actions = np.array(actions_j, np.float32).reshape(n_envs, -1)
-            if reset_on_done:
-                is_first = dones.reshape(n_envs, 1).copy()
-                prev_actions[dones.reshape(-1) > 0] = 0.0
-                if np.any(dones):
-                    mask = jnp.asarray(1.0 - dones.reshape(n_envs, 1))
-                    hc = (hc[0] * mask, hc[1] * mask)
-            else:
-                is_first = np.zeros((n_envs, 1), np.float32)
-
-            if cfg.metric.log_level > 0 and "final_info" in info:
-                fi = info["final_info"]
-                if isinstance(fi, dict) and "episode" in fi:
-                    mask = np.asarray(fi.get("_episode", []), dtype=bool)
-                    for i in np.nonzero(mask)[0]:
-                        ep_rew = float(fi["episode"]["r"][i])
-                        ep_len = float(fi["episode"]["l"][i])
-                        if aggregator and "Rewards/rew_avg" in aggregator:
-                            aggregator.update("Rewards/rew_avg", ep_rew)
-                        if aggregator and "Game/ep_len_avg" in aggregator:
-                            aggregator.update("Game/ep_len_avg", ep_len)
-                        fabric.print(
-                            f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}"
-                        )
+        carry = state_box["carry"]
+        next_obs = {k: carry[k] for k in obs_keys}
+        prev_actions = carry["prev_actions"]
+        is_first = carry["is_first"]
+        hc = (jnp.asarray(carry["hc_c"]), jnp.asarray(carry["hc_h"]))
 
         # GAE over the rollout
         next_values = value_fn(

@@ -70,10 +70,12 @@ from sheeprl_tpu.obs import (
     log_sps_metrics,
     profile_tick,
     register_train_cost,
+    set_shard_footprint,
     shape_specs,
     span,
 )
 from sheeprl_tpu.obs.dist import pmean
+from sheeprl_tpu.parallel.shard import measured_bytes_per_device
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -90,14 +92,29 @@ def build_train_fn(
     action_bias: np.ndarray,
     target_entropy: float,
     donate: bool = True,
+    state_plan=None,
+    opt_plan=None,
 ):
     """Compile G gradient steps (critic → EMA → actor → alpha) as one SPMD
     program. ``batch`` leaves are ``[G, B_local, ...]``; ``do_ema`` is a
-    dynamic bool so the EMA cadence never recompiles."""
+    dynamic bool so the EMA cadence never recompiles.
+
+    ``state_plan``/``opt_plan`` (from ``fabric.shard_plan`` over the agent
+    state and optimizer-state trees) switch the program onto the
+    ``{'data','model'}`` mesh as ONE GSPMD program: no manual shard_map
+    region (``axis=None`` makes the per-shard gradient pmean an identity —
+    the loss spans the global batch, so its gradient is already the
+    all-reduced one), params/opt state enter via ``in_shardings``/
+    ``out_shardings`` with the plans' model-axis specs, and XLA inserts all
+    collectives. The jax-0.4-era partitioner CHECK-fails on ``lax.scan``
+    inside a partially-manual (``auto=``) shard_map, so the sharded path
+    avoids shard_map entirely. ``None`` is the byte-identical manual
+    data-parallel program."""
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     n_critics = int(cfg.algo.critic.n)
-    axis = fabric.data_axis
+    data_axis = fabric.data_axis
+    axis = data_axis if state_plan is None else None
     scale = jnp.asarray(action_scale)
     bias = jnp.asarray(action_bias)
     tgt_entropy = jnp.float32(target_entropy)
@@ -176,16 +193,31 @@ def build_train_fn(
         metrics = pmean(jnp.mean(metrics, axis=0), axis)
         return state, opt_states, metrics
 
-    shmapped = shard_map(
-        local_train,
-        mesh=fabric.mesh,
-        in_specs=(P(), P(), P(None, axis), P(), P()),
-        out_specs=(P(), P(), P()),
-        check_vma=False,
-    )
     # decoupled mode keeps the old actor params alive for the player
     # thread, so donation must be off there
-    return jax.jit(shmapped, donate_argnums=(0, 1) if donate else ())
+    donate_argnums = (0, 1) if donate else ()
+    if state_plan is None:
+        shmapped = shard_map(
+            local_train,
+            mesh=fabric.mesh,
+            in_specs=(P(), P(), P(None, data_axis), P(), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(shmapped, donate_argnums=donate_argnums)
+    rep = fabric.replicated
+    return jax.jit(
+        local_train,
+        in_shardings=(
+            state_plan.shardings(),
+            opt_plan.shardings(),
+            fabric.sharding(None, data_axis),
+            rep,
+            rep,
+        ),
+        out_shardings=(state_plan.shardings(), opt_plan.shardings(), rep),
+        donate_argnums=donate_argnums,
+    )
 
 
 @register_algorithm()
@@ -280,8 +312,24 @@ def main(fabric, cfg: Dict[str, Any]):
         agent_state = state["agent"]
         opt_states = state["opt_states"]
         cfg.per_rank_batch_size = int(np.asarray(state["batch_size"])) // world_size
-    agent_state = jax.device_put(agent_state, fabric.replicated)
-    opt_states = jax.device_put(opt_states, fabric.replicated)
+    # Parameter sharding (parallel.model_axis>1): spec-assign params and
+    # optimizer state over the 'model' axis and place them sharded. Resumed
+    # checkpoints arrive as full host arrays, so restoring onto a different
+    # model_axis than they were saved under is the same respec-and-reshard
+    # path. model_axis=1 keeps the replicated placement untouched.
+    state_plan = fabric.shard_plan(agent_state)
+    opt_plan = fabric.shard_plan(opt_states)
+    if state_plan is None:
+        agent_state = jax.device_put(agent_state, fabric.replicated)
+        opt_states = jax.device_put(opt_states, fabric.replicated)
+    else:
+        agent_state = state_plan.place(agent_state)
+        opt_states = opt_plan.place(opt_states)
+    set_shard_footprint(
+        measured_bytes_per_device(agent_state),
+        measured_bytes_per_device(opt_states),
+        fabric.model_axis_size,
+    )
 
     aggregator = None
     if not MetricAggregator.disabled:
@@ -306,7 +354,8 @@ def main(fabric, cfg: Dict[str, Any]):
     play_actor = actor_mirror(agent_state["actor"])
 
     train_fn = build_train_fn(
-        actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric, action_scale, action_bias, target_entropy
+        actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric, action_scale, action_bias, target_entropy,
+        state_plan=state_plan, opt_plan=opt_plan,
     )
     batch_sharding = fabric.sharding(None, fabric.data_axis)
     if backend == "jax":
@@ -574,6 +623,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     ckpt_path=ckpt_path,
                     state=ckpt_state,
                     replay_buffer=rb if cfg.buffer.get("checkpoint", False) else None,
+                    sharding_meta=state_plan.describe() if state_plan is not None else None,
                 )
             if preemption_requested():
                 # SIGTERM/SIGINT: the final checkpoint is saved (the CLI

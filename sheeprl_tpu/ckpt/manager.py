@@ -90,13 +90,16 @@ class CheckpointManager:
         fabric: Any = None,
         keep_last: Optional[int] = None,
         sync: Optional[bool] = None,
+        sharding_meta: Optional[Dict[str, Any]] = None,
     ) -> None:
         """Snapshot ``state``/``rb_state`` and persist them as ``ckpt_path``.
 
         ``keep_last`` overrides the manager policy (callback-level knob);
         ``sync`` forces a synchronous write (final/preemption saves drain
         anyway, so they can stay async — this is for callers that must see
-        write errors inline).
+        write errors inline). ``sharding_meta`` (a ``ShardingPlan.describe``
+        dict) is recorded in the manifest; the state itself is gathered to
+        full host arrays below, so restores re-spec freely.
         """
         import jax
 
@@ -105,6 +108,21 @@ class CheckpointManager:
         t0 = time.perf_counter()
         rank = int(fabric.global_rank) if fabric is not None else 0
         world_size = int(fabric.world_size) if fabric is not None else 1
+        if state is not None:
+            # Model-sharded leaves on a multi-host mesh are not fully
+            # addressable: device_get alone cannot materialize them, so they
+            # are gathered across processes first (every rank participates —
+            # this runs outside the rank-0 guard below on purpose). On a
+            # single-process mesh every array is addressable and this is a
+            # no-op.
+            def _gather(x):
+                if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+                    from jax.experimental import multihost_utils
+
+                    return np.asarray(multihost_utils.process_allgather(x))
+                return x
+
+            state = jax.tree_util.tree_map(_gather, state)
         # The step-path snapshot. device_get alone is NOT a snapshot: on the
         # CPU backend it returns zero-copy views of the XLA buffers
         # (owndata=False), and a donated train step — or the entrypoint
@@ -139,6 +157,7 @@ class CheckpointManager:
                 world_size=world_size,
                 algo=self.algo,
                 config_hash=self.config_hash,
+                sharding=sharding_meta,
             )
             self._prune(os.path.dirname(ckpt_path), rank, keep)
             return nbytes

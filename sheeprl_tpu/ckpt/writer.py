@@ -144,11 +144,19 @@ def write_checkpoint(
     algo: Optional[str] = None,
     config_hash: Optional[str] = None,
     fsync: bool = True,
+    sharding: Optional[Dict[str, Any]] = None,
 ) -> int:
     """Write one checkpoint directory atomically; returns bytes written.
 
     ``state=None`` (non-zero ranks of a replicated model) writes buffer
     shards + manifest only — resume reads the model from the rank-0 sibling.
+
+    ``sharding`` records the :meth:`ShardingPlan.describe` layout the state
+    was trained under (mesh axes + per-leaf specs). The state arrays
+    themselves are always written *gathered* (full shapes), so restore needs
+    no shard reassembly and is free to re-spec onto a different
+    ``model_axis`` — the manifest section pins down provenance and lets
+    tooling verify what layout produced the numbers.
     """
     final_dir = os.path.abspath(final_dir)
     tmp_dir = final_dir + TMP_SUFFIX
@@ -166,6 +174,7 @@ def write_checkpoint(
         "config_hash": config_hash,
         "state": None,
         "rb": None,
+        "sharding": sharding,
     }
 
     if state is not None:

@@ -337,7 +337,15 @@ def run_algorithm(cfg) -> None:
     if "finetuning" in cfg.algo.name and "p2e" in entry["module"]:
         kwargs["exploration_cfg"] = _load_exploration_cfg(cfg)
 
-    fabric = instantiate(cfg.fabric)
+    # parallel group → Fabric sharding knobs (the {'data','model'} mesh);
+    # absent/empty group keeps the pure data-parallel defaults.
+    parallel_cfg = cfg.get("parallel", None) or {}
+    fabric = instantiate(
+        cfg.fabric,
+        model_axis=parallel_cfg.get("model_axis", 1) or 1,
+        shard_min_bytes=parallel_cfg.get("shard_min_bytes", None),
+        shard_overrides=parallel_cfg.get("shard_overrides", None),
+    )
 
     # Observability gates (reference cli.py:141-155)
     _prune_metric_keys(cfg, entry["module"])

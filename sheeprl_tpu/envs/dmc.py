@@ -18,14 +18,31 @@ if not _IS_DMC_AVAILABLE:
         "dm_control is required for the DMC environments: pip install dm_control"
     )
 
+import ctypes.util
 import os
 from typing import Any, Dict, Optional, Tuple
 
+
+def _pick_gl_backend() -> str:
+    """Offscreen GL backend for headless hosts, probed before import.
+
+    MuJoCo hard-crashes deep inside PyOpenGL when ``MUJOCO_GL`` names a
+    backend whose shared library is missing (``'NoneType' object has no
+    attribute 'eglQueryString'`` on EGL-less containers), so the choice must
+    be made from what the loader can actually find: EGL first (TPU VM
+    images), then OSMesa (software rasterizer), else rendering is switched
+    ``off`` — physics and vector observations still work; only
+    ``from_pixels`` needs a renderer (guarded in :class:`DMCWrapper`)."""
+    for backend, lib in (("egl", "EGL"), ("osmesa", "OSMesa")):
+        if ctypes.util.find_library(lib):
+            return backend
+    return "off"
+
+
 # Headless hosts (no DISPLAY — every TPU VM) need an offscreen GL backend
-# for pixel observations; EGL works in this image. Respect an explicit
-# user choice.
+# for pixel observations. Respect an explicit user choice.
 if "DISPLAY" not in os.environ:
-    os.environ.setdefault("MUJOCO_GL", "egl")
+    os.environ.setdefault("MUJOCO_GL", _pick_gl_backend())
 
 import gymnasium as gym
 import numpy as np
@@ -80,6 +97,14 @@ class DMCWrapper(gym.Env):
             raise ValueError(
                 "'from_vectors' and 'from_pixels' must not be both False: "
                 f"got {from_vectors} and {from_pixels} respectively."
+            )
+        if from_pixels and os.environ.get("MUJOCO_GL", "") == "off":
+            raise RuntimeError(
+                "Pixel observations need an offscreen GL backend, but no EGL "
+                "or OSMesa library was found on this host (MUJOCO_GL=off). "
+                "Install libEGL/libOSMesa or set MUJOCO_GL explicitly; vector "
+                "observations (from_vectors=True, from_pixels=False) work "
+                "without a renderer."
             )
         domain_name, task_name = id.split("_", 1)
         self._from_pixels = from_pixels

@@ -35,6 +35,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.parallel import mesh as _mesh
+from sheeprl_tpu.parallel import shard as _shard
+
 
 def _select_devices(devices: Any, accelerator: str) -> List[jax.Device]:
     """Resolve the device list from the fabric config.
@@ -138,6 +141,9 @@ class Fabric:
         callbacks: Optional[Sequence[Any]] = None,
         data_axis: str = "data",
         prng_impl: Optional[str] = "rbg",
+        model_axis: int = 1,
+        shard_min_bytes: Optional[int] = None,
+        shard_overrides: Optional[Dict[str, Any]] = None,
     ):
         if prng_impl:
             # rbg (default): XLA-native random bits, markedly cheaper than
@@ -177,7 +183,26 @@ class Fabric:
         self.num_nodes = num_nodes
         self._devices = _select_devices(devices, self.accelerator)
         self.data_axis = data_axis
-        self.mesh = Mesh(np.asarray(self._devices), (data_axis,))
+        self.model_axis = int(model_axis) if model_axis is not None else 1
+        if self.model_axis < 1:
+            raise ValueError(f"parallel.model_axis must be >= 1, got {model_axis}")
+        self.shard_min_bytes = (
+            int(shard_min_bytes)
+            if shard_min_bytes is not None
+            else _shard.DEFAULT_MIN_SHARD_BYTES
+        )
+        self.shard_overrides = dict(shard_overrides) if shard_overrides else None
+        if self.model_axis > 1:
+            # {'data': -1, 'model': N} — the GSPMD parameter-sharding mesh.
+            # make_mesh raises when N does not divide the device count.
+            self.mesh = _mesh.make_mesh(
+                {data_axis: -1, _mesh.MODEL_AXIS: self.model_axis}, self._devices
+            )
+        else:
+            # model_axis=1 keeps the 1-D mesh byte-identical to the pure
+            # data-parallel runtime: same jaxpr, same reduction order, so
+            # sharded-vs-replicated bitwise parity holds by construction.
+            self.mesh = Mesh(np.asarray(self._devices), (data_axis,))
         self._launched = False
 
     # ------------------------------------------------------------------
@@ -257,6 +282,45 @@ class Fabric:
     def to_device(self, tree: Any) -> Any:
         """Host→HBM replicated placement."""
         return jax.device_put(tree, self.replicated)
+
+    # ------------------------------------------------------------------
+    # parameter sharding (the {'data','model'} mesh)
+    # ------------------------------------------------------------------
+
+    @property
+    def model_axis_size(self) -> int:
+        """Size of the ``'model'`` parameter-sharding axis (1 = replicated)."""
+        return self.model_axis
+
+    @property
+    def data_parallel_size(self) -> int:
+        """Size of the data axis — the gradient-pmean world. Equals
+        ``world_size`` unless ``model_axis`` carves devices out of it."""
+        return int(self.mesh.shape[self.data_axis])
+
+    @property
+    def auto_axes(self):
+        """Mesh axes left to the GSPMD partitioner inside ``shard_map``
+        bodies (empty ⇒ the fully-manual 1-D data-parallel path)."""
+        if self.model_axis > 1:
+            return frozenset({_mesh.MODEL_AXIS})
+        return frozenset()
+
+    def shard_plan(self, tree: Any) -> Optional["_shard.ShardingPlan"]:
+        """Spec-assign ``tree``'s leaves over the ``'model'`` axis.
+
+        Returns ``None`` when ``model_axis`` is 1 so call sites can branch
+        ``plan is None`` onto the byte-identical replicated path. Honors the
+        ``parallel.shard_min_bytes`` / ``parallel.shard_overrides`` knobs.
+        """
+        if self.model_axis <= 1:
+            return None
+        return _shard.make_plan(
+            tree,
+            self.mesh,
+            min_shard_bytes=self.shard_min_bytes,
+            overrides=self.shard_overrides,
+        )
 
     # ------------------------------------------------------------------
     # launch & module setup (reference-API parity shims)

@@ -47,6 +47,7 @@ from sheeprl_tpu.obs.counters import (
     count_h2d,
     device_memory_stats,
     note_plane_policy_version,
+    set_shard_footprint,
     staged_device_put,
     tree_nbytes,
 )
@@ -116,6 +117,7 @@ __all__ = [
     "log_sps_metrics",
     "mfu_pct",
     "note_plane_policy_version",
+    "set_shard_footprint",
     "pmean",
     "profile_tick",
     "profiler_capture",

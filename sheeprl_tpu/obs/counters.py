@@ -127,6 +127,14 @@ class Counters:
         self.comms_bytes = 0
         self.comms_ms = 0.0
         self.comms_by_kind: Dict[str, Dict[str, Any]] = {}
+        # parameter sharding (parallel/shard.py): per-device HBM footprint of
+        # the model params and optimizer state under the active ShardingPlan
+        # (gauges — set at placement, not summed; model_axis=1 runs record
+        # the full replicated footprint), plus the model-axis size itself so
+        # telemetry.json pins down what layout produced the numbers
+        self.params_bytes_per_device = 0
+        self.opt_state_bytes_per_device = 0
+        self.model_axis_size = 1
 
     def add(self, field: str, amount) -> None:
         with self._lock:
@@ -179,6 +187,9 @@ class Counters:
                 "plane_traj_slabs": self.plane_traj_slabs,
                 "plane_policy_version": self.plane_policy_version,
                 "plane_player_restarts": self.plane_player_restarts,
+                "params_bytes_per_device": self.params_bytes_per_device,
+                "opt_state_bytes_per_device": self.opt_state_bytes_per_device,
+                "model_axis_size": self.model_axis_size,
                 "comms_ops": self.comms_ops,
                 "comms_bytes": self.comms_bytes,
                 "comms_ms": round(self.comms_ms, 3),
@@ -336,6 +347,25 @@ def add_act_dispatches(n: int = 1) -> None:
     if c is not None:
         with c._lock:
             c.act_dispatches += int(n)
+
+
+# -- parameter-sharding accounting -------------------------------------------
+
+
+def set_shard_footprint(
+    params_bytes_per_device: int,
+    opt_state_bytes_per_device: int,
+    model_axis_size: int = 1,
+) -> None:
+    """Record the per-device HBM footprint of params/optimizer state under
+    the active sharding layout (gauges, set once at placement — a replicated
+    run records the full tree size with ``model_axis_size=1``)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.params_bytes_per_device = int(params_bytes_per_device)
+            c.opt_state_bytes_per_device = int(opt_state_bytes_per_device)
+            c.model_axis_size = int(model_axis_size)
 
 
 # -- actor–learner plane accounting ------------------------------------------

@@ -121,19 +121,28 @@ def link_peak_gbps() -> Optional[float]:
 # backward pass) is one edit, not seventeen.
 
 
-def pmean(x: Any, axis_name: str) -> Any:
+def pmean(x: Any, axis_name: Optional[str]) -> Any:
     """Mean-all-reduce over a mesh axis inside a jitted program (the
     gradient sync every train step runs). Device time is attributed by the
-    profiled-capture comms split, not a host span."""
+    profiled-capture comms split, not a host span.
+
+    ``axis_name=None`` is the identity: sharded-parameter train steps run as
+    one *global* GSPMD program (no manual axis — the batch mean already spans
+    the whole mesh and XLA inserts the gradient reduce-scatter itself)."""
     import jax
 
+    if axis_name is None:
+        return x
     return jax.lax.pmean(x, axis_name)
 
 
-def psum(x: Any, axis_name: str) -> Any:
-    """Sum-all-reduce over a mesh axis inside a jitted program."""
+def psum(x: Any, axis_name: Optional[str]) -> Any:
+    """Sum-all-reduce over a mesh axis inside a jitted program.
+    ``axis_name=None`` is the identity (see :func:`pmean`)."""
     import jax
 
+    if axis_name is None:
+        return x
     return jax.lax.psum(x, axis_name)
 
 

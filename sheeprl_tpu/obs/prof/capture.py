@@ -100,16 +100,27 @@ def analyze_trace(
     # program, so the per-exec share divides by the train module's execs.
     device_ms = round(ms_per_exec * dps / ws, 3) if ms_per_exec is not None else None
     comms_total = summary.get("comms_ms_total")
-    comms_ms = compute_ms = None
+    comms_ms = compute_ms = comms_by_kind = None
     if device_ms is not None and comms_total is not None and rec and rec["execs"]:
         comms_ms = round(comms_total / rec["execs"] * dps / ws, 4)
         compute_ms = round(max(device_ms - comms_ms, 0.0), 4)
+        # same per-step attribution, split by collective category: under
+        # parameter sharding the gradient all-reduce and the parameter
+        # all-gather/reduce-scatter scale with different byte volumes, so
+        # the binding-constraint story needs them reported separately
+        by_kind = summary.get("comms_ms_by_kind")
+        if by_kind:
+            comms_by_kind = {
+                kind: round(v / rec["execs"] * dps / ws, 4)
+                for kind, v in by_kind.items()
+            }
     return {
         "trace_dir": trace_dir,
         "source": summary["source"],
         "train_module": train,
         "device_ms_per_step": device_ms,
         "comms_ms_per_step": comms_ms,
+        "comms_ms_by_kind_per_step": comms_by_kind,
         "compute_ms_per_step": compute_ms,
         "mfu_device_pct": roofline["mfu_pct"],
         "achieved_gbps": roofline["achieved_gbps"],

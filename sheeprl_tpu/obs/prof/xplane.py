@@ -281,11 +281,26 @@ def _top_ops(plane: Dict[str, Any], limit: int = 30) -> Dict[str, float]:
 #: HLO op-name categories that are collective communication — the device
 #: time XLA spends moving gradients/activations between chips rather than
 #: computing (sync-variant names like `all-reduce-start`/`-done` and fused
-#: spellings like `all-reduce.1` / `fusion.all-reduce` all match)
-_COLLECTIVE_OP = re.compile(
-    r"all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all",
-    re.I,
+#: spellings like `all-reduce.1` / `fusion.all-reduce` all match). Order
+#: matters for classification: `reduce-scatter` must win over a bare
+#: `all-reduce` substring match, so kinds are probed in listed order.
+_COLLECTIVE_KINDS = (
+    "reduce-scatter",
+    "all-reduce",
+    "all-gather",
+    "collective-permute",
+    "all-to-all",
 )
+_COLLECTIVE_OP = re.compile("|".join(_COLLECTIVE_KINDS), re.I)
+
+
+def _collective_kind(name: str) -> str | None:
+    """Collective category of an HLO op name, or None for compute ops."""
+    low = name.lower()
+    for kind in _COLLECTIVE_KINDS:
+        if kind in low:
+            return kind
+    return None
 
 
 def _collective_ms(self_times: "collections.Counter") -> float:
@@ -294,6 +309,18 @@ def _collective_ms(self_times: "collections.Counter") -> float:
     return sum(
         ps for name, ps in self_times.items() if _COLLECTIVE_OP.search(name)
     ) / 1e9
+
+
+def _collective_ms_by_kind(self_times: "collections.Counter") -> Dict[str, float]:
+    """Collective self-time (ms) split by category. Gradient all-reduce vs
+    parameter all-gather vs reduce-scatter bind differently under parameter
+    sharding (howto/sharding.md), so the roofline report keeps them apart."""
+    by_kind: Dict[str, float] = {}
+    for name, ps in self_times.items():
+        kind = _collective_kind(name)
+        if kind is not None:
+            by_kind[kind] = by_kind.get(kind, 0.0) + ps / 1e9
+    return by_kind
 
 
 def summarize_space(planes: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -342,6 +369,11 @@ def summarize_space(planes: List[Dict[str, Any]]) -> Dict[str, Any]:
         # collective-op device time: present (possibly 0.0) whenever the
         # trace carries an op line, None when ops were not recorded at all
         out["comms_ms_total"] = round(_collective_ms(self_times), 4) if self_times else None
+        out["comms_ms_by_kind"] = (
+            {k: round(v, 4) for k, v in sorted(_collective_ms_by_kind(self_times).items())}
+            if self_times
+            else None
+        )
         return out
 
     # CPU fallback: PjitFunction(...) dispatch spans on the host plane
@@ -364,6 +396,7 @@ def summarize_space(planes: List[Dict[str, Any]]) -> Dict[str, Any]:
     out["steps_ms_total"] = None
     out["top_ops"] = {}
     out["comms_ms_total"] = None  # host dispatch spans carry no op split
+    out["comms_ms_by_kind"] = None
     return out
 
 

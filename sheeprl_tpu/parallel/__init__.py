@@ -22,13 +22,23 @@ from sheeprl_tpu.parallel.ring import (
     ring_self_attention,
     ulysses_attention,
 )
+from sheeprl_tpu.parallel.shard import (
+    DEFAULT_MIN_SHARD_BYTES,
+    ShardingPlan,
+    assign_spec,
+    make_plan,
+)
 
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "SEQ_AXIS",
+    "DEFAULT_MIN_SHARD_BYTES",
+    "ShardingPlan",
+    "assign_spec",
     "axis_size",
     "make_mesh",
+    "make_plan",
     "pad_to_multiple",
     "shard_batch_and_sequence",
     "sharding",

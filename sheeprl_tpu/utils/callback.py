@@ -72,13 +72,19 @@ class CheckpointCallback:
         ckpt_path: str,
         state: Dict[str, Any],
         replay_buffer=None,
+        sharding_meta: Optional[Dict[str, Any]] = None,
         **_: Any,
     ) -> None:
         from sheeprl_tpu.ckpt import get_checkpoint_manager
 
         rb_state = self._buffer_state(replay_buffer) if replay_buffer is not None else None
         get_checkpoint_manager().save(
-            ckpt_path, state, rb_state=rb_state, fabric=fabric, keep_last=self.keep_last
+            ckpt_path,
+            state,
+            rb_state=rb_state,
+            fabric=fabric,
+            keep_last=self.keep_last,
+            sharding_meta=sharding_meta,
         )
 
     def on_checkpoint_player(self, fabric, ckpt_path: str, state: Dict[str, Any], replay_buffer=None, **_: Any):

@@ -11,11 +11,18 @@ between releases and break older pinned containers:
 
 Every call site imports from here instead of feature-testing jax inline, so
 the framework runs unmodified on both sides of the rename.
+
+``auto`` marks mesh axes the body does NOT reduce over manually: those axes
+stay under GSPMD control, so an enclosing ``jit(..., in_shardings=...)`` can
+partition the body's tensor work over them (the pjit/PartitionSpec pattern)
+while the remaining axes keep their hand-written per-shard collectives. This
+is how the ``'model'`` parameter axis composes with the manual ``'data'``
+gradient pmean without rewriting the train steps.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, FrozenSet
 
 import jax
 
@@ -24,18 +31,36 @@ __all__ = ["shard_map", "axis_size"]
 
 if hasattr(jax, "shard_map"):
 
-    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    def shard_map(
+        f,
+        *,
+        mesh,
+        in_specs,
+        out_specs,
+        check_vma: bool = True,
+        auto: FrozenSet[str] = frozenset(),
+    ):
+        kwargs = {"auto": auto} if auto else {}
         return jax.shard_map(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma, **kwargs
         )
 
 else:  # pre-graduation jax: experimental module, check_rep kwarg
 
-    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    def shard_map(
+        f,
+        *,
+        mesh,
+        in_specs,
+        out_specs,
+        check_vma: bool = True,
+        auto: FrozenSet[str] = frozenset(),
+    ):
         from jax.experimental.shard_map import shard_map as _shard_map
 
+        kwargs = {"auto": auto} if auto else {}
         return _shard_map(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma, **kwargs
         )
 
 

@@ -376,3 +376,83 @@ def test_sac_jax_backend_e2e_counters(tmp_path, monkeypatch):
     # every env step of the run (24 policy steps / 2 envs = 12 updates) ran
     # inside jit
     assert summary["env_steps_jax"] == 24
+
+
+def _onpolicy_burst_args(tmp_path, exp, run_name, extra):
+    return [
+        f"exp={exp}",
+        "dry_run=False",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+        "env=gym",
+        "env.id=CartPole-v1",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "env.num_envs=2",
+        "buffer.memmap=False",
+        "buffer.checkpoint=True",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "metric.log_level=0",
+        "algo.run_test=False",
+        "mlp_keys.encoder=[state]",
+        f"root_dir={tmp_path}/logs",
+        f"run_name={run_name}",
+        *extra,
+    ]
+
+
+def _assert_ckpt_bitwise(tmp_path, run_a, run_b, written):
+    """Final checkpoint of two runs must be bitwise identical: trained
+    params/opt state (state.npz) AND the collected replay rows."""
+    a = _load_ckpt_arrays(tmp_path, run_a, "*.npz")
+    b = _load_ckpt_arrays(tmp_path, run_b, "*.npz")
+    assert a and a.keys() == b.keys()
+    for k in a:
+        if a[k].ndim == 0 or a[k].shape[0] < written:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=str(k))
+        else:
+            # rows past the write head are np.empty garbage
+            np.testing.assert_array_equal(a[k][:written], b[k][:written], err_msg=str(k))
+
+
+def test_a2c_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """A2C entrypoint equivalence with training ON: the act_burst=4 run's
+    final checkpoint (params, opt state, replay rows) is bitwise the
+    per-step run's — acting params are frozen per rollout, so burst
+    partitioning must not change a single collected bit, and identical data
+    implies identical updates."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    common = [
+        "total_steps=16",
+        "algo.rollout_steps=4",
+        "per_rank_batch_size=4",
+        "buffer.size=4",
+    ]
+    cli.run(_onpolicy_burst_args(tmp_path, "a2c", "k1", common))
+    cli.run(_onpolicy_burst_args(tmp_path, "a2c", "k4", common + ["env.act_burst=4"]))
+    _assert_ckpt_bitwise(tmp_path, "k1", "k4", written=4)
+
+
+def test_ppo_recurrent_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """Recurrent PPO equivalence: the LSTM carry threads through the burst
+    (hidden-state recording, done masking, prev_action resets all host-side)
+    and act_burst=4 still reproduces the per-step run bitwise end-to-end."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    common = [
+        "total_steps=32",
+        "algo.rollout_steps=8",
+        "per_rank_sequence_length=4",
+        "per_rank_num_batches=2",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.rnn.lstm.hidden_size=8",
+        "buffer.size=8",
+    ]
+    cli.run(_onpolicy_burst_args(tmp_path, "ppo_recurrent", "rk1", common))
+    cli.run(_onpolicy_burst_args(tmp_path, "ppo_recurrent", "rk4", common + ["env.act_burst=4"]))
+    _assert_ckpt_bitwise(tmp_path, "rk1", "rk4", written=8)

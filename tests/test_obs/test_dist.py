@@ -127,6 +127,18 @@ def test_xplane_collective_attribution_splits_comms():
     assert out["modules"]["shmapped"]["execs"] == 2
     # 2 all-reduce ops x 1.5ms self-time = 3ms of collective device time
     assert out["comms_ms_total"] == pytest.approx(3.0, abs=1e-6)
+    assert out["comms_ms_by_kind"] == {"all-reduce": pytest.approx(3.0, abs=1e-6)}
+
+
+def test_xplane_collective_by_kind_reduce_scatter_not_all_reduce():
+    from sheeprl_tpu.obs.prof.xplane import _collective_kind
+
+    # 'reduce-scatter.4' contains no 'all-reduce' substring but the kind
+    # probe order still matters for names XLA fuses both ways
+    assert _collective_kind("reduce-scatter.4") == "reduce-scatter"
+    assert _collective_kind("fusion.all-gather.1") == "all-gather"
+    assert _collective_kind("all-reduce-start") == "all-reduce"
+    assert _collective_kind("fusion.7") is None
 
 
 def test_xplane_host_fallback_reports_no_comms_split():

@@ -89,6 +89,10 @@ _LOWER_KEYS = (
     "comms_ms_per_step",
     "sample_age_p95_s",
     "policy_lag_p95",
+    # parameter-sharding footprint gauges: growing per-device HBM use is a
+    # regression (a model_axis change that stopped sharding, say)
+    "params_bytes_per_device",
+    "opt_state_bytes_per_device",
 )
 
 

@@ -43,10 +43,11 @@ ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
 #: actor–learner plane acting through BurstActor (sheeprl_tpu/plane,
 #: algos/{sac,ppo}/player.py); droq and sac_ae were delisted when their
 #: coupled acting loops moved onto the shared BurstActor (K=1 default is
-#: bitwise the old per-step path). Keep in sync with
-#: howto/rollout_engine.md's support matrix.
+#: bitwise the old per-step path); a2c and ppo_recurrent followed (the
+#: recurrent player threads its LSTM state through the burst carry, done
+#: masking still host-side). Keep in sync with howto/rollout_engine.md's
+#: support matrix.
 GRANDFATHERED = {
-    "a2c/a2c.py",
     "dreamer_v1/dreamer_v1.py",
     "dreamer_v2/dreamer_v2.py",
     "dreamer_v3/dreamer_v3.py",
@@ -56,7 +57,6 @@ GRANDFATHERED = {
     "p2e_dv2/p2e_dv2_finetuning.py",
     "p2e_dv3/p2e_dv3_exploration.py",
     "p2e_dv3/p2e_dv3_finetuning.py",
-    "ppo_recurrent/ppo_recurrent.py",
 }
 
 #: helper files that legitimately step envs per-step (single eval episodes)

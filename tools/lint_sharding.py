@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Sharding-uniformity lint: no ad-hoc placement construction in algos/.
+
+Parameter sharding has exactly one decision point —
+``sheeprl_tpu/parallel/shard.py``'s spec-assignment pass, reached through
+:meth:`sheeprl_tpu.fabric.Fabric.shard_plan` (howto/sharding.md). An algo
+that builds its own ``NamedSharding``/``Mesh``/``PartitionSpec`` layout
+bypasses the plan: its placement is invisible to the checkpoint manifest
+(sharded save → resharded load breaks), to the
+``params_bytes_per_device`` telemetry gauges, and to the
+``model_axis=1``-is-bitwise-replicated guarantee.
+
+What this flags, for every ``.py`` under ``sheeprl_tpu/algos/``:
+
+- any ``NamedSharding(...)`` or ``Mesh(...)`` construction — always a
+  violation, the Fabric owns the mesh;
+- any ``PartitionSpec(...)`` / aliased ``P(...)`` call **outside** the
+  ``in_specs=`` / ``out_specs=`` keywords of a ``shard_map(...)`` call —
+  data-layout specs for the collective train program are fine, parameter
+  placement specs are not.
+
+AST-based; comments/docstrings are fine. Usage: ``python
+tools/lint_sharding.py`` — non-zero exit with findings on violation. Wired
+into the CI tier-1 lane (.github/workflows/tests.yml).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
+
+#: jax.sharding constructors that algos must never call directly
+_BANNED = {"NamedSharding", "Mesh"}
+#: allowed only inside shard_map(in_specs=..., out_specs=...) subtrees
+_SPEC = {"PartitionSpec"}
+_SPEC_KWARGS = {"in_specs", "out_specs"}
+
+
+def _local_aliases(tree: ast.Module) -> dict:
+    """Map local names to the jax.sharding constructor they bind.
+
+    Covers ``from jax.sharding import PartitionSpec as P`` and
+    ``from jax.sharding import NamedSharding``; attribute forms like
+    ``jax.sharding.NamedSharding(...)`` are matched by attr name directly.
+    """
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+            "jax.sharding",
+            "jax.experimental.shard_map",
+        ):
+            for alias in node.names:
+                if alias.name in _BANNED | _SPEC:
+                    aliases[alias.asname or alias.name] = alias.name
+    return aliases
+
+
+def _resolve(func: ast.AST, aliases: dict) -> str:
+    if isinstance(func, ast.Name):
+        return aliases.get(func.id, func.id if func.id in _BANNED | _SPEC else "")
+    if isinstance(func, ast.Attribute) and func.attr in _BANNED | _SPEC:
+        return func.attr
+    return ""
+
+
+def _allowed_spec_calls(tree: ast.Module) -> set:
+    """ids of Call nodes feeding a ``shard_map`` spec keyword — either
+    written inline in ``in_specs=``/``out_specs=`` or assigned to a local
+    that those keywords reference (``data_spec = P() if share else P(axis)``
+    hoisted above the ``shard_map`` call)."""
+    allowed = set()
+    spec_names = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _name_of(node.func) == "shard_map"):
+            continue
+        for kw in node.keywords:
+            if kw.arg in _SPEC_KWARGS:
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Call):
+                        allowed.add(id(sub))
+                    elif isinstance(sub, ast.Name):
+                        spec_names.add(sub.id)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id in spec_names for t in node.targets
+        ):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call):
+                    allowed.add(id(sub))
+    return allowed
+
+
+def _name_of(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def lint_file(path: str) -> list:
+    tree = ast.parse(open(path).read(), filename=path)
+    aliases = _local_aliases(tree)
+    allowed = _allowed_spec_calls(tree)
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        ctor = _resolve(node.func, aliases)
+        if ctor in _BANNED:
+            findings.append(
+                (
+                    node.lineno,
+                    f"ad-hoc {ctor}(...) in an algo — placement belongs to "
+                    "Fabric.shard_plan / sheeprl_tpu/parallel/shard.py",
+                )
+            )
+        elif ctor in _SPEC and id(node) not in allowed:
+            findings.append(
+                (
+                    node.lineno,
+                    "PartitionSpec(...) outside shard_map in_specs/out_specs "
+                    "— parameter placement goes through Fabric.shard_plan "
+                    "(plan.shardings()), not hand-built specs",
+                )
+            )
+    return findings
+
+
+def main() -> int:
+    violations = []
+    for root, _dirs, files in os.walk(ALGOS_DIR):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, ALGOS_DIR).replace(os.sep, "/")
+            violations.extend(
+                (rel, line, msg) for line, msg in lint_file(path)
+            )
+    if violations:
+        print("sharding-uniformity lint FAILED:")
+        for rel, line, msg in violations:
+            print(f"  sheeprl_tpu/algos/{rel}:{line}: {msg}")
+        return 1
+    print("sharding-uniformity lint OK (no ad-hoc placement in algos/)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
