@@ -52,6 +52,10 @@ Workloads:
    a `sac_lunarlander_8192_steps_act_burst16` line with the
    act_dispatches/rollout_bursts counters and the sps delta vs the
    per-step SAC stage.
+6. Fused-kernel evidence (ISSUE 13, howto/kernels.md): a
+   `hafner_ln_gru_seq_fwd_bwd_sps` line — the fused LayerNorm-GRU sequence
+   tiers vs the reference cell scan at the DV2 shape, forward+backward
+   (tools/bench_kernels.py; acceptance >= 1.2x on at least one tier).
 
 Wall-clock protocol (round-4 de-noising): repeated lines run one warm-up
 (compile/cache fill, disclosed) plus up to 3 measured repeats — trimmed to
@@ -434,6 +438,36 @@ def _rollout_jax_line(min_stage_s: float = 60.0) -> str:
         return json.dumps({"metric": metric, "value": None, "error": repr(exc)[:400]})
 
 
+def _kernels_line(min_stage_s: float = 60.0) -> str:
+    """Fused-kernel evidence (ISSUE-13, howto/kernels.md): forward+backward
+    of the LayerNorm-GRU sequence at the DV2 shape — the fused tiers vs the
+    reference cell under ``lax.scan`` (tools/bench_kernels.py). Acceptance:
+    ``speedup_vs_reference`` >= 1.2 on at least one tier; the ``steps/s``
+    value is diffed across rounds by tools/bench_compare.py."""
+    metric = "hafner_ln_gru_seq_fwd_bwd_sps"
+    if _remaining() < min_stage_s:
+        return _skip_line(metric, min_stage_s)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_kernels.py")],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=max(60.0, _remaining()),
+        )
+        line = next(
+            (l for l in reversed(proc.stdout.splitlines()) if l.startswith("{")), None
+        )
+        if proc.returncode == 0 and line:
+            return line
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+        return json.dumps(
+            {"metric": metric, "value": None, "error": " | ".join(tail)[-400:]}
+        )
+    except Exception as exc:
+        return json.dumps({"metric": metric, "value": None, "error": repr(exc)[:400]})
+
+
 def _sac_line() -> str:
     # reference protocol (benchmark_sb3.py:21-29): LunarLanderContinuous,
     # 4 envs, 65536 steps. SAC is one policy+one train dispatch per env step,
@@ -729,6 +763,10 @@ def main() -> None:
     # rollout-engine tier-a evidence: jitted-scan collection sps vs the sync
     # Python loop (cheap, ~1 min; ISSUE-6 acceptance >= 10x)
     emit(_rollout_jax_line())
+    # fused-kernel evidence: LayerNorm-GRU sequence fwd+bwd, fused tiers vs
+    # the reference scan at the DV2 shape (cheap, ~1 min; ISSUE-13
+    # acceptance >= 1.2x on >= 1 tier)
+    emit(_kernels_line())
     # actor–learner plane evidence: 2-player+1-learner decoupled SAC vs the
     # thread-local decoupled baseline (plane counters + plane_wait/train
     # phase tails as the collection-overlap decomposition). Early in the

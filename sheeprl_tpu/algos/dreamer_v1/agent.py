@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu import kernels
 from sheeprl_tpu.algos.dreamer_v2.agent import (
     CNNDecoder,
     CNNEncoder,
@@ -90,14 +91,17 @@ class RecurrentModel(nn.Module):
 
     recurrent_state_size: int
     activation: Any = "elu"
+    fused: str = "off"  # resolved kernel tier (sheeprl_tpu/kernels)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
-        from sheeprl_tpu.models import resolve_activation
+        from sheeprl_tpu.models import FusedGRUCell, resolve_activation
 
         feat = nn.Dense(self.recurrent_state_size)(x)
         feat = resolve_activation(self.activation)(feat)
-        return nn.GRUCell(self.recurrent_state_size, name="gru")(h, feat)[1]
+        # FusedGRUCell is parameter- and bitwise-compatible with the
+        # nn.GRUCell it replaced; fused="off" is the flax math verbatim
+        return FusedGRUCell(self.recurrent_state_size, name="gru", fused=self.fused)(h, feat)[1]
 
 
 class _GaussianStochasticModel(nn.Module):
@@ -126,11 +130,13 @@ class RSSM(nn.Module):
     representation_hidden_size: Optional[int] = None
     min_std: float = 0.1
     activation: Any = "elu"
+    fused: str = "off"
 
     def setup(self):
         self.recurrent_model = RecurrentModel(
             recurrent_state_size=self.recurrent_state_size,
             activation=self.activation,
+            fused=self.fused,
         )
         self.representation_model = _GaussianStochasticModel(
             hidden_size=self.representation_hidden_size or self.hidden_size,
@@ -253,6 +259,7 @@ class WorldModel(nn.Module):
     min_std: float = 0.1
     cnn_act: Any = "relu"
     dense_act: Any = "elu"
+    fused: str = "off"
 
     def setup(self):
         if self.cnn_keys:
@@ -294,6 +301,7 @@ class WorldModel(nn.Module):
             representation_hidden_size=self.representation_hidden_size,
             min_std=self.min_std,
             activation=self.dense_act,
+            fused=self.fused,
         )
         self.reward_model = MLPHead(
             output_dim=1,
@@ -394,6 +402,9 @@ def build_agent(
     screen = int(cfg.env.screen_size)
     cnn_channels = [int(np.prod(observation_space[k].shape[:-2])) for k in cnn_keys]
     mlp_dims = [int(np.prod(observation_space[k].shape)) for k in mlp_keys]
+    # DV1's recurrent core is flax-GRU math: a `pallas` request degrades to
+    # the padded-XLA tier inside resolve_tier (family has no Pallas kernel)
+    fused = kernels.resolve_tier(cfg.algo.get("fused_kernels", "off"), family="flax_gru")
 
     world_model = WorldModel(
         cnn_keys=cnn_keys,
@@ -417,6 +428,7 @@ def build_agent(
         min_std=float(wm_cfg.min_std),
         cnn_act=cfg.algo.cnn_act,
         dense_act=cfg.algo.dense_act,
+        fused=fused,
     )
     latent_size = int(wm_cfg.stochastic_size) + int(wm_cfg.recurrent_model.recurrent_state_size)
     actor = Actor(

@@ -44,6 +44,7 @@ from sheeprl_tpu.algos.dreamer_v3.agent import (
     resolve_actor_distribution,
     sample_actor_actions,
 )
+from sheeprl_tpu import kernels
 from sheeprl_tpu.models import MLP, CNN, DeCNN, LayerNormGRUCell
 
 sg = jax.lax.stop_gradient
@@ -191,6 +192,7 @@ class RecurrentModel(nn.Module):
     dense_units: int
     layer_norm: bool = False
     activation: Any = "elu"
+    fused: str = "off"  # resolved kernel tier (sheeprl_tpu/kernels)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
@@ -200,7 +202,8 @@ class RecurrentModel(nn.Module):
             layer_norm=self.layer_norm,
         )(x)
         return LayerNormGRUCell(
-            self.recurrent_state_size, bias=True, layer_norm=True, norm_eps=1e-5, name="gru"
+            self.recurrent_state_size, bias=True, layer_norm=True, norm_eps=1e-5, name="gru",
+            fused=self.fused,
         )(feat, h)
 
 
@@ -270,6 +273,7 @@ class RSSM(nn.Module):
     layer_norm: bool = False
     recurrent_layer_norm: bool = True
     activation: Any = "elu"
+    fused: str = "off"
 
     def setup(self):
         self.recurrent_model = RecurrentModel(
@@ -277,6 +281,7 @@ class RSSM(nn.Module):
             dense_units=self.dense_units,
             layer_norm=self.recurrent_layer_norm,
             activation=self.activation,
+            fused=self.fused,
         )
         stoch = self.stochastic_size * self.discrete_size
         self.representation_model = _StochasticModel(
@@ -440,6 +445,7 @@ class WorldModel(nn.Module):
     layer_norm: bool = False
     cnn_act: Any = "elu"
     dense_act: Any = "elu"
+    fused: str = "off"
 
     def setup(self):
         if self.cnn_keys:
@@ -483,6 +489,7 @@ class WorldModel(nn.Module):
             representation_hidden_size=self.representation_hidden_size,
             layer_norm=self.layer_norm,
             activation=self.dense_act,
+            fused=self.fused,
         )
         self.reward_model = MLPHead(
             output_dim=1,
@@ -622,6 +629,9 @@ def build_agent(
     screen = int(cfg.env.screen_size)
     cnn_channels = [int(np.prod(observation_space[k].shape[:-2])) for k in cnn_keys]
     mlp_dims = [int(np.prod(observation_space[k].shape)) for k in mlp_keys]
+    # resolve the fused-kernel tier once, here: the string is baked into the
+    # module tree so every train/player/imagination call sites agree
+    fused = kernels.resolve_tier(cfg.algo.get("fused_kernels", "off"), family="hafner_ln_gru")
 
     world_model = WorldModel(
         cnn_keys=cnn_keys,
@@ -646,6 +656,7 @@ def build_agent(
         layer_norm=bool(cfg.algo.layer_norm),
         cnn_act=cfg.algo.cnn_act,
         dense_act=cfg.algo.dense_act,
+        fused=fused,
     )
     latent_size = (
         int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)

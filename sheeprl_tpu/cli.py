@@ -213,13 +213,49 @@ def check_configs(cfg) -> None:
         "ppo",
         "sac_decoupled",
         "ppo_decoupled",
+        "dreamer_v1",
+        "dreamer_v2",
     ):
         warnings.warn(
             f"env.act_burst={cfg.env.act_burst} is only consumed by the "
-            f"SAC-family/PPO rollout paths (coupled loops and plane players); "
-            f"'{algo_name}' acts per-step (howto/rollout_engine.md)",
+            f"SAC-family/PPO/dreamer-v1/v2 rollout paths (coupled loops and "
+            f"plane players); '{algo_name}' acts per-step "
+            "(howto/rollout_engine.md)",
             UserWarning,
         )
+
+    # fused recurrent-core kernels (algo.fused_kernels, sheeprl_tpu/kernels):
+    # a `pallas` request on a non-TPU backend is not an error — the registry
+    # degrades it to the padded-XLA tier at agent-build time — but say so
+    # here, up front, instead of only counting it in telemetry
+    from sheeprl_tpu.kernels import normalize_tier
+
+    fused_req = normalize_tier(cfg.algo.get("fused_kernels", "off"))
+    if fused_req != "off" and algo_name not in (
+        "dreamer_v1",
+        "dreamer_v2",
+        "p2e_dv1_exploration",
+        "p2e_dv1_finetuning",
+        "p2e_dv2_exploration",
+        "p2e_dv2_finetuning",
+    ):
+        warnings.warn(
+            f"algo.fused_kernels={cfg.algo.fused_kernels} is only consumed by "
+            f"the dreamer-v1/v2 recurrent cores (and their P2E variants); "
+            f"'{algo_name}' ignores it (howto/kernels.md)",
+            UserWarning,
+        )
+    elif fused_req == "pallas":
+        import jax
+
+        if jax.default_backend() != "tpu":
+            warnings.warn(
+                f"algo.fused_kernels=pallas on backend={jax.default_backend()}: "
+                "the Pallas kernels target TPU — the run will auto-degrade to "
+                "the padded-XLA tier (counted as kernel_tier_degraded in "
+                "telemetry; howto/kernels.md)",
+                UserWarning,
+            )
 
     # the actor–learner plane (plane.*, sheeprl_tpu/plane) is consumed by the
     # decoupled entrypoints only; validate its knobs here so a multi-process

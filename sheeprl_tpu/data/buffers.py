@@ -891,6 +891,11 @@ class EnvIndependentReplayBuffer:
         return all(b.full for b in self._buf)
 
     def seed(self, seed: Optional[int] = None) -> None:
+        # the wrapper's own rng drives pick_envs (the per-batch env mix) and
+        # must be reseeded along with the sub-buffers, or seeded runs still
+        # draw their env partitions from OS entropy (offset past the
+        # sub-buffer streams so no two generators share a seed)
+        self._rng = np.random.default_rng(None if seed is None else seed + self._n_envs)
         for i, b in enumerate(self._buf):
             b.seed(None if seed is None else seed + i)
 

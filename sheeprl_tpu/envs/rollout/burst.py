@@ -4,25 +4,34 @@ The per-step acting path pays one policy dispatch per env step:
 ``policy_fn(...)`` → ``np.asarray(actions)`` → ``envs.step(...)`` — and on
 a remote-attached accelerator each dispatch is a network round trip.
 :class:`BurstActor` compiles K acting steps into ONE dispatched program: a
-``lax.scan`` whose body runs the policy on device and hands the actions to
-the host through an ordered :func:`jax.experimental.io_callback`. The host
-callback is the *whole* old loop body — ``envs.step`` (against the PR-5
-shared-memory obs slabs), episode bookkeeping, the replay-buffer ``add`` —
-and returns the prepared next observation for the following in-scan act.
+``lax.while_loop`` whose body runs the policy on device and hands the
+actions to the host through an ordered
+:func:`jax.experimental.io_callback`. The host callback is the *whole* old
+loop body — ``envs.step`` (against the PR-5 shared-memory obs slabs),
+episode bookkeeping, the replay-buffer ``add`` — and returns the prepared
+next observation for the following in-loop act.
+
+The burst length is a *traced scalar*, not a static loop bound: every K
+runs the SAME compiled program, just with a different trip count. That is
+what makes trajectories bitwise-independent of K (asserted for every
+converted family in ``tests/test_envs/test_rollout.py``) — with one
+program per length, XLA inlines the trip-count-1 loop and the changed
+fusion context perturbs the acting math by an ulp, which a seeded bitwise
+gate catches. One program also means one trace/compile, however often the
+train-gating clamps vary the burst length mid-run.
 
 So the data still crosses the link every step (the envs are Python), but
 the per-step *dispatch* — trace-cache lookup, program launch, host sync on
 the action fetch — is paid once per burst: ``K = env.act_burst`` acts per
-dispatch. With ``K = 1`` this is the old per-step path, same key discipline
-and bitwise the same trajectories (asserted in
-``tests/test_envs/test_rollout.py``); larger K trades train/log/checkpoint
+dispatch. With ``K = 1`` this is the old per-step path, same key
+discipline and the same trajectories; larger K trades train/log/checkpoint
 *cadence granularity* (gates run per burst, not per step) for dispatch
 amortization — see ``howto/rollout_engine.md``.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Tuple
 
 import numpy as np
 
@@ -57,7 +66,7 @@ class BurstActor:
             lambda x: jax.ShapeDtypeStruct(np.asarray(x).shape, np.asarray(x).dtype),
             obs_example,
         )
-        self._rollout_fns: Dict[int, Any] = {}
+        self._rollout_fn: Any = None
         self._device: Any = None
 
     @staticmethod
@@ -77,24 +86,31 @@ class BurstActor:
         except RuntimeError:
             return jax.devices()[0]
 
-    def _build(self, burst_len: int):
+    def _build(self):
         import jax
+        import jax.numpy as jnp
         from jax.experimental import io_callback
 
         act_fn = self._act_fn
         host_step = self._host_step
         obs_spec = self._obs_spec
 
-        def rollout(params, obs, key):
-            def body(carry, _):
-                obs, key = carry
+        def rollout(params, obs, key, n):
+            # n is traced: one compiled program serves every burst length,
+            # so the acting math cannot depend on K (bitwise K-invariance)
+            def cond(carry):
+                i, _, _ = carry
+                return i < n
+
+            def body(carry):
+                i, obs, key = carry
                 cb_args, key = act_fn(params, obs, key)
                 # ordered: env steps must run in sequence, and the next act
                 # consumes exactly this step's observation
                 next_obs = io_callback(host_step, obs_spec, *cb_args, ordered=True)
-                return (next_obs, key), ()
+                return (i + jnp.int32(1), next_obs, key)
 
-            (obs, key), _ = jax.lax.scan(body, (obs, key), None, length=burst_len)
+            _, obs, key = jax.lax.while_loop(cond, body, (jnp.int32(0), obs, key))
             return obs, key
 
         return jax.jit(rollout)
@@ -106,10 +122,9 @@ class BurstActor:
         import jax
 
         burst_len = int(burst_len)
-        fn = self._rollout_fns.get(burst_len)
-        if fn is None:
-            fn = self._build(burst_len)
-            self._rollout_fns[burst_len] = fn
+        if self._rollout_fn is None:
+            self._rollout_fn = self._build()
+        fn = self._rollout_fn
         # The burst program must be SINGLE-device: this jax version's SPMD
         # sharding propagation CHECK-aborts on io_callback programs with
         # multi-device (mesh-replicated) inputs. Pin to wherever the acting
@@ -120,7 +135,7 @@ class BurstActor:
         if self._device is None:
             self._device = self._params_device(params)
         params, obs, key = jax.device_put((params, obs, key), self._device)
-        obs, key = fn(params, obs, key)
+        obs, key = fn(params, obs, key, np.int32(burst_len))
         # FENCE: dispatch is async — the caller is about to read host state
         # the callbacks mutate (replay buffer, episode stats). The returned
         # obs is data-dependent on the LAST ordered callback, so readiness

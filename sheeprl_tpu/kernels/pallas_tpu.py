@@ -1,0 +1,280 @@
+"""Tier ``pallas`` — TPU Pallas kernels for the Hafner LayerNorm-GRU.
+
+Two kernels (see /opt/skills guide + howto/kernels.md):
+
+- **cell**: one fused step — joint matmul (two MXU dots, ``h`` and ``x``
+  parts separately so no lane-concat is needed), masked LayerNorm over the
+  real lanes, gate block — all in one ``pallas_call`` on the padded
+  ``Hp = ceil(H/128)·128`` layout (DV2: 600 → 640, so the 3·H projection
+  runs 1920 full lanes instead of 1800 straddled ones).
+- **sequence**: the whole ``lax.scan`` time loop fused into ONE kernel:
+  ``grid=(T,)`` with the hidden state resident in a VMEM scratch across
+  grid steps (verified semantics: scratch persists across iterations,
+  ``pl.when(t == 0)`` seeds it from ``h0``), one timestep of ``xs``
+  streamed in per step and one row of the trajectory written out.
+
+Both are wrapped in ``jax.custom_vjp`` whose backward is ``jax.vjp`` of
+the *padded XLA program* (``kernels.xla``) over the same padded operands —
+the ISSUE-sanctioned "backward as the XLA reference autodiff" option: the
+fused forward changes the schedule, not the math, so the XLA gradient is
+the gradient. Forward parity vs the reference cell and gradient parity vs
+reference autodiff are asserted by ``tests/test_models/test_kernels.py``
+(CPU via ``interpret=True``).
+
+Input padding: the Pallas tier additionally pads the input width ``X`` to
+the lane multiple (extra zero *rows* in the kernel — they contribute
+nothing) so every operand lands on full ``(8, 128)`` f32 tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific params; present in the CPU install, harmless if not
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from sheeprl_tpu.kernels import xla
+
+__all__ = ["LANE", "hafner_cell", "hafner_sequence"]
+
+#: TPU vector-lane width — the tile the hidden state is padded to
+LANE = 128
+
+
+def _gate_block(z, h, *, H, Hp, eps, layer_norm, scale, bias):
+    """Shared in-kernel epilogue: masked LayerNorm + Hafner gates."""
+    if layer_norm:
+        n_real = 3.0 * H
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 3 * Hp), 1)
+        mask = ((lane % Hp) < H).astype(jnp.float32)
+        mu = jnp.sum(z, axis=-1, keepdims=True) / n_real
+        var = jnp.sum(jnp.square(z - mu) * mask, axis=-1, keepdims=True) / n_real
+        z = (z - mu) * jax.lax.rsqrt(var + eps)
+        z = z * scale + bias
+    reset = jax.nn.sigmoid(z[:, :Hp])
+    cand = jnp.tanh(reset * z[:, Hp : 2 * Hp])
+    update = jax.nn.sigmoid(z[:, 2 * Hp :] - 1.0)
+    return update * cand + (1.0 - update) * h
+
+
+def _cell_kernel(h_ref, x_ref, w_ref, b_ref, s_ref, lb_ref, o_ref, *, H, Hp, eps, layer_norm):
+    h = h_ref[...]
+    w = w_ref[...]
+    # two dots instead of concat([h, x]) @ W: no lane-dim concatenation
+    z = jnp.dot(h, w[:Hp], preferred_element_type=jnp.float32)
+    z += jnp.dot(x_ref[...], w[Hp:], preferred_element_type=jnp.float32)
+    z += b_ref[...]
+    o_ref[...] = _gate_block(
+        z, h, H=H, Hp=Hp, eps=eps, layer_norm=layer_norm, scale=s_ref[...], bias=lb_ref[...]
+    )
+
+
+def _seq_kernel(
+    h0_ref, xs_ref, w_ref, b_ref, s_ref, lb_ref, o_ref, h_scr, *, H, Hp, eps, layer_norm
+):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _seed():
+        h_scr[...] = h0_ref[...]
+
+    h = h_scr[...]
+    w = w_ref[...]
+    z = jnp.dot(h, w[:Hp], preferred_element_type=jnp.float32)
+    z += jnp.dot(xs_ref[0], w[Hp:], preferred_element_type=jnp.float32)
+    z += b_ref[...]
+    new_h = _gate_block(
+        z, h, H=H, Hp=Hp, eps=eps, layer_norm=layer_norm, scale=s_ref[...], bias=lb_ref[...]
+    )
+    h_scr[...] = new_h
+    o_ref[0] = new_h
+
+
+def _compiler_params():
+    if pltpu is None:  # pragma: no cover
+        return None
+    # the (T,) grid is a serial recurrence through the VMEM scratch
+    return pltpu.TPUCompilerParams(dimension_semantics=("arbitrary",))
+
+
+def _pad_operands(h, x, kernel, bias, ln_scale, ln_bias, *, hidden_size, layer_norm):
+    """Real-width operands → full-tile padded layout (H and X both padded;
+    dummy ones/zeros LN affine when the cell runs without LayerNorm, so the
+    kernel signature is static)."""
+    H = int(hidden_size)
+    kernel, bias, ln_scale, ln_bias, Hp = xla.pad_hafner_params(
+        kernel, bias, ln_scale, ln_bias, hidden_size=H, pad_to=LANE
+    )
+    X = kernel.shape[0] - Hp
+    Xp = xla.round_up(max(X, 1), LANE)
+    if Xp != X:
+        kernel = jnp.concatenate([kernel[:Hp], xla.pad_axis(kernel[Hp:], 0, Xp)], axis=0)
+    x = xla.pad_axis(x, -1, Xp)
+    h = xla.pad_axis(h, -1, Hp)
+    if bias is None:
+        bias = jnp.zeros((3 * Hp,), kernel.dtype)
+    if not layer_norm or ln_scale is None:
+        ln_scale = jnp.ones((3 * Hp,), kernel.dtype)
+        ln_bias = jnp.zeros((3 * Hp,), kernel.dtype)
+    return h, x, kernel, bias.reshape(1, -1), ln_scale.reshape(1, -1), ln_bias.reshape(1, -1), Hp
+
+
+@functools.lru_cache(maxsize=None)
+def _make_cell(H: int, Hp: int, eps: float, layer_norm: bool, interpret: bool):
+    body = functools.partial(_cell_kernel, H=H, Hp=Hp, eps=eps, layer_norm=layer_norm)
+
+    def impl(h, x, w, b, s, lb):
+        call = pl.pallas_call(
+            body,
+            out_shape=jax.ShapeDtypeStruct(h.shape, jnp.float32),
+            interpret=interpret,
+            **({} if interpret or pltpu is None else {"compiler_params": _compiler_params()}),
+        )
+        return call(h, x, w, b, s, lb)
+
+    @jax.custom_vjp
+    def cell(h, x, w, b, s, lb):
+        return impl(h, x, w, b, s, lb)
+
+    def fwd(h, x, w, b, s, lb):
+        return impl(h, x, w, b, s, lb), (h, x, w, b, s, lb)
+
+    def bwd(res, g):
+        # gradient of the padded XLA program — same math, XLA's autodiff
+        def ref(h, x, w, b, s, lb):
+            return xla.hafner_cell_padded(
+                h, x, w, b.reshape(-1),
+                s.reshape(-1) if layer_norm else None,
+                lb.reshape(-1) if layer_norm else None,
+                hidden_size=H, padded_size=Hp, eps=eps,
+            )
+
+        _, vjp = jax.vjp(ref, *res)
+        return vjp(g)
+
+    cell.defvjp(fwd, bwd)
+    return cell
+
+
+@functools.lru_cache(maxsize=None)
+def _make_sequence(H: int, Hp: int, eps: float, layer_norm: bool, interpret: bool):
+    body = functools.partial(_seq_kernel, H=H, Hp=Hp, eps=eps, layer_norm=layer_norm)
+
+    def impl(h0, xs, w, b, s, lb):
+        if pltpu is None:  # pragma: no cover
+            raise RuntimeError("pallas TPU support is unavailable in this jax install")
+        T, B, Xp = xs.shape
+        call = pl.pallas_call(
+            body,
+            grid=(T,),
+            in_specs=[
+                pl.BlockSpec((B, Hp), lambda t: (0, 0)),
+                pl.BlockSpec((1, B, Xp), lambda t: (t, 0, 0)),
+                pl.BlockSpec(w.shape, lambda t: (0, 0)),
+                pl.BlockSpec(b.shape, lambda t: (0, 0)),
+                pl.BlockSpec(s.shape, lambda t: (0, 0)),
+                pl.BlockSpec(lb.shape, lambda t: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, B, Hp), lambda t: (t, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((T, B, Hp), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((B, Hp), jnp.float32)],
+            interpret=interpret,
+            **({} if interpret or pltpu is None else {"compiler_params": _compiler_params()}),
+        )
+        return call(h0, xs, w, b, s, lb)
+
+    @jax.custom_vjp
+    def seq(h0, xs, w, b, s, lb):
+        return impl(h0, xs, w, b, s, lb)
+
+    def fwd(h0, xs, w, b, s, lb):
+        return impl(h0, xs, w, b, s, lb), (h0, xs, w, b, s, lb)
+
+    def bwd(res, g):
+        def ref(h0, xs, w, b, s, lb):
+            return _xla_sequence_padded(
+                h0, xs, w, b, s, lb, H=H, Hp=Hp, eps=eps, layer_norm=layer_norm
+            )
+
+        _, vjp = jax.vjp(ref, *res)
+        return vjp(g)
+
+    seq.defvjp(fwd, bwd)
+    return seq
+
+
+def _xla_sequence_padded(h0, xs, w, b, s, lb, *, H, Hp, eps, layer_norm):
+    """Padded-layout XLA twin of the sequence kernel (hoisted input GEMM +
+    scan) — the custom-VJP backward program."""
+    kh, kx = w[:Hp], w[Hp:]
+    zx = jnp.einsum("tbx,xh->tbh", xs, kx) + b
+
+    def bodyfn(h, zx_t):
+        z = h @ kh + zx_t
+        if layer_norm:
+            z = xla.masked_layer_norm(
+                z, s.reshape(-1), lb.reshape(-1), eps=eps, hidden_size=H, padded_size=Hp
+            )
+        reset = jax.nn.sigmoid(z[:, :Hp])
+        cand = jnp.tanh(reset * z[:, Hp : 2 * Hp])
+        update = jax.nn.sigmoid(z[:, 2 * Hp :] - 1.0)
+        new_h = update * cand + (1.0 - update) * h
+        return new_h, new_h
+
+    _, hs = jax.lax.scan(bodyfn, h0, zx)
+    return hs
+
+
+def hafner_cell(
+    h: jnp.ndarray,
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    bias: Optional[jnp.ndarray],
+    ln_scale: Optional[jnp.ndarray],
+    ln_bias: Optional[jnp.ndarray],
+    *,
+    hidden_size: int,
+    eps: float = 1e-3,
+    layer_norm: bool = True,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One fused LayerNorm-GRU step on real-width operands; pads to tile,
+    runs the Pallas cell, slices the real lanes back out."""
+    H = int(hidden_size)
+    h_p, x_p, w, b, s, lb, Hp = _pad_operands(
+        h, x, kernel, bias, ln_scale, ln_bias, hidden_size=H, layer_norm=layer_norm
+    )
+    cell = _make_cell(H, Hp, float(eps), bool(layer_norm and ln_scale is not None), interpret)
+    out = cell(h_p, x_p, w, b, s, lb)
+    return out if Hp == H else out[..., :H]
+
+
+def hafner_sequence(
+    h0: jnp.ndarray,
+    xs: jnp.ndarray,
+    kernel: jnp.ndarray,
+    bias: Optional[jnp.ndarray],
+    ln_scale: Optional[jnp.ndarray],
+    ln_bias: Optional[jnp.ndarray],
+    *,
+    hidden_size: int,
+    eps: float = 1e-3,
+    layer_norm: bool = True,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Whole-sequence fused scan: ``xs`` is ``[T, B, X]`` → trajectory
+    ``[T, B, H]``, hidden state VMEM-resident across the ``grid=(T,)``."""
+    H = int(hidden_size)
+    h_p, xs_p, w, b, s, lb, Hp = _pad_operands(
+        h0, xs, kernel, bias, ln_scale, ln_bias, hidden_size=H, layer_norm=layer_norm
+    )
+    seq = _make_sequence(H, Hp, float(eps), bool(layer_norm and ln_scale is not None), interpret)
+    out = seq(h_p, xs_p, w, b, s, lb)
+    return out if Hp == H else out[..., :H]

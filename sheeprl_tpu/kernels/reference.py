@@ -1,0 +1,115 @@
+"""Reference (tier ``off``) recurrent-cell math — the single source of truth.
+
+This module holds the *exact* gate math the flax modules in
+``sheeprl_tpu/models`` and the dreamer agents execute today, extracted so
+that (a) the fused tiers in ``xla.py``/``pallas_tpu.py`` have one canonical
+program to be tested against, and (b) ``tools/lint_kernels.py`` can forbid
+open-coded GRU gate math anywhere under ``algos/`` or ``models/`` outside
+this registry.
+
+Two cell families live here:
+
+- ``hafner_cell`` — the Hafner-style LayerNorm-GRU used by the RSSM
+  recurrent core (``models.LayerNormGRUCell``; reference dreamerv2
+  nets.py:317): one joint Dense over ``[h, x]`` → LayerNorm → gates with
+  ``cand = tanh(reset * cand)`` and the update gate biased by −1.
+- ``flax_gru_cell`` — flax 0.10 ``nn.GRUCell`` math (DreamerV1's recurrent
+  model), with the 6-Dense parameter layout (``ir/iz/in`` with bias,
+  ``hr/hz`` without, ``hn`` with).
+
+Every op here is written to be BITWISE what the corresponding flax module
+produces (same ``lax.dot_general`` dims, same bias broadcast, the same
+``fast_layer_norm`` custom-VJP) — the ``fused_kernels=off`` tier is these
+functions, so "off is today's runtime" holds by construction and is
+asserted by ``tests/test_models/test_kernels.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.models.norm import fast_layer_norm
+
+__all__ = ["dense_apply", "hafner_gates", "hafner_cell", "flax_gru_gates", "flax_gru_cell"]
+
+
+def dense_apply(x: jnp.ndarray, kernel: jnp.ndarray, bias: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """``flax.linen.Dense`` forward, bitwise: the same ``dot_general``
+    contraction dims and the same reshaped-bias broadcast flax emits."""
+    y = jax.lax.dot_general(x, kernel, (((x.ndim - 1,), (0,)), ((), ())))
+    if bias is not None:
+        y += jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
+    return y
+
+
+def hafner_gates(z: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
+    """The Hafner gate block: ``z`` is the (optionally LayerNormed) joint
+    projection ``[reset | cand | update]``; returns the new hidden state.
+    Op order matches ``models.LayerNormGRUCell.__call__`` exactly."""
+    reset, cand, update = jnp.split(z, 3, axis=-1)
+    reset = jax.nn.sigmoid(reset)
+    cand = jnp.tanh(reset * cand)
+    update = jax.nn.sigmoid(update - 1)
+    return update * cand + (1 - update) * h
+
+
+def hafner_cell(
+    h: jnp.ndarray,
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    bias: Optional[jnp.ndarray],
+    ln_scale: Optional[jnp.ndarray],
+    ln_bias: Optional[jnp.ndarray],
+    *,
+    eps: float = 1e-3,
+) -> jnp.ndarray:
+    """Full reference LayerNorm-GRU step on explicit parameters.
+
+    ``kernel`` is the joint ``[H+X, 3H]`` Dense kernel (h rows first — the
+    cell concatenates ``[h, x]``), ``ln_scale``/``ln_bias`` are the
+    ``FastLayerNorm`` affine params (``None`` → no LayerNorm, DV1-style).
+    """
+    inp = jnp.concatenate([h, x], axis=-1)
+    z = dense_apply(inp, kernel, bias)
+    if ln_scale is not None:
+        z = fast_layer_norm(z, ln_scale, ln_bias, float(eps)).astype(
+            jnp.promote_types(z.dtype, ln_scale.dtype)
+        )
+    return hafner_gates(z, h)
+
+
+def flax_gru_gates(
+    ir: jnp.ndarray,
+    iz: jnp.ndarray,
+    in_: jnp.ndarray,
+    hr: jnp.ndarray,
+    hz: jnp.ndarray,
+    hn: jnp.ndarray,
+    h: jnp.ndarray,
+) -> jnp.ndarray:
+    """flax ``nn.GRUCell`` gate block on the six Dense projections:
+
+        r = σ(ir + hr); z = σ(iz + hz); n = tanh(in + r · hn)
+        h' = (1−z)·n + z·h
+    """
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    return (1.0 - z) * n + z * h
+
+
+def flax_gru_cell(h: jnp.ndarray, x: jnp.ndarray, params) -> jnp.ndarray:
+    """flax 0.10 ``nn.GRUCell`` math on its native parameter tree
+    (``{"ir","iz","in","hr","hz","hn"}``), bitwise the flax module."""
+
+    def dense(inputs, name):
+        p = params[name]
+        return dense_apply(inputs, p["kernel"], p.get("bias"))
+
+    return flax_gru_gates(
+        dense(x, "ir"), dense(x, "iz"), dense(x, "in"),
+        dense(h, "hr"), dense(h, "hz"), dense(h, "hn"), h,
+    )

@@ -1,0 +1,272 @@
+"""Tier ``xla`` — padded + fused recurrent cells in pure XLA.
+
+Runs everywhere (including the tier-1 CPU lane). Two ideas:
+
+- **Pad-to-tile**: the DV2 RSSM hidden width (600) straddles the TPU's
+  128-lane tile; padding ``H → ceil(H/128)·128`` (640) lets every matmul
+  and elementwise op land on full tiles. Padding is pure zero-extension of
+  the parameters *inside* the differentiated program, so gradients flow
+  back through the padding ops and slice themselves to the real blocks —
+  no separate unpad bookkeeping. On CPU ``pad_to=1`` short-circuits to the
+  unpadded shapes and the op sequence is bitwise the reference cell
+  (asserted in tests/test_models/test_kernels.py).
+- **Fuse / hoist**: the cell runs as one joint projection + one gate
+  block; the sequence form additionally hoists the input projection
+  ``xs @ W_x`` out of the ``lax.scan`` into a single ``[T·B, X]`` GEMM
+  (the cuDNN-RNN trick), shrinking the serial per-step matmul from
+  ``[B, H+X]@[H+X, 3H]`` to ``[B, H]@[H, 3H]``. The sequence form applies
+  when the whole input sequence is known up front (bench, embeddings
+  precomputed); the production RSSM scan feeds the cell per step because
+  ``x_t`` depends on the previous posterior.
+
+Padding invariants (why masking cannot leak): padded kernel columns, bias
+lanes, and LayerNorm scale/bias lanes are zero, so padded pre-activation
+lanes are exactly 0 and LayerNorm statistics are taken over the real
+lanes only (explicit mask in the variance); a zero-initialised padded
+hidden lane stays exactly 0 through the gate block (``cand = tanh(σ(0)·0)
+= 0``), so real lanes never see padding garbage. Verified at widths
+600/599/128/1 by the parity suite.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.kernels import reference
+from sheeprl_tpu.models.norm import fast_layer_norm
+
+__all__ = [
+    "round_up",
+    "pad_axis",
+    "pad_hafner_params",
+    "pad_flax_gru_params",
+    "masked_layer_norm",
+    "hafner_cell_fused",
+    "hafner_sequence_fused",
+    "flax_gru_cell_fused",
+]
+
+
+def round_up(n: int, multiple: int) -> int:
+    return ((int(n) + multiple - 1) // multiple) * multiple
+
+
+def pad_axis(a: jnp.ndarray, axis: int, new_size: int) -> jnp.ndarray:
+    """Zero-pad one axis up to ``new_size`` (no-op when already there)."""
+    if a.shape[axis] == new_size:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, new_size - a.shape[axis])
+    return jnp.pad(a, widths)
+
+
+def pad_hafner_params(
+    kernel: jnp.ndarray,
+    bias: Optional[jnp.ndarray],
+    ln_scale: Optional[jnp.ndarray],
+    ln_bias: Optional[jnp.ndarray],
+    *,
+    hidden_size: int,
+    pad_to: int,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray], Optional[jnp.ndarray], int]:
+    """Zero-extend the joint ``[H+X, 3H]`` Hafner parameters to the padded
+    layout ``[Hp+X, 3Hp]`` (gate ``g``'s real block lands at rows ``:H`` /
+    ``Hp:`` and columns ``g·Hp : g·Hp+H``). Returns ``(kernel, bias,
+    ln_scale, ln_bias, Hp)``; everything passes through untouched when
+    ``Hp == H``."""
+    H = int(hidden_size)
+    Hp = round_up(H, pad_to)
+    if Hp == H:
+        return kernel, bias, ln_scale, ln_bias, H
+    X = kernel.shape[0] - H
+
+    def pad_cols(v):
+        # [.., 3H] -> [.., 3Hp] with each gate block re-based at g*Hp
+        parts = jnp.split(v, 3, axis=-1)
+        return jnp.concatenate([pad_axis(p, -1, Hp) for p in parts], axis=-1)
+
+    kh = pad_axis(pad_cols(kernel[:H]), 0, Hp)  # [Hp, 3Hp]
+    kx = pad_cols(kernel[H : H + X])  # [X, 3Hp]
+    kernel_p = jnp.concatenate([kh, kx], axis=0)  # [Hp+X, 3Hp]
+    bias_p = pad_cols(bias) if bias is not None else None
+    scale_p = pad_cols(ln_scale) if ln_scale is not None else None
+    lnb_p = pad_cols(ln_bias) if ln_bias is not None else None
+    return kernel_p, bias_p, scale_p, lnb_p, Hp
+
+
+def masked_layer_norm(
+    z: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    *,
+    eps: float,
+    hidden_size: int,
+    padded_size: int,
+) -> jnp.ndarray:
+    """LayerNorm over the REAL lanes of a gate-padded ``[.., 3·Hp]`` vector.
+
+    Padded pre-activation lanes are exactly 0 by the padding invariant, so
+    the mean needs no mask (sum over all lanes == sum over real lanes); the
+    variance masks explicitly because ``(0 − μ)²`` is not 0. Padded
+    scale/bias lanes are 0, so padded outputs stay exactly 0. Reduces to
+    ``fast_layer_norm`` semantics when ``padded_size == hidden_size``.
+    """
+    H, Hp = int(hidden_size), int(padded_size)
+    n_real = 3.0 * H
+    zf = z.astype(jnp.float32)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (3 * Hp,), 0)
+    mask = ((lane % Hp) < H).astype(jnp.float32)
+    mu = jnp.sum(zf, axis=-1, keepdims=True) / n_real
+    var = jnp.sum(jnp.square(zf - mu) * mask, axis=-1, keepdims=True) / n_real
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (zf - mu) * rstd
+    y = xhat * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(jnp.promote_types(z.dtype, scale.dtype))
+
+
+def hafner_cell_fused(
+    h: jnp.ndarray,
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    bias: Optional[jnp.ndarray],
+    ln_scale: Optional[jnp.ndarray],
+    ln_bias: Optional[jnp.ndarray],
+    *,
+    hidden_size: int,
+    eps: float = 1e-3,
+    pad_to: int = 1,
+) -> jnp.ndarray:
+    """One fused LayerNorm-GRU step on (possibly unpadded) real-width
+    inputs: pads parameters + hidden state, runs the padded cell, slices
+    the real lanes back out. With ``pad_to=1`` this is bitwise
+    ``reference.hafner_cell`` (same dot dims, same ``fast_layer_norm``)."""
+    H = int(hidden_size)
+    kernel, bias, ln_scale, ln_bias, Hp = pad_hafner_params(
+        kernel, bias, ln_scale, ln_bias, hidden_size=H, pad_to=pad_to
+    )
+    hp = pad_axis(h, -1, Hp)
+    new_h = hafner_cell_padded(
+        hp, x, kernel, bias, ln_scale, ln_bias, hidden_size=H, padded_size=Hp, eps=eps
+    )
+    return new_h if Hp == H else new_h[..., :H]
+
+
+def hafner_cell_padded(
+    h: jnp.ndarray,
+    x: jnp.ndarray,
+    kernel: jnp.ndarray,
+    bias: Optional[jnp.ndarray],
+    ln_scale: Optional[jnp.ndarray],
+    ln_bias: Optional[jnp.ndarray],
+    *,
+    hidden_size: int,
+    padded_size: int,
+    eps: float,
+) -> jnp.ndarray:
+    """The padded-layout cell body (also the `custom_vjp` backward program
+    for the Pallas tier: ``jax.vjp`` of this function IS the fused
+    kernel's gradient). All inputs already in the ``Hp`` layout."""
+    H, Hp = int(hidden_size), int(padded_size)
+    inp = jnp.concatenate([h, x], axis=-1)
+    z = reference.dense_apply(inp, kernel, bias)
+    if ln_scale is not None:
+        if Hp == H:
+            z = fast_layer_norm(z, ln_scale, ln_bias, float(eps)).astype(
+                jnp.promote_types(z.dtype, ln_scale.dtype)
+            )
+        else:
+            z = masked_layer_norm(
+                z, ln_scale, ln_bias, eps=float(eps), hidden_size=H, padded_size=Hp
+            )
+    return reference.hafner_gates(z, h)
+
+
+def hafner_sequence_fused(
+    h0: jnp.ndarray,
+    xs: jnp.ndarray,
+    kernel: jnp.ndarray,
+    bias: Optional[jnp.ndarray],
+    ln_scale: Optional[jnp.ndarray],
+    ln_bias: Optional[jnp.ndarray],
+    *,
+    hidden_size: int,
+    eps: float = 1e-3,
+    pad_to: int = 1,
+) -> jnp.ndarray:
+    """Whole-sequence LayerNorm-GRU: ``xs`` is ``[T, B, X]``, returns the
+    hidden trajectory ``[T, B, H]``. The input projection runs as ONE GEMM
+    outside the scan; only the ``[B, Hp]@[Hp, 3Hp]`` recurrent matmul and
+    the gate block stay serial."""
+    H = int(hidden_size)
+    kernel, bias, ln_scale, ln_bias, Hp = pad_hafner_params(
+        kernel, bias, ln_scale, ln_bias, hidden_size=H, pad_to=pad_to
+    )
+    kh, kx = kernel[:Hp], kernel[Hp:]
+    # hoisted input projection (+ bias, so the scan body adds nothing twice)
+    zx = reference.dense_apply(xs, kx, bias)  # [T, B, 3Hp]
+    hp = pad_axis(h0, -1, Hp)
+
+    def body(h, zx_t):
+        z = jax.lax.dot_general(h, kh, (((h.ndim - 1,), (0,)), ((), ()))) + zx_t
+        if ln_scale is not None:
+            z = masked_layer_norm(
+                z, ln_scale, ln_bias, eps=float(eps), hidden_size=H, padded_size=Hp
+            )
+        new_h = reference.hafner_gates(z, h)
+        return new_h, new_h
+
+    _, hs = jax.lax.scan(body, hp, zx)
+    return hs if Hp == H else hs[..., :H]
+
+
+def pad_flax_gru_params(params, *, hidden_size: int, pad_to: int):
+    """Pack the flax ``ir/iz/in | hr/hz/hn`` six-Dense tree into two padded
+    joint kernels: ``Wi [X, 3Hp]`` (+ joint input bias ``[3Hp]``) and
+    ``Wh [Hp, 3Hp]`` (+ the ``hn`` bias ``[Hp]``). Gate order r|z|n."""
+    H = int(hidden_size)
+    Hp = round_up(H, pad_to)
+
+    def padded(name):
+        k = pad_axis(params[name]["kernel"], -1, Hp)
+        b = params[name].get("bias")
+        return k, (pad_axis(b, -1, Hp) if b is not None else jnp.zeros((Hp,), k.dtype))
+
+    kir, bir = padded("ir")
+    kiz, biz = padded("iz")
+    kin, bin_ = padded("in")
+    khr, _ = padded("hr")
+    khz, _ = padded("hz")
+    khn, bhn = padded("hn")
+    wi = jnp.concatenate([kir, kiz, kin], axis=-1)
+    bi = jnp.concatenate([bir, biz, bin_], axis=-1)
+    wh = jnp.concatenate([pad_axis(k, 0, Hp) for k in (khr, khz, khn)], axis=-1)
+    return wi, bi, wh, bhn, Hp
+
+
+def flax_gru_cell_fused(
+    h: jnp.ndarray,
+    x: jnp.ndarray,
+    params,
+    *,
+    hidden_size: int,
+    pad_to: int = 1,
+) -> jnp.ndarray:
+    """Fused flax-GRU step: the six Denses collapse into one ``[B, X]@[X,
+    3Hp]`` input GEMM and one ``[B, Hp]@[Hp, 3Hp]`` recurrent GEMM, then
+    the gate block. Padded hidden lanes stay exactly 0 (``n = tanh(0 +
+    σ(0)·0) = 0`` and ``(1−z)·0 + z·0 = 0``). Numerically equivalent — not
+    bitwise — to the reference (different GEMM grouping); tolerance-tested.
+    """
+    H = int(hidden_size)
+    wi, bi, wh, bhn, Hp = pad_flax_gru_params(params, hidden_size=H, pad_to=pad_to)
+    hp = pad_axis(h, -1, Hp)
+    zi = reference.dense_apply(x, wi, bi)
+    zh = reference.dense_apply(hp, wh, None)
+    r = jax.nn.sigmoid(zi[..., :Hp] + zh[..., :Hp])
+    z = jax.nn.sigmoid(zi[..., Hp : 2 * Hp] + zh[..., Hp : 2 * Hp])
+    n = jnp.tanh(zi[..., 2 * Hp :] + r * (zh[..., 2 * Hp :] + bhn))
+    new_h = (1.0 - z) * n + z * hp
+    return new_h if Hp == H else new_h[..., :H]

@@ -2,6 +2,7 @@ from sheeprl_tpu.models.models import (
     CNN,
     MLP,
     DeCNN,
+    FusedGRUCell,
     LayerNormGRUCell,
     MultiDecoder,
     MultiEncoder,
@@ -13,6 +14,7 @@ __all__ = [
     "CNN",
     "MLP",
     "DeCNN",
+    "FusedGRUCell",
     "LayerNormGRUCell",
     "MultiDecoder",
     "MultiEncoder",

@@ -27,6 +27,11 @@ import numpy as np
 
 from sheeprl_tpu.models.norm import FastLayerNorm
 
+# the fused-kernel registry (sheeprl_tpu/kernels, howto/kernels.md): the
+# recurrent cells below dispatch their gate math through it so one
+# `algo.fused_kernels` knob swaps reference / padded-XLA / Pallas tiers
+from sheeprl_tpu import kernels
+
 # ---------------------------------------------------------------------------
 # activation resolution (accepts jax-style names and torch-style class paths,
 # so reference config trees run unchanged)
@@ -317,23 +322,77 @@ class LayerNormGRUCell(nn.Module):
     norm_eps: float = 1e-3
     param_dtype: Any = jnp.float32
     dtype: Optional[Any] = None
+    #: resolved kernel tier ("off" | "xla" | "pallas") — set at agent-build
+    #: time via kernels.resolve_tier(cfg.algo.fused_kernels); "off" is the
+    #: reference flax path, bitwise the pre-registry cell
+    fused: str = "off"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
-        inp = jnp.concatenate([h, x], axis=-1)
-        z = nn.Dense(
-            3 * self.hidden_size, use_bias=self.bias, param_dtype=self.param_dtype, dtype=self.dtype
-        )(inp)
-        if self.layer_norm:
-            z = FastLayerNorm(
-                epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype,
-                name="LayerNorm_0",
-            )(z)
-        reset, cand, update = jnp.split(z, 3, axis=-1)
-        reset = jax.nn.sigmoid(reset)
-        cand = jnp.tanh(reset * cand)
-        update = jax.nn.sigmoid(update - 1)
-        return update * cand + (1 - update) * h
+        if self.fused == "off" or self.is_initializing():
+            # reference path (also the init path, so parameter names/shapes
+            # never depend on the tier): gate math lives in kernels/reference
+            inp = jnp.concatenate([h, x], axis=-1)
+            z = nn.Dense(
+                3 * self.hidden_size, use_bias=self.bias, param_dtype=self.param_dtype,
+                dtype=self.dtype,
+            )(inp)
+            if self.layer_norm:
+                z = FastLayerNorm(
+                    epsilon=self.norm_eps, param_dtype=self.param_dtype, dtype=self.dtype,
+                    name="LayerNorm_0",
+                )(z)
+            return kernels.reference.hafner_gates(z, h)
+        params = self.variables["params"]
+        dense = params["Dense_0"]
+        ln = params.get("LayerNorm_0") if self.layer_norm else None
+        return kernels.hafner_gru_cell(
+            h,
+            x,
+            dense["kernel"],
+            dense.get("bias") if self.bias else None,
+            ln["scale"] if ln is not None else None,
+            ln["bias"] if ln is not None else None,
+            hidden_size=self.hidden_size,
+            eps=float(self.norm_eps),
+            tier=self.fused,
+        )
+
+
+class FusedGRUCell(nn.Module):
+    """flax ``nn.GRUCell`` with its gate math routed through the kernel
+    registry (DreamerV1's recurrent core). Parameter tree, initializers and
+    the ``(carry, inputs) -> (new_carry, out)`` signature are identical to
+    ``nn.GRUCell`` — ``fused="off"`` is bitwise the flax module, so swapping
+    it in changes no checkpoint and no result.
+    """
+
+    features: int
+    param_dtype: Any = jnp.float32
+    fused: str = "off"
+
+    @nn.compact
+    def __call__(self, carry: jnp.ndarray, inputs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        h = carry
+        if self.fused == "off" or self.is_initializing():
+            def dense_i(name):
+                return nn.Dense(self.features, use_bias=True, param_dtype=self.param_dtype, name=name)
+
+            def dense_h(name, use_bias=False):
+                return nn.Dense(
+                    self.features, use_bias=use_bias, param_dtype=self.param_dtype,
+                    kernel_init=nn.initializers.orthogonal(), name=name,
+                )
+
+            new_h = kernels.reference.flax_gru_gates(
+                dense_i("ir")(inputs), dense_i("iz")(inputs), dense_i("in")(inputs),
+                dense_h("hr")(h), dense_h("hz")(h), dense_h("hn", use_bias=True)(h), h,
+            )
+        else:
+            new_h = kernels.flax_gru_cell(
+                h, inputs, self.variables["params"], hidden_size=self.features, tier=self.fused
+            )
+        return new_h, new_h
 
 
 # ---------------------------------------------------------------------------

@@ -40,6 +40,7 @@ __all__ = [
     "add_env_degraded",
     "add_env_worker_restart",
     "add_h2d_bytes",
+    "add_kernel_tier_degraded",
     "add_plane_player_restart",
     "add_plane_slabs",
     "add_prefetch",
@@ -135,6 +136,10 @@ class Counters:
         self.params_bytes_per_device = 0
         self.opt_state_bytes_per_device = 0
         self.model_axis_size = 1
+        # fused-kernel subsystem (sheeprl_tpu/kernels): times a requested
+        # tier was auto-degraded at agent-build time (pallas on a non-TPU
+        # backend, or a family with no pallas kernel yet)
+        self.kernel_tier_degraded = 0
 
     def add(self, field: str, amount) -> None:
         with self._lock:
@@ -190,6 +195,7 @@ class Counters:
                 "params_bytes_per_device": self.params_bytes_per_device,
                 "opt_state_bytes_per_device": self.opt_state_bytes_per_device,
                 "model_axis_size": self.model_axis_size,
+                "kernel_tier_degraded": self.kernel_tier_degraded,
                 "comms_ops": self.comms_ops,
                 "comms_bytes": self.comms_bytes,
                 "comms_ms": round(self.comms_ms, 3),
@@ -393,6 +399,14 @@ def add_plane_player_restart(n: int = 1) -> None:
     if c is not None:
         with c._lock:
             c.plane_player_restarts += int(n)
+
+
+def add_kernel_tier_degraded(n: int = 1) -> None:
+    """Record ``n`` fused-kernel tier auto-degrades (kernels/registry.py)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.kernel_tier_degraded += int(n)
 
 
 # -- checkpoint accounting --------------------------------------------------

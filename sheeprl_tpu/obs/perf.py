@@ -106,6 +106,37 @@ def shape_specs(tree: Any) -> Any:
     return jax.tree_util.tree_map(spec, tree)
 
 
+def _reference_twin(jit_fn):
+    """A reference-tier twin of ``jit_fn`` for cost analysis, or None.
+
+    When a fused recurrent-core tier is active (``sheeprl_tpu/kernels``) the
+    train program may contain Pallas custom calls, which XLA's cost model
+    scores as zero FLOPs, or padded-lane matmuls (600→640), which it scores
+    as *more* FLOPs than the model actually defines. Either way the
+    registered cost — and with it MFU and the roofline numerators — would
+    change with the kernel tier. Model FLOPs are a property of the model,
+    not of the kernel strategy (the PaLM-MFU convention), so when a fused
+    tier is active we lower a twin program instead: a fresh ``jax.jit`` of
+    the wrapped python body, traced under
+    :func:`~sheeprl_tpu.kernels.reference_cost_mode` so the kernel
+    dispatchers take the reference path at trace time.
+    """
+    from sheeprl_tpu import kernels
+
+    if not kernels.fused_active():
+        return None
+    raw = getattr(jit_fn, "__wrapped__", None)
+    if raw is None:
+        return None
+    import jax
+
+    def _ref(*args):
+        with kernels.reference_cost_mode():
+            return raw(*args)
+
+    return jax.jit(_ref)
+
+
 def register_train_cost(
     telemetry, jit_fn, *specs, world_size: int = 1, dispatches_per_step: int = 1
 ) -> None:
@@ -128,7 +159,14 @@ def register_train_cost(
         return
     from sheeprl_tpu.obs.prof.roofline import cost_of
 
-    cost = cost_of(jit_fn, *specs)
+    cost = None
+    ref_fn = _reference_twin(jit_fn)
+    if ref_fn is not None:
+        cost = cost_of(ref_fn, *specs)
+    if not (cost and cost.get("flops")):
+        # no fused tier active, or the twin couldn't lower (e.g. the train
+        # callable isn't a plain jit wrapper): fall back to the program as-is
+        cost = cost_of(jit_fn, *specs)
     ws = max(int(world_size), 1)
     dps = max(int(dispatches_per_step), 1)
     if cost and cost.get("flops"):

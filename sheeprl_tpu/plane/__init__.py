@@ -31,7 +31,12 @@ required to route through this package by ``tools/lint_plane.py``.
 """
 
 from sheeprl_tpu.plane.local import BurstPayload, LocalBurstQueue, LocalPlayerHandle
-from sheeprl_tpu.plane.protocol import burst_plan, required_version, version_after
+from sheeprl_tpu.plane.protocol import (
+    burst_plan,
+    required_version,
+    train_gated_burst_plan,
+    version_after,
+)
 from sheeprl_tpu.plane.publish import (
     LocalPolicyChannel,
     PolicyPoller,
@@ -66,6 +71,7 @@ __all__ = [
     "TrajSlabRing",
     "build_plane",
     "burst_plan",
+    "train_gated_burst_plan",
     "plane_env_split",
     "policy_path",
     "required_version",

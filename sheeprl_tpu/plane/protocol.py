@@ -31,7 +31,7 @@ from __future__ import annotations
 
 from typing import Tuple
 
-__all__ = ["burst_plan", "required_version", "version_after"]
+__all__ = ["burst_plan", "required_version", "train_gated_burst_plan", "version_after"]
 
 
 def burst_plan(
@@ -44,6 +44,32 @@ def burst_plan(
     random_phase = first <= learning_starts
     boundary = min(learning_starts, num_updates) if random_phase else num_updates
     return max(min(int(act_burst), boundary - first + 1), 1), random_phase
+
+
+def train_gated_burst_plan(
+    first: int,
+    act_burst: int,
+    learning_starts: int,
+    num_updates: int,
+    updates_before_training: int,
+    resuming: bool = False,
+) -> Tuple[int, bool]:
+    """``(n_act, random_phase)`` for the coupled loops that gate training on a
+    ``train_every`` countdown (the Dreamer families) rather than training every
+    update like SAC.
+
+    The countdown decrements once per collected update, so the first update at
+    which training would fire is ``max(first, learning_starts,
+    first + updates_before_training - 1)`` — the burst may run *through* that
+    update but never past it, which keeps the set of train-firing update
+    indices identical to the per-step loop for every K. The random prefill
+    phase (skipped on resume, matching the per-step condition) acts one step
+    at a time: actions come from ``envs.action_space.sample()`` on the host,
+    so there is no dispatch to amortize."""
+    if first <= learning_starts and not resuming:
+        return 1, True
+    u_train = max(first, learning_starts, first + int(updates_before_training) - 1)
+    return max(min(int(act_burst), u_train - first + 1, num_updates - first + 1), 1), False
 
 
 def version_after(last: int, first_train_update: int) -> int:

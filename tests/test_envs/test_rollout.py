@@ -456,3 +456,88 @@ def test_ppo_recurrent_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
     cli.run(_onpolicy_burst_args(tmp_path, "ppo_recurrent", "rk1", common))
     cli.run(_onpolicy_burst_args(tmp_path, "ppo_recurrent", "rk4", common + ["env.act_burst=4"]))
     _assert_ckpt_bitwise(tmp_path, "rk1", "rk4", written=8)
+
+
+def _dreamer_burst_args(tmp_path, algo, run_name, extra=()):
+    args = [
+        f"exp={algo}",
+        "dry_run=False",
+        "total_steps=32",
+        "fabric.devices=1",
+        "fabric.accelerator=cpu",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "env.num_envs=2",
+        "per_rank_batch_size=2",
+        "per_rank_sequence_length=4",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.per_rank_gradient_steps=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4",
+        "algo.learning_starts=12",
+        "algo.train_every=8",
+        "cnn_keys.encoder=[rgb]",
+        "buffer.size=16",
+        "buffer.memmap=False",
+        # the prefetch worker samples burst k+1 while collection is still
+        # adding rows — scheduling-dependent by design (data/staging.py); a
+        # bitwise K-invariance gate needs the synchronous sampling path
+        "buffer.prefetch=False",
+        "buffer.checkpoint=True",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "metric.log_level=0",
+        "algo.run_test=False",
+        f"root_dir={tmp_path}/logs",
+        f"run_name={run_name}",
+    ]
+    if algo == "dreamer_v2":
+        args += ["algo.world_model.discrete_size=4", "algo.per_rank_pretrain_steps=1"]
+    return args + list(extra)
+
+
+def test_dreamer_v1_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """DreamerV1 equivalence with training ON: the RSSM player state rides
+    the burst carry (host-side (1-mask) episode resets), the act key stream
+    threads through the scanned burst, and the train_every countdown clamps
+    bursts at train boundaries — so act_burst=4 reproduces the per-step run
+    bitwise end-to-end (params, opt state, replay rows)."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    cli.run(_dreamer_burst_args(tmp_path, "dreamer_v1", "dk1"))
+    cli.run(_dreamer_burst_args(tmp_path, "dreamer_v1", "dk4", ["env.act_burst=4"]))
+    _assert_ckpt_bitwise(tmp_path, "dk1", "dk4", written=8)
+
+
+def test_dreamer_v2_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """DreamerV2 equivalence with training ON, including the is_first row
+    bookkeeping and the pretrain-at-learning-starts gate under bursts."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    cli.run(_dreamer_burst_args(tmp_path, "dreamer_v2", "dk1"))
+    cli.run(_dreamer_burst_args(tmp_path, "dreamer_v2", "dk4", ["env.act_burst=4"]))
+    _assert_ckpt_bitwise(tmp_path, "dk1", "dk4", written=8)
+
+
+def test_dreamer_v2_fused_xla_bitwise_off_e2e(tmp_path, monkeypatch):
+    """The fused-kernel knob (ISSUE 13) must not change a single bit of a
+    DV2 run on CPU: ``algo.fused_kernels=xla`` resolves to ``pad_to=1``
+    there, whose op sequence is bitwise the reference cell — so the trained
+    params, opt state, and replay rows of a fused run must equal the
+    default (``off``) run's exactly. This is the e2e teeth behind the
+    unit-level ``test_xla_cell_pad1_bitwise_reference``."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    cli.run(_dreamer_burst_args(tmp_path, "dreamer_v2", "foff"))
+    cli.run(_dreamer_burst_args(tmp_path, "dreamer_v2", "fxla", ["algo.fused_kernels=xla"]))
+    _assert_ckpt_bitwise(tmp_path, "foff", "fxla", written=8)

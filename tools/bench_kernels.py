@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Fused-kernel micro-benchmark: the LayerNorm-GRU sequence tiers vs the
+reference cell under ``lax.scan`` (ISSUE-13 acceptance: >= 1.2x forward+
+backward on at least one tier at the DV2 shape).
+
+Apples to apples: identical parameters, identical loss (``sum(tanh(hs))``),
+forward + full backward (gradients w.r.t. h0, xs, and all parameters) —
+the shape the world-model gradient pays, at the DV2 production widths
+``H=600`` (straddling the 128-lane tile), ``X=400``, ``B=16``, ``T=50``.
+
+- **reference**: ``kernels.reference.hafner_cell`` scanned per step — one
+  ``[B, H+X] @ [H+X, 3H]`` GEMM inside every serial iteration (the tier-1
+  flax path the modules run at ``fused_kernels=off``).
+- **xla tier**: ``kernels.xla.hafner_sequence_fused`` at the pad the
+  registry would resolve on this backend (1 on CPU, 128 on TPU) — the
+  input projection hoisted out of the scan into a single ``[T*B, X]``
+  GEMM, only the ``[B, Hp] @ [Hp, 3Hp]`` recurrent matmul left serial.
+- **pallas tier**: the real Pallas kernel, benched only on TPU — interpret
+  mode is a correctness vehicle, not a performance tier, so on CPU the
+  line discloses ``pallas: null`` rather than timing the interpreter.
+
+Prints ONE JSON line (the bench.py tail contract). ``value`` is the best
+fused tier's cell-steps/s (unit ``steps/s`` — higher-better, so
+tools/bench_compare.py flags a fused-tier slowdown across rounds);
+``speedup_vs_reference`` is the acceptance ratio. ``model_gflops_per_s``
+prices the analytic ``registry.kernel_cost`` FLOPs (real widths, fwd +
+2x bwd), never the padded-lane work — consistent with the roofline/MFU
+accounting.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+B, T, H, X = 16, 50, 600, 400
+REPEATS = 5
+
+
+def _operands(key):
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(key, 6)
+    h0 = jax.random.normal(ks[0], (B, H), jnp.float32)
+    xs = jax.random.normal(ks[1], (T, B, X), jnp.float32)
+    kernel = jax.random.normal(ks[2], (H + X, 3 * H), jnp.float32) * 0.05
+    bias = jax.random.normal(ks[3], (3 * H,), jnp.float32) * 0.05
+    ln_scale = 1.0 + 0.05 * jax.random.normal(ks[4], (3 * H,), jnp.float32)
+    ln_bias = 0.05 * jax.random.normal(ks[5], (3 * H,), jnp.float32)
+    return h0, xs, kernel, bias, ln_scale, ln_bias
+
+
+def _timed_interleaved(contenders, args):
+    """Median seconds per call over REPEATS rounds, all contenders timed
+    once per ROUND (interleaved, not back to back): host-load drift over
+    the bench's lifetime then lands on every contender equally instead of
+    biasing whichever ran while the machine was busy. First call of each
+    compiles (discarded)."""
+    import jax
+
+    for fn in contenders.values():
+        jax.block_until_ready(fn(*args))
+    runs = {name: [] for name in contenders}
+    for _ in range(REPEATS):
+        for name, fn in contenders.items():
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.block_until_ready(out)
+            runs[name].append(time.perf_counter() - t0)
+    return {name: statistics.median(r) for name, r in runs.items()}
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.kernels import reference, registry, xla
+
+    args = _operands(jax.random.PRNGKey(0))
+    argnums = tuple(range(len(args)))
+
+    def loss_reference(h0, xs, kernel, bias, ln_scale, ln_bias):
+        def body(h, x_t):
+            nh = reference.hafner_cell(h, x_t, kernel, bias, ln_scale, ln_bias, eps=1e-3)
+            return nh, nh
+
+        _, hs = jax.lax.scan(body, h0, xs)
+        return jnp.sum(jnp.tanh(hs))
+
+    pad_to = registry.default_pad_to("xla")
+
+    def loss_xla(h0, xs, kernel, bias, ln_scale, ln_bias):
+        hs = xla.hafner_sequence_fused(
+            h0, xs, kernel, bias, ln_scale, ln_bias, hidden_size=H, eps=1e-3, pad_to=pad_to
+        )
+        return jnp.sum(jnp.tanh(hs))
+
+    contenders = {
+        "reference": jax.jit(jax.value_and_grad(loss_reference, argnums=argnums)),
+        "xla": jax.jit(jax.value_and_grad(loss_xla, argnums=argnums)),
+    }
+    if jax.default_backend() == "tpu":
+        from sheeprl_tpu.kernels import pallas_tpu
+
+        def loss_pallas(h0, xs, kernel, bias, ln_scale, ln_bias):
+            hs = pallas_tpu.hafner_sequence(
+                h0, xs, kernel, bias, ln_scale, ln_bias, hidden_size=H, eps=1e-3
+            )
+            return jnp.sum(jnp.tanh(hs))
+
+        contenders["pallas"] = jax.jit(jax.value_and_grad(loss_pallas, argnums=argnums))
+
+    timings = _timed_interleaved(contenders, args)
+    ref_s = timings.pop("reference")
+    tiers = timings
+
+    best_tier = min(tiers, key=tiers.get)
+    best_s = tiers[best_tier]
+    cell_steps = B * T
+    # fwd + ~2x bwd of the analytic reference cost (real widths, never padded)
+    model_flops = 3.0 * registry.kernel_cost(
+        "hafner_ln_gru", batch=B, hidden_size=H, input_size=X, seq_len=T
+    )["flops"]
+    line = {
+        "metric": "hafner_ln_gru_seq_fwd_bwd_sps",
+        "value": round(cell_steps / best_s, 1),
+        "unit": "steps/s",
+        "tier": best_tier,
+        "pad_to": pad_to,
+        "seconds_per_call": {
+            "reference": round(ref_s, 5),
+            **{k: round(v, 5) for k, v in tiers.items()},
+            "pallas": round(tiers["pallas"], 5) if "pallas" in tiers else None,
+        },
+        "speedup_vs_reference": round(ref_s / best_s, 3),
+        "model_gflops_per_s": round(model_flops / best_s / 1e9, 2),
+        "shape": {"B": B, "T": T, "H": H, "X": X},
+        "backend": jax.default_backend(),
+        "protocol": (
+            f"forward+backward (value_and_grad over h0/xs/params, loss "
+            f"sum(tanh(hs))) of the LayerNorm-GRU at the DV2 shape B={B} "
+            f"T={T} H={H} X={X}: reference.hafner_cell under lax.scan vs "
+            f"xla.hafner_sequence_fused (hoisted input GEMM, pad_to={pad_to})"
+            + (" and the Pallas sequence kernel" if "pallas" in tiers else
+               "; pallas not timed on this backend (interpret mode is a "
+               "correctness vehicle, not a performance tier)")
+            + f"; per-tier median over {REPEATS} interleaved rounds after "
+            "one compile warm-up each; "
+            "ISSUE-13 acceptance: speedup_vs_reference >= 1.2 on >= 1 tier"
+        ),
+    }
+    print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Kernel-uniformity lint: GRU gate math lives ONLY in the kernel registry.
+
+The fused-kernel subsystem (``sheeprl_tpu/kernels``, howto/kernels.md)
+keeps one reference implementation of each recurrent gate block next to
+its fused tiers, with a parity suite pinning them together. That contract
+dies the day an algo or model open-codes the gate math again: the copy
+drifts, the parity suite doesn't cover it, and ``fused_kernels`` silently
+stops meaning "same math, faster schedule".
+
+This lint flags, in any ``sheeprl_tpu/algos/`` or ``sheeprl_tpu/models/``
+function, the open-coded GRU gate signature — BOTH activation families
+(``sigmoid`` and ``tanh``) next to a 3-way gate split of the joint
+projection (``jnp.split(z, 3, ...)``, or three-plus slice-subscripts of
+one array — the padded-layout spelling). ``sigmoid`` or ``tanh`` alone is
+everywhere legitimate (continue predictors, reward clipping, activation
+registries) and never trips. It also flags direct ``nn.GRUCell``
+construction — ``models.FusedGRUCell`` is the parameter-compatible,
+registry-dispatching replacement.
+
+The reference gate blocks themselves live in ``sheeprl_tpu/kernels/``
+(outside the linted trees); the flax modules call them through
+``kernels.reference`` / the registry dispatchers, which is the point.
+
+AST-based; comments/docstrings are fine. Usage: ``python
+tools/lint_kernels.py`` — non-zero exit with findings on violation. Wired
+into the CI tier-1 lane (.github/workflows/tests.yml).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINTED_DIRS = (
+    os.path.join(REPO, "sheeprl_tpu", "algos"),
+    os.path.join(REPO, "sheeprl_tpu", "models"),
+)
+
+_SIGMOID = {"sigmoid", "hard_sigmoid", "log_sigmoid"}
+_TANH = {"tanh"}
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _is_three_way_split(call: ast.Call) -> bool:
+    """``split(z, 3, ...)`` / ``split(z, indices_or_sections=3)``."""
+    if _call_name(call) != "split":
+        return False
+    candidates = list(call.args[1:2]) + [
+        kw.value for kw in call.keywords if kw.arg == "indices_or_sections"
+    ]
+    return any(
+        isinstance(c, ast.Constant) and c.value == 3 for c in candidates
+    )
+
+
+def _sliced_names(node: ast.AST):
+    """Names subscripted with a slice (``z[..., :H]`` spellings)."""
+    if isinstance(node, ast.Subscript) and isinstance(node.value, ast.Name):
+        sl = node.slice
+        has_slice = isinstance(sl, ast.Slice) or (
+            isinstance(sl, ast.Tuple) and any(isinstance(e, ast.Slice) for e in sl.elts)
+        )
+        if has_slice:
+            yield node.value.id
+
+
+def _is_gru_cell_ctor(call: ast.Call) -> bool:
+    """Direct flax ``nn.GRUCell(...)`` construction (FusedGRUCell exists)."""
+    return _call_name(call) == "GRUCell"
+
+
+def _function_findings(func: ast.AST) -> list:
+    sigmoids, tanhs, splits, ctors = [], [], [], []
+    slice_counts: dict = {}
+    for sub in ast.walk(func):
+        if isinstance(sub, ast.Call):
+            name = _call_name(sub)
+            if name in _SIGMOID:
+                sigmoids.append(sub.lineno)
+            elif name in _TANH:
+                tanhs.append(sub.lineno)
+            if _is_three_way_split(sub):
+                splits.append(sub.lineno)
+            if _is_gru_cell_ctor(sub):
+                ctors.append(sub.lineno)
+        for name in _sliced_names(sub):
+            slice_counts[name] = slice_counts.get(name, 0) + 1
+    findings = [
+        (
+            line,
+            "direct nn.GRUCell construction — use models.FusedGRUCell "
+            "(parameter-compatible; gate math dispatched through "
+            "sheeprl_tpu/kernels)",
+        )
+        for line in ctors
+    ]
+    gate_split = bool(splits) or any(n >= 3 for n in slice_counts.values())
+    if sigmoids and tanhs and gate_split:
+        findings.append(
+            (
+                min(sigmoids + tanhs + splits),
+                "open-coded GRU gate math (sigmoid + tanh around a 3-way "
+                "gate split) — the gate block belongs in sheeprl_tpu/kernels"
+                "/reference.py, dispatched through the registry "
+                "(howto/kernels.md)",
+            )
+        )
+    return findings
+
+
+def lint_file(path: str) -> list:
+    tree = ast.parse(open(path).read(), filename=path)
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_function_findings(node))
+    return findings
+
+
+def main() -> int:
+    violations = []
+    checked = 0
+    for base in LINTED_DIRS:
+        for root, _dirs, files in os.walk(base):
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(root, fname)
+                rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+                checked += 1
+                violations.extend(
+                    (rel, line, msg) for line, msg in lint_file(path)
+                )
+    if violations:
+        print("kernel-uniformity lint FAILED:")
+        for rel, line, msg in violations:
+            print(f"  {rel}:{line}: {msg}")
+        return 1
+    print(f"kernel-uniformity lint OK ({checked} files, gate math only in the registry)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

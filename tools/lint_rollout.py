@@ -48,8 +48,6 @@ ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
 #: masking still host-side). Keep in sync with howto/rollout_engine.md's
 #: support matrix.
 GRANDFATHERED = {
-    "dreamer_v1/dreamer_v1.py",
-    "dreamer_v2/dreamer_v2.py",
     "dreamer_v3/dreamer_v3.py",
     "p2e_dv1/p2e_dv1_exploration.py",
     "p2e_dv1/p2e_dv1_finetuning.py",
