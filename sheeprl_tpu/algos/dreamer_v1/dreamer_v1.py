@@ -25,7 +25,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.dreamer_v1.agent import (
     Actor,
@@ -52,20 +51,13 @@ from sheeprl_tpu.distributions import Bernoulli, Independent, Normal
 from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.plane import train_gated_burst_plan
+from sheeprl_tpu.train import build_train_burst, metric_fetch_gate, run_train_burst
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import (
-    get_telemetry,
-    log_sps_metrics,
-    profile_tick,
-    register_train_cost,
-    shape_specs,
-    span,
-)
+from sheeprl_tpu.obs import log_sps_metrics, profile_tick, span
 from sheeprl_tpu.obs.dist import pmean
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
-from sheeprl_tpu.utils.jax_compat import shard_map
 
 sg = jax.lax.stop_gradient
 
@@ -84,7 +76,9 @@ def build_train_fn(
 ):
     """Compile one full DreamerV1 gradient step as a single SPMD program.
 
-    Returns ``train_step(agent_state, data, key) -> (agent_state, metrics)``.
+    Returns a :class:`~sheeprl_tpu.train.TrainProgram`: callable as
+    ``train_step(agent_state, data, key) -> (agent_state, metrics)``, with
+    ``.burst`` running a staged ``[n_samples, ...]`` block as ONE dispatch.
     """
     axis = fabric.data_axis
     cnn_keys = tuple(cfg.cnn_keys.encoder)
@@ -316,14 +310,8 @@ def build_train_fn(
         }
         return new_state, metrics
 
-    shmapped = shard_map(
-        local_step,
-        mesh=fabric.mesh,
-        in_specs=(P(), P(None, axis), P()),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    return jax.jit(shmapped, donate_argnums=(0,))
+    # step + fused-burst programs (scanned per-step input: key)
+    return build_train_burst(local_step, fabric, n_scanned=1)
 
 
 def build_optimizers_and_state(cfg, params):
@@ -677,39 +665,44 @@ def main(fabric, cfg: Dict[str, Any]):
 
         if last >= learning_starts and updates_before_training <= 0:
             n_samples = cfg.algo.per_rank_gradient_steps
-            local_data = staging.sample_device(
-                cfg.per_rank_batch_size * world_size,
-                sequence_length=cfg.per_rank_sequence_length,
-                n_samples=n_samples,
-            )
-            telemetry = get_telemetry()
-            train_specs = None
-            with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
-                metrics = None
-                for i in range(n_samples):
-                    # device-side slice of the staged burst — a [L, B, ...]
-                    # view batch-sharded over the data axis; no per-gradient-
-                    # step host→HBM upload
-                    batch = {k: v[i] for k, v in local_data.items()}
-                    root_key, train_key = jax.random.split(root_key)
-                    if train_specs is None and telemetry is not None and telemetry.needs_train_flops():
-                        # specs captured pre-call: the step donates agent_state
-                        train_specs = shape_specs((agent_state, batch, train_key))
-                    agent_state, metrics = train_fn(agent_state, batch, train_key)
-                    per_rank_gradient_steps += 1
-                if metrics is not None:
-                    metrics = jax.device_get(metrics)
-                play_wm = wm_mirror(agent_state["params"]["world_model"])
-                play_actor = actor_mirror(agent_state["params"]["actor"])
-                train_step += world_size
-            if train_specs is not None:
-                # the counter advances by world_size per block of
-                # per_rank_gradient_steps single-step dispatches
-                register_train_cost(
-                    telemetry, train_fn, *train_specs,
-                    world_size=world_size,
-                    dispatches_per_step=cfg.algo.per_rank_gradient_steps,
+            metrics = None
+            if n_samples > 0:
+                local_data = staging.sample_device(
+                    cfg.per_rank_batch_size * world_size,
+                    sequence_length=cfg.per_rank_sequence_length,
+                    n_samples=n_samples,
                 )
+                # metrics are pulled at most once per burst behind the
+                # shared fetch gate (sheeprl_tpu/train)
+                fetch_metrics = metric_fetch_gate(
+                    cfg,
+                    aggregator,
+                    policy_step=policy_step,
+                    last_log=last_log,
+                    train_step=train_step,
+                    update=last,
+                    num_updates=num_updates,
+                    policy_steps_per_update=policy_steps_per_update,
+                    world_size=world_size,
+                )
+                with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
+                    # the whole burst (n_samples gradient steps) is ONE
+                    # scanned dispatch (sheeprl_tpu/train): per-call overhead
+                    # on a remote-attached device would otherwise repeat per
+                    # gradient step
+                    root_key, train_key = jax.random.split(root_key)
+                    agent_state, metrics, _ = run_train_burst(
+                        train_fn,
+                        agent_state,
+                        local_data,
+                        (jax.random.split(train_key, n_samples),),
+                        world_size=world_size,
+                        fetch_metrics=fetch_metrics,
+                    )
+                    per_rank_gradient_steps += n_samples
+                    play_wm = wm_mirror(agent_state["params"]["world_model"])
+                    play_actor = actor_mirror(agent_state["params"]["actor"])
+                    train_step += world_size
             updates_before_training = cfg.algo.train_every // policy_steps_per_update
             if cfg.algo.actor.expl_decay:
                 expl_decay_steps += 1

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import math
 import re
+from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import flax.linen as nn
@@ -1334,9 +1335,18 @@ def build_player_fns(
             tree = unravel_packed(packed)
             return reset_states(tree["wm"], state, reset_mask)
 
+        @partial(jax.jit, static_argnums=(1,))
+        def init_states_packed(packed, n_envs: int):
+            # the burst-acting host callback applies episode resets as
+            # mask * fresh + (1 - mask) * state with a host copy of this
+            # fresh state, refreshed once per params version
+            tree = unravel_packed(packed)
+            return init_states(tree["wm"], n_envs)
+
         fns.update(
             exploration_action_packed=exploration_action_packed,
             greedy_action_packed=greedy_action_packed,
             reset_states_packed=reset_states_packed,
+            init_states_packed=init_states_packed,
         )
     return fns

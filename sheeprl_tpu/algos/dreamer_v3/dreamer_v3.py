@@ -35,7 +35,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.dreamer_v3.agent import (
     Actor,
@@ -60,25 +59,29 @@ from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.distributions import MSEDistribution, SymlogDistribution, TwoHotEncodingDistribution
+from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
+from sheeprl_tpu.plane import train_gated_burst_plan
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import (
     LoopProbe,
-    add_act_dispatches,
-    get_telemetry,
     log_sps_metrics,
     profile_tick,
-    register_train_cost,
     set_shard_footprint,
-    shape_specs,
     span,
 )
 from sheeprl_tpu.obs.dist import pmean
 from sheeprl_tpu.parallel.shard import measured_bytes_per_device
+from sheeprl_tpu.train import (
+    TrainProgram,
+    build_train_burst,
+    metric_fetch_gate,
+    run_train_burst,
+    tau_schedule,
+)
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
-from sheeprl_tpu.utils.jax_compat import shard_map
 
 sg = jax.lax.stop_gradient
 
@@ -448,86 +451,30 @@ def build_train_fn(
         }
         return new_state, metrics
 
-    if plan is None:
-        shmapped = shard_map(
-            local_step,
-            mesh=fabric.mesh,
-            in_specs=(P(), P(None, data_axis), P(), P()),
-            out_specs=(P(), P()),
-            check_vma=False,
-        )
-        step_fn = jax.jit(shmapped, donate_argnums=(0,))
-    else:
-        state_sh = plan.shardings()
-        rep = fabric.replicated
-        step_fn = jax.jit(
-            local_step,
-            in_shardings=(state_sh, fabric.sharding(None, data_axis), rep, rep),
-            out_shardings=(state_sh, rep),
-            donate_argnums=(0,),
-        )
-
-    # Burst variant: a whole training burst (n_samples gradient steps) as ONE
-    # program — a lax.scan over the stacked [n, T, B, ...] batches. On a
-    # remote-attached device every dispatch pays a per-call round trip that
-    # scales with the donated state's leaf count (~120 ms measured for this
-    # agent pytree over the tunnel); one scan dispatch per burst pays it once.
-    def local_burst(agent_state, data_stack, keys, taus):
+    def packed_play_params(state):
         from jax.flatten_util import ravel_pytree
 
-        def body(state, inp):
-            d, k, t = inp
-            return local_step(state, d, k, t)
-
-        state, metrics = jax.lax.scan(body, agent_state, (data_stack, keys, taus))
-        # the fresh acting params leave the program as ONE flat vector so the
-        # player's next dispatch marshals a single buffer (packed player fns)
-        packed = ravel_pytree(
+        # the fresh acting params leave the burst as ONE flat vector so the
+        # player's next dispatch marshals a single buffer (packed player fns);
+        # under a sharding plan they leave replicated, so the all-gather
+        # happens once per burst instead of at every acting dispatch
+        return ravel_pytree(
             {"wm": state["params"]["world_model"], "actor": state["params"]["actor"]}
         )[0]
-        # the aggregator consumed only the burst's last metrics already
-        return state, jax.tree_util.tree_map(lambda m: m[-1], metrics), packed
 
-    if plan is None:
-        burst_shmapped = shard_map(
-            local_burst,
-            mesh=fabric.mesh,
-            in_specs=(P(), P(None, None, data_axis), P(), P()),
-            out_specs=(P(), P(), P()),
-            check_vma=False,
-        )
-        burst_fn = jax.jit(burst_shmapped, donate_argnums=(0,))
-    else:
-        state_sh = plan.shardings()
-        rep = fabric.replicated
-        # the packed acting vector leaves replicated: the player consumes it
-        # whole (single-device burst acting / host mirror), so the all-gather
-        # happens once here instead of at every acting dispatch
-        burst_fn = jax.jit(
-            local_burst,
-            in_shardings=(state_sh, fabric.sharding(None, None, data_axis), rep, rep),
-            out_shardings=(state_sh, rep, rep),
-            donate_argnums=(0,),
-        )
-    return TrainProgram(step_fn, burst_fn)
-
-
-class TrainProgram:
-    """One-gradient-step program plus the fused whole-burst variant.
-
-    Callable like the plain step (existing tests/benches), with ``.burst``
-    for the scan-over-samples program the train loop uses.
-    """
-
-    def __init__(self, step_fn, burst_fn):
-        self._step = step_fn
-        self.burst = burst_fn
-
-    def __call__(self, *args, **kwargs):
-        return self._step(*args, **kwargs)
-
-    def lower(self, *args, **kwargs):
-        return self._step.lower(*args, **kwargs)
+    # step + fused-burst programs (scanned per-step inputs: key, tau). The
+    # burst pattern this file pioneered now lives in the shared engine: one
+    # dispatch per training burst, because on a remote-attached device every
+    # dispatch pays a per-call round trip that scales with the donated
+    # state's leaf count (~120 ms measured for this agent pytree over the
+    # tunnel).
+    return build_train_burst(
+        local_step,
+        fabric,
+        n_scanned=2,
+        plan=plan,
+        extra_outputs=packed_play_params,
+    )
 
 
 def build_optimizers_and_state(cfg, params):
@@ -830,96 +777,66 @@ def main(fabric, cfg: Dict[str, Any]):
         gc.set_threshold(100000, 50, 50)
 
     per_rank_gradient_steps = 0
-    expl_scalar = None
-    expl_scalar_val = None
     dumped_rows = 0
     _dump_digest = None
-    for update in range(start_step, num_updates + 1):
-        policy_step += n_envs
-        probe.mark()
+    # SHEEPRL_ACT_GREEDY=1 (diagnostic): act with the policy MODE instead of
+    # sampling — with a seeded env this makes the whole collection loop
+    # deterministic and comparable bit-for-bit against external eval tooling
+    act_greedy = bool(os.environ.get("SHEEPRL_ACT_GREEDY"))
+    dump_path = os.environ.get("SHEEPRL_ACT_DUMP")
 
+    # Burst acting (tier b, howto/rollout_engine.md): K env steps per device
+    # dispatch, K = env.act_burst; 1 reproduces the per-step path exactly.
+    # The RSSM player state rides the burst carry next to the observation
+    # (and the MineDojo validity masks when present); the host callback is
+    # the whole old loop body — env step, episode bookkeeping, buffer adds —
+    # and applies episode resets with the same mask * fresh + (1 - mask) *
+    # state arithmetic as player_fns["reset_states"], against a host copy of
+    # the fresh init state refreshed once per params version (unlike
+    # DV1/DV2's zeros, DV3's fresh state has a nonzero initial posterior
+    # that depends on the current world-model params).
+    act_burst = max(int(cfg.env.get("act_burst", 1) or 1), 1)
+    n_sub = len(actions_dim)
+    carry0 = {
+        "obs": obs,
+        "player": {k: np.asarray(v) for k, v in player_state.items()},
+    }
+    if is_minedojo:
+        carry0["masks"] = {k: np.asarray(o[k]) for k in mask_keys}
+    state_box = {
+        "carry": carry0,
+        "policy_step": policy_step,
+        "update": start_step,
+        "fresh": None,
+    }
+
+    def _fresh_player():
+        # host copy of init_states under the CURRENT acting params; the
+        # train block clears it whenever the params version advances
+        if state_box["fresh"] is None:
+            fresh = (
+                player_fns["init_states_packed"](play_packed, n_envs)
+                if use_packed_player
+                else player_fns["init_states"](play_wm, n_envs)
+            )
+            state_box["fresh"] = {k: np.asarray(v) for k, v in fresh.items()}
+        return state_box["fresh"]
+
+    def _host_step_core(actions, real_actions, player_np, key_data=None):
+        nonlocal dumped_rows, _dump_digest
+        cur_update = state_box["update"]
+        state_box["update"] += 1
+        state_box["policy_step"] += n_envs
+        probe.lap("act")
+        step_data["actions"] = actions.reshape(1, n_envs, -1).astype(np.float32)
+        rb.add(step_data)
+        probe.lap("rb_add")
         with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
-            if update <= learning_starts and cfg.checkpoint.resume_from is None:
-                real_actions = actions = np.array(envs.action_space.sample())
-                if not is_continuous:
-                    actions = np.concatenate(
-                        [
-                            np.eye(act_dim, dtype=np.float32)[act]
-                            for act, act_dim in zip(
-                                actions.reshape(len(actions_dim), -1), actions_dim
-                            )
-                        ],
-                        axis=-1,
-                    )
-            else:
-                masks = (
-                    {k: jnp.asarray(np.asarray(o[k])) for k in mask_keys}
-                    if is_minedojo
-                    else None
-                )
-                root_key, act_key = jax.random.split(root_key)
-                # SHEEPRL_ACT_GREEDY=1 (diagnostic): act with the policy MODE
-                # instead of sampling — with a seeded env this makes the whole
-                # collection loop deterministic and comparable bit-for-bit
-                # against external eval tooling
-                if os.environ.get("SHEEPRL_ACT_GREEDY"):
-                    if use_packed_player:
-                        actions_j, player_state = player_fns["greedy_action_packed"](
-                            play_packed, player_state, obs, act_key, masks=masks
-                        )
-                    else:
-                        actions_j, player_state = player_fns["greedy_action_raw"](
-                            play_wm, play_actor, player_state, obs, act_key, masks=masks
-                        )
-                # raw-obs variants: uint8 pixels cross the host→device link
-                # and are normalized inside the jit (one dispatch per step)
-                elif use_packed_player:
-                    if expl_scalar is None or expl_scalar_val != expl_amount:
-                        # device scalar cached: creating it eagerly per step
-                        # would be one extra program dispatch per env step
-                        expl_scalar = jnp.float32(expl_amount)
-                        expl_scalar_val = expl_amount
-                    actions_j, player_state = player_fns["exploration_action_packed"](
-                        play_packed,
-                        player_state,
-                        obs,
-                        act_key,
-                        expl_scalar,
-                        masks=masks,
-                    )
-                else:
-                    actions_j, player_state = player_fns["exploration_action_raw"](
-                        play_wm,
-                        play_actor,
-                        player_state,
-                        obs,
-                        act_key,
-                        jnp.float32(expl_amount),
-                        masks=masks,
-                    )
-                actions = np.concatenate([np.asarray(a) for a in actions_j], -1)
-                if is_continuous:
-                    real_actions = actions
-                else:
-                    real_actions = np.stack(
-                        [np.argmax(np.asarray(a), axis=-1) for a in actions_j], axis=-1
-                    )
-                # recurrent players pay one inference dispatch per env step —
-                # the counter makes that cost visible next to the burst-acting
-                # algos' rollout_bursts (envs/rollout; burst acting for
-                # stateful players is future work)
-                add_act_dispatches(1)
-
-            probe.lap("act")
-            step_data["actions"] = actions.reshape(1, n_envs, -1).astype(np.float32)
-            rb.add(step_data)
-            probe.lap("rb_add")
-
             o, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
-            dones = np.logical_or(terminated, truncated).astype(np.float32)
-            probe.lap("env_step")
+        dones = np.logical_or(terminated, truncated).astype(np.float32)
+        probe.lap("env_step")
 
         step_data["is_first"] = np.zeros_like(step_data["dones"])
         if "restart_on_exception" in infos:
@@ -941,7 +858,9 @@ def main(fabric, cfg: Dict[str, Any]):
                         aggregator.update("Rewards/rew_avg", ep_rew)
                     if aggregator and "Game/ep_len_avg" in aggregator:
                         aggregator.update("Game/ep_len_avg", ep_len)
-                    fabric.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+                    fabric.print(
+                        f"Rank-0: policy_step={state_box['policy_step']}, reward_env_{i}={ep_rew}"
+                    )
 
         # Save the real next observation: on autoreset steps the terminal
         # observation lives in final_obs (reference main :663-668)
@@ -956,9 +875,9 @@ def main(fabric, cfg: Dict[str, Any]):
                         if k in fo:
                             real_next_obs[k][idx] = np.asarray(fo[k])
 
-        obs = prepare_obs(next_obs_np, cnn_keys, mlp_keys, n_envs)
+        new_obs = prepare_obs(next_obs_np, cnn_keys, mlp_keys, n_envs)
         for k in obs_keys:
-            step_data[k] = obs[k][None]
+            step_data[k] = new_obs[k][None]
 
         rewards = np.asarray(rewards, np.float32).reshape(n_envs, 1)
         step_data["dones"] = dones.reshape(1, n_envs, 1)
@@ -970,9 +889,10 @@ def main(fabric, cfg: Dict[str, Any]):
         # tooling (random-prefill steps bind no act_key and are not dumped;
         # the window counts dumped rows, not loop iterations, so fresh runs
         # with a long prefill still capture their first 1000 policy steps)
-        dump_path = os.environ.get("SHEEPRL_ACT_DUMP")
-        acted_with_policy = update > learning_starts or cfg.checkpoint.resume_from is not None
-        if dump_path and acted_with_policy and dumped_rows < 1000:
+        acted_with_policy = (
+            cur_update > learning_starts or cfg.checkpoint.resume_from is not None
+        )
+        if dump_path and acted_with_policy and key_data is not None and dumped_rows < 1000:
             import pickle
 
             dumped_rows += 1
@@ -984,16 +904,14 @@ def main(fabric, cfg: Dict[str, Any]):
             with open(dump_path, "ab") as _f:
                 pickle.dump(
                     {
-                        "step": update,
+                        "step": cur_update,
                         "actions": np.asarray(actions),
-                        "act_key": np.asarray(jax.random.key_data(act_key)),
+                        "act_key": np.asarray(key_data),
                         "rewards": rewards.copy(),
                         "dones": dones.copy(),
-                        "rec_norm": float(
-                            np.linalg.norm(np.asarray(player_state["recurrent"]))
-                        ),
+                        "rec_norm": float(np.linalg.norm(player_np["recurrent"])),
                         "packed_digest": _dump_digest,
-                        **{k: np.asarray(obs[k]) for k in obs_keys},
+                        **{k: np.asarray(new_obs[k]) for k in obs_keys},
                     },
                     _f,
                 )
@@ -1018,122 +936,180 @@ def main(fabric, cfg: Dict[str, Any]):
             step_data["is_first"][:, dones_idxes] = 1.0
             reset_mask = np.zeros((n_envs, 1), np.float32)
             reset_mask[dones_idxes] = 1.0
+            # same arithmetic as player_fns["reset_states"], applied
+            # host-side against the cached fresh init state
+            fresh = _fresh_player()
+            keep = np.float32(1.0) - reset_mask
+            player_np = {
+                k: reset_mask * fresh[k] + keep * v for k, v in player_np.items()
+            }
+
+        carry = {"obs": new_obs, "player": player_np}
+        if is_minedojo:
+            carry["masks"] = {k: np.asarray(o[k]) for k in mask_keys}
+        state_box["carry"] = carry
+        probe.lap("bookkeeping")
+        return carry
+
+    def _host_env_step(*args):
+        actions_j = [np.asarray(a) for a in args[:n_sub]]
+        player_np = {
+            "actions": np.asarray(args[n_sub]),
+            "recurrent": np.asarray(args[n_sub + 1]),
+            "stochastic": np.asarray(args[n_sub + 2]),
+        }
+        key_data = np.asarray(args[n_sub + 3])
+        actions = np.concatenate(actions_j, -1)
+        if is_continuous:
+            real_actions = actions
+        else:
+            real_actions = np.stack([np.argmax(a, axis=-1) for a in actions_j], axis=-1)
+        return _host_step_core(actions, real_actions, player_np, key_data)
+
+    def _act_fn(p, carry, key):
+        # the key advances inside the jitted burst with the same split order
+        # the per-step loop used (carried key first, act key second), so the
+        # K=1 key stream is bitwise the per-step stream
+        key, act_key = jax.random.split(key)
+        masks = carry["masks"] if is_minedojo else None
+        player = carry["player"]
+        # raw-obs variants: uint8 pixels cross the host→device link and are
+        # normalized inside the jit; packed variants take all acting params
+        # as the ONE flat vector the train burst emits
+        if act_greedy:
             if use_packed_player:
-                player_state = player_fns["reset_states_packed"](
-                    play_packed, player_state, jnp.asarray(reset_mask)
+                actions_j, new_player = player_fns["greedy_action_packed"](
+                    p["packed"], player, carry["obs"], act_key, masks=masks
                 )
             else:
-                player_state = player_fns["reset_states"](
-                    play_wm, player_state, jnp.asarray(reset_mask)
+                actions_j, new_player = player_fns["greedy_action_raw"](
+                    p["wm"], p["actor"], player, carry["obs"], act_key, masks=masks
                 )
+        elif use_packed_player:
+            actions_j, new_player = player_fns["exploration_action_packed"](
+                p["packed"], player, carry["obs"], act_key, p["expl"], masks=masks
+            )
+        else:
+            actions_j, new_player = player_fns["exploration_action_raw"](
+                p["wm"], p["actor"], player, carry["obs"], act_key, p["expl"], masks=masks
+            )
+        cb_args = tuple(actions_j) + (
+            new_player["actions"],
+            new_player["recurrent"],
+            new_player["stochastic"],
+            jax.random.key_data(act_key),
+        )
+        return cb_args, key
 
-        probe.lap("bookkeeping")
-        updates_before_training -= 1
+    burst_actor = BurstActor(_act_fn, _host_env_step, state_box["carry"])
+
+    update = start_step
+    while update <= num_updates:
+        n_act, random_phase = train_gated_burst_plan(
+            update,
+            act_burst,
+            learning_starts,
+            num_updates,
+            updates_before_training,
+            resuming=cfg.checkpoint.resume_from is not None,
+        )
+        probe.mark()
+        if random_phase:
+            real_actions = actions = np.array(envs.action_space.sample())
+            if not is_continuous:
+                actions = np.concatenate(
+                    [
+                        np.eye(act_dim, dtype=np.float32)[act]
+                        for act, act_dim in zip(
+                            actions.reshape(len(actions_dim), -1), actions_dim
+                        )
+                    ],
+                    axis=-1,
+                )
+            _host_step_core(actions, real_actions, state_box["carry"]["player"])
+        else:
+            burst_params = (
+                {"packed": play_packed, "expl": jnp.float32(expl_amount)}
+                if use_packed_player
+                else {"wm": play_wm, "actor": play_actor, "expl": jnp.float32(expl_amount)}
+            )
+            with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+                _, root_key = burst_actor.rollout(
+                    burst_params, state_box["carry"], root_key, n_act
+                )
+            # the burst program commits its inputs to the player's device;
+            # pull the carried key back to host numpy (uncommitted) so the
+            # possibly multi-device train program keeps accepting it
+            root_key = np.asarray(root_key)
+        policy_step = state_box["policy_step"]
+
+        update += n_act
+        last = update - 1
+        updates_before_training -= n_act
 
         # Train the agent (reference main :719-765)
-        if update >= learning_starts and updates_before_training <= 0:
+        if last >= learning_starts and updates_before_training <= 0:
             n_samples = (
                 cfg.algo.per_rank_pretrain_steps
-                if update == learning_starts
+                if last == learning_starts
                 else cfg.algo.per_rank_gradient_steps
             )
-            if n_samples <= 0:
-                # a length-0 scan over the burst would fail at trace time;
-                # degrade to "no training this window" but keep the cadence
-                metrics = None
-            else:
+            metrics = None
+            if n_samples > 0:
                 local_data = staging.sample_device(
                     cfg.per_rank_batch_size * world_size,
                     sequence_length=cfg.per_rank_sequence_length,
                     n_samples=n_samples,
                 )
                 probe.lap("sample")
-                # On a bandwidth-limited host link every blocking device→host
-                # metric fetch costs a round trip; fetch_train_metrics_every=k
-                # samples the train metrics every k-th burst (always on the last
-                # burst before a log boundary), 1 = every burst (default),
-                # 0 = log boundaries only. Log boundaries are crossed by policy
-                # steps, not bursts, so look ahead one real burst period
-                # (bursts recur every max(train_every//update_steps,1) updates,
-                # NOT every train_every policy steps when the two don't divide):
-                # if the threshold falls before the next burst, this is the
-                # burst whose metrics that log will see.
-                burst_updates = max(int(cfg.algo.train_every) // policy_steps_per_update, 1)
-                burst_period = burst_updates * policy_steps_per_update
-                will_log = cfg.metric.log_level > 0 and (
-                    policy_step - last_log + burst_period >= cfg.metric.log_every
-                    # the run's last burst feeds the final update==num_updates log
-                    # even when that update itself is not a burst
-                    or update + burst_updates > num_updates
+                fetch_metrics = metric_fetch_gate(
+                    cfg,
+                    aggregator,
+                    policy_step=policy_step,
+                    last_log=last_log,
+                    train_step=train_step,
+                    update=last,
+                    num_updates=num_updates,
+                    policy_steps_per_update=policy_steps_per_update,
+                    world_size=world_size,
                 )
-                fetch_every = int(cfg.metric.get("fetch_train_metrics_every", 1))
-                fetch_metrics = (
-                    aggregator is not None
-                    and not aggregator.disabled
-                    and (
-                        will_log
-                        or (fetch_every > 0 and (train_step // world_size) % fetch_every == 0)
-                    )
+                # EMA targets: soft tau on the cadence, the run's very first
+                # gradient step hard-copies
+                taus = tau_schedule(
+                    n_samples,
+                    per_rank_gradient_steps,
+                    cfg.algo.critic.target_network_update_freq,
+                    tau=cfg.algo.critic.tau,
+                    first_hard=True,
                 )
-                # NOTE: when the metric fetch below is skipped, nothing in this
-                # block waits on the device — train_fn dispatch is async, so the
-                # timer records dispatch time and the device compute overlaps the
-                # next acting phase (that overlap is the point on a remote-
+                # NOTE: when the metric fetch is skipped, nothing in this block
+                # waits on the device — the burst dispatch is async, so the
+                # timer records dispatch time and the device compute overlaps
+                # the next acting phase (that overlap is the point on a remote-
                 # attached chip). Time/sps_train is only device-accurate on
                 # bursts that fetch.
-                telemetry = get_telemetry()
-                burst_specs = None
-                taus = np.zeros(n_samples, np.float32)
-                for i in range(n_samples):
-                    g = per_rank_gradient_steps + i
-                    if g % cfg.algo.critic.target_network_update_freq == 0:
-                        taus[i] = 1.0 if g == 0 else cfg.algo.critic.tau
-                # already on device: a ring gather, or a host burst whose
-                # sampling + upload overlapped the previous train burst
-                batches = local_data
                 with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
-                    # the whole burst (n_samples gradient steps) is ONE dispatch:
-                    # per-call overhead on a remote-attached device scales with
-                    # the state pytree's leaf count and would otherwise repeat
-                    # per gradient step (build_train_fn burst notes)
                     root_key, train_key = jax.random.split(root_key)
-                    burst_args = (
+                    agent_state, metrics, extras = run_train_burst(
+                        train_fn,
                         agent_state,
-                        batches,
-                        jax.random.split(train_key, n_samples),
-                        jnp.asarray(taus),
+                        local_data,
+                        (jax.random.split(train_key, n_samples), jnp.asarray(taus)),
+                        world_size=world_size,
+                        fetch_metrics=fetch_metrics,
+                        probe=probe,
                     )
-                    if telemetry is not None and telemetry.needs_train_flops():
-                        # specs captured pre-call: the burst donates agent_state
-                        burst_specs = shape_specs(burst_args)
-                    agent_state, metrics, play_packed_new = train_fn.burst(*burst_args)
                     per_rank_gradient_steps += n_samples
-                    probe.lap("train_dispatch")
-                    if metrics is not None and fetch_metrics:
-                        metrics = jax.device_get(metrics)
-                    else:
-                        # pacing barrier: one scalar pull per burst bounds the
-                        # host's dispatch run-ahead. Unbounded run-ahead on a
-                        # remote-attached device lets per-call overhead compound
-                        # (measured: acting latency grows without this); on local
-                        # devices the wait is the device's own step time.
-                        np.asarray(metrics["Loss/world_model_loss"])
-                        metrics = None
-                    probe.lap("metric_fetch")
                     if use_packed_player:
-                        play_packed = play_packed_new
+                        play_packed = extras[0]
                         _dump_digest = None
                     else:
                         play_wm = wm_mirror(agent_state["params"]["world_model"])
                         play_actor = actor_mirror(agent_state["params"]["actor"])
+                    # the cached fresh player state (episode resets) belongs
+                    # to the previous params version
+                    state_box["fresh"] = None
                     train_step += world_size
-                if burst_specs is not None:
-                    # one AOT cost analysis of the whole burst (FLOPs +
-                    # bytes accessed), registered per train-step UNIT (the
-                    # counter advances by world_size per dispatched burst)
-                    register_train_cost(
-                        telemetry, train_fn.burst, *burst_specs, world_size=world_size
-                    )
             updates_before_training = cfg.algo.train_every // policy_steps_per_update
             if cfg.algo.actor.expl_decay:
                 expl_decay_steps += 1
@@ -1153,7 +1129,7 @@ def main(fabric, cfg: Dict[str, Any]):
 
         # Log metrics (reference main :768-800)
         if cfg.metric.log_level > 0 and (
-            policy_step - last_log >= cfg.metric.log_every or update == num_updates
+            policy_step - last_log >= cfg.metric.log_every or last == num_updates
         ):
             if aggregator and not aggregator.disabled:
                 metrics_dict = aggregator.compute()
@@ -1173,15 +1149,15 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        probe.tick(update)
+        probe.tick(last)
 
         # Checkpoint (reference main :803-830)
-        if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
+        if should_checkpoint(cfg, policy_step, last_checkpoint, last, num_updates):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": jax.device_get(agent_state),
                 "expl_decay_steps": expl_decay_steps,
-                "update": update * world_size,
+                "update": last * world_size,
                 "batch_size": cfg.per_rank_batch_size * world_size,
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import build_train_fn
+from sheeprl_tpu.train import metric_fetch_gate, run_train_burst, tau_schedule
 from sheeprl_tpu.algos.dreamer_v2.utils import normalize_obs_jnp, prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, build_player_fns
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
@@ -28,14 +29,7 @@ from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import (
-    get_telemetry,
-    log_sps_metrics,
-    profile_tick,
-    register_train_cost,
-    shape_specs,
-    span,
-)
+from sheeprl_tpu.obs import log_sps_metrics, profile_tick, span
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
 
@@ -335,48 +329,51 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 if update == learning_starts
                 else cfg.algo.per_rank_gradient_steps
             )
-            local_data = staging.sample_device(
-                cfg.per_rank_batch_size * world_size,
-                sequence_length=cfg.per_rank_sequence_length,
-                n_samples=n_samples,
-            )
-            telemetry = get_telemetry()
-            train_specs = None
-            with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
-                metrics = None
-                for i in range(n_samples):
-                    tau = (
-                        1.0
-                        if per_rank_gradient_steps % cfg.algo.critic.target_network_update_freq == 0
-                        else 0.0
-                    )
-                    # device-side slice of the staged burst — a [L, B, ...]
-                    # view batch-sharded over the data axis; no per-gradient-
-                    # step host→HBM upload
-                    batch = {k: v[i] for k, v in local_data.items()}
-                    root_key, train_key = jax.random.split(root_key)
-                    if train_specs is None and telemetry is not None and telemetry.needs_train_flops():
-                        # specs captured pre-call: the step donates agent_state
-                        train_specs = shape_specs((
-                            agent_state, batch, train_key, jnp.float32(tau)
-                        ))
-                    agent_state, metrics = train_fn(
-                        agent_state, batch, train_key, jnp.float32(tau)
-                    )
-                    per_rank_gradient_steps += 1
-                if metrics is not None:
-                    metrics = jax.device_get(metrics)
-                play_wm = wm_mirror(agent_state["params"]["world_model"])
-                play_actor = actor_mirror(agent_state["params"]["actor"])
-                train_step += world_size
-            if train_specs is not None:
-                # the counter advances by world_size per block of
-                # per_rank_gradient_steps single-step dispatches
-                register_train_cost(
-                    telemetry, train_fn, *train_specs,
-                    world_size=world_size,
-                    dispatches_per_step=cfg.algo.per_rank_gradient_steps,
+            metrics = None
+            if n_samples > 0:
+                local_data = staging.sample_device(
+                    cfg.per_rank_batch_size * world_size,
+                    sequence_length=cfg.per_rank_sequence_length,
+                    n_samples=n_samples,
                 )
+                # hard target copies on the host-computed cadence; metrics
+                # are pulled at most once per burst behind the shared gate
+                taus = tau_schedule(
+                    n_samples,
+                    per_rank_gradient_steps,
+                    cfg.algo.critic.target_network_update_freq,
+                    tau=1.0,
+                    first_hard=False,
+                )
+                fetch_metrics = metric_fetch_gate(
+                    cfg,
+                    aggregator,
+                    policy_step=policy_step,
+                    last_log=last_log,
+                    train_step=train_step,
+                    update=update,
+                    num_updates=num_updates,
+                    policy_steps_per_update=policy_steps_per_update,
+                    world_size=world_size,
+                )
+                with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
+                    # the whole burst (n_samples gradient steps) is ONE
+                    # scanned dispatch (sheeprl_tpu/train): per-call overhead
+                    # on a remote-attached device would otherwise repeat per
+                    # gradient step
+                    root_key, train_key = jax.random.split(root_key)
+                    agent_state, metrics, _ = run_train_burst(
+                        train_fn,
+                        agent_state,
+                        local_data,
+                        (jax.random.split(train_key, n_samples), jnp.asarray(taus)),
+                        world_size=world_size,
+                        fetch_metrics=fetch_metrics,
+                    )
+                    per_rank_gradient_steps += n_samples
+                    play_wm = wm_mirror(agent_state["params"]["world_model"])
+                    play_actor = actor_mirror(agent_state["params"]["actor"])
+                    train_step += world_size
             updates_before_training = cfg.algo.train_every // policy_steps_per_update
             if cfg.algo.actor.expl_decay:
                 expl_decay_steps += 1

@@ -35,7 +35,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.algos.dreamer_v3.agent import (
     Actor,
@@ -61,21 +60,16 @@ from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.distributions import MSEDistribution, SymlogDistribution, TwoHotEncodingDistribution
+from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
+from sheeprl_tpu.plane import train_gated_burst_plan
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import (
-    get_telemetry,
-    log_sps_metrics,
-    profile_tick,
-    register_train_cost,
-    shape_specs,
-    span,
-)
+from sheeprl_tpu.obs import log_sps_metrics, profile_tick, span
 from sheeprl_tpu.obs.dist import pmean
+from sheeprl_tpu.train import build_train_burst, metric_fetch_gate, run_train_burst, tau_schedule
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
-from sheeprl_tpu.utils.jax_compat import shard_map
 
 sg = jax.lax.stop_gradient
 
@@ -503,14 +497,9 @@ def build_train_fn(
         }
         return new_state, metrics
 
-    shmapped = shard_map(
-        local_step,
-        mesh=fabric.mesh,
-        in_specs=(P(), P(None, axis), P(), P()),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    return jax.jit(shmapped, donate_argnums=(0,))
+    # step + fused-burst programs (scanned per-step inputs: key, tau); the
+    # ensemble params/optimizer state ride the burst carry with the rest
+    return build_train_burst(local_step, fabric, n_scanned=2)
 
 
 @register_algorithm()
@@ -708,48 +697,41 @@ def main(fabric, cfg: Dict[str, Any]):
     player_state = player_fns["init_states"](play_wm, n_envs)
 
     per_rank_gradient_steps = 0
-    for update in range(start_step, num_updates + 1):
-        policy_step += n_envs
 
+    # Burst acting (tier b, howto/rollout_engine.md): K env steps per device
+    # dispatch, K = env.act_burst; 1 reproduces the per-step path exactly.
+    # The RSSM player state rides the burst carry next to the observation;
+    # the host callback is the whole old loop body and applies episode
+    # resets with the same mask * fresh + (1 - mask) * state arithmetic as
+    # player_fns["reset_states"], against a host copy of the fresh init
+    # state refreshed once per params version (DV3's fresh state has a
+    # nonzero, params-dependent initial posterior).
+    act_burst = max(int(cfg.env.get("act_burst", 1) or 1), 1)
+    n_sub = len(actions_dim)
+    state_box = {
+        "carry": {
+            "obs": obs,
+            "player": {k: np.asarray(v) for k, v in player_state.items()},
+        },
+        "policy_step": policy_step,
+        "fresh": None,
+    }
+
+    def _fresh_player():
+        if state_box["fresh"] is None:
+            fresh = player_fns["init_states"](play_wm, n_envs)
+            state_box["fresh"] = {k: np.asarray(v) for k, v in fresh.items()}
+        return state_box["fresh"]
+
+    def _host_step_core(actions, real_actions, player_np):
+        state_box["policy_step"] += n_envs
+        step_data["actions"] = actions.reshape(1, n_envs, -1).astype(np.float32)
+        rb.add(step_data)
         with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
-            if update <= learning_starts and cfg.checkpoint.resume_from is None:
-                real_actions = actions = np.array(envs.action_space.sample())
-                if not is_continuous:
-                    actions = np.concatenate(
-                        [
-                            np.eye(act_dim, dtype=np.float32)[act]
-                            for act, act_dim in zip(
-                                actions.reshape(len(actions_dim), -1), actions_dim
-                            )
-                        ],
-                        axis=-1,
-                    )
-            else:
-                norm_obs = normalize_obs_jnp(obs, cnn_keys)
-                root_key, act_key = jax.random.split(root_key)
-                actions_j, player_state = player_fns["exploration_action"](
-                    play_wm,
-                    player_actor_params(),
-                    player_state,
-                    norm_obs,
-                    act_key,
-                    jnp.float32(expl_amount),
-                )
-                actions = np.concatenate([np.asarray(a) for a in actions_j], -1)
-                if is_continuous:
-                    real_actions = actions
-                else:
-                    real_actions = np.stack(
-                        [np.argmax(np.asarray(a), axis=-1) for a in actions_j], axis=-1
-                    )
-
-            step_data["actions"] = actions.reshape(1, n_envs, -1).astype(np.float32)
-            rb.add(step_data)
-
             o, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
-            dones = np.logical_or(terminated, truncated).astype(np.float32)
+        dones = np.logical_or(terminated, truncated).astype(np.float32)
 
         step_data["is_first"] = np.zeros_like(step_data["dones"])
         if cfg.metric.log_level > 0 and "final_info" in infos:
@@ -763,7 +745,9 @@ def main(fabric, cfg: Dict[str, Any]):
                         aggregator.update("Rewards/rew_avg", ep_rew)
                     if aggregator and "Game/ep_len_avg" in aggregator:
                         aggregator.update("Game/ep_len_avg", ep_len)
-                    fabric.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+                    fabric.print(
+                        f"Rank-0: policy_step={state_box['policy_step']}, reward_env_{i}={ep_rew}"
+                    )
 
         next_obs_np = {k: np.asarray(o[k]) for k in o}
         dones_idxes = np.nonzero(dones.reshape(-1))[0].tolist()
@@ -776,9 +760,9 @@ def main(fabric, cfg: Dict[str, Any]):
                         if k in fo:
                             real_next_obs[k][idx] = np.asarray(fo[k])
 
-        obs = prepare_obs(next_obs_np, cnn_keys, mlp_keys, n_envs)
+        new_obs = prepare_obs(next_obs_np, cnn_keys, mlp_keys, n_envs)
         for k in obs_keys:
-            step_data[k] = obs[k][None]
+            step_data[k] = new_obs[k][None]
 
         rewards = np.asarray(rewards, np.float32).reshape(n_envs, 1)
         step_data["dones"] = dones.reshape(1, n_envs, 1)
@@ -801,60 +785,151 @@ def main(fabric, cfg: Dict[str, Any]):
             step_data["is_first"][:, dones_idxes] = 1.0
             reset_mask = np.zeros((n_envs, 1), np.float32)
             reset_mask[dones_idxes] = 1.0
-            player_state = player_fns["reset_states"](
-                play_wm, player_state, jnp.asarray(reset_mask)
-            )
+            # same arithmetic as player_fns["reset_states"], applied
+            # host-side against the cached fresh init state
+            fresh = _fresh_player()
+            keep = np.float32(1.0) - reset_mask
+            player_np = {
+                k: reset_mask * fresh[k] + keep * v for k, v in player_np.items()
+            }
 
-        updates_before_training -= 1
+        carry = {"obs": new_obs, "player": player_np}
+        state_box["carry"] = carry
+        return carry
 
-        if update >= learning_starts and updates_before_training <= 0:
+    def _host_env_step(*args):
+        actions_j = [np.asarray(a) for a in args[:n_sub]]
+        player_np = {
+            "actions": np.asarray(args[n_sub]),
+            "recurrent": np.asarray(args[n_sub + 1]),
+            "stochastic": np.asarray(args[n_sub + 2]),
+        }
+        actions = np.concatenate(actions_j, -1)
+        if is_continuous:
+            real_actions = actions
+        else:
+            real_actions = np.stack([np.argmax(a, axis=-1) for a in actions_j], axis=-1)
+        return _host_step_core(actions, real_actions, player_np)
+
+    def _act_fn(p, carry, key):
+        # the key advances inside the jitted burst with the same split order
+        # the per-step loop used, so the K=1 key stream is bitwise the
+        # per-step stream
+        key, act_key = jax.random.split(key)
+        norm_obs = normalize_obs_jnp(carry["obs"], cnn_keys)
+        actions_j, new_player = player_fns["exploration_action"](
+            p["wm"], p["actor"], carry["player"], norm_obs, act_key, p["expl"]
+        )
+        cb_args = tuple(actions_j) + (
+            new_player["actions"],
+            new_player["recurrent"],
+            new_player["stochastic"],
+        )
+        return cb_args, key
+
+    burst_actor = BurstActor(_act_fn, _host_env_step, state_box["carry"])
+
+    update = start_step
+    while update <= num_updates:
+        n_act, random_phase = train_gated_burst_plan(
+            update,
+            act_burst,
+            learning_starts,
+            num_updates,
+            updates_before_training,
+            resuming=cfg.checkpoint.resume_from is not None,
+        )
+        if random_phase:
+            real_actions = actions = np.array(envs.action_space.sample())
+            if not is_continuous:
+                actions = np.concatenate(
+                    [
+                        np.eye(act_dim, dtype=np.float32)[act]
+                        for act, act_dim in zip(
+                            actions.reshape(len(actions_dim), -1), actions_dim
+                        )
+                    ],
+                    axis=-1,
+                )
+            _host_step_core(actions, real_actions, state_box["carry"]["player"])
+        else:
+            with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+                _, root_key = burst_actor.rollout(
+                    {
+                        "wm": play_wm,
+                        "actor": player_actor_params(),
+                        "expl": jnp.float32(expl_amount),
+                    },
+                    state_box["carry"],
+                    root_key,
+                    n_act,
+                )
+            # the burst program commits its inputs to the player's device;
+            # pull the carried key back to host numpy (uncommitted) so the
+            # possibly multi-device train program keeps accepting it
+            root_key = np.asarray(root_key)
+        policy_step = state_box["policy_step"]
+
+        update += n_act
+        last = update - 1
+        updates_before_training -= n_act
+
+        if last >= learning_starts and updates_before_training <= 0:
             n_samples = (
                 cfg.algo.per_rank_pretrain_steps
-                if update == learning_starts
+                if last == learning_starts
                 else cfg.algo.per_rank_gradient_steps
             )
-            local_data = staging.sample_device(
-                cfg.per_rank_batch_size * world_size,
-                sequence_length=cfg.per_rank_sequence_length,
-                n_samples=n_samples,
-            )
-            telemetry = get_telemetry()
-            train_specs = None
-            with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
-                metrics = None
-                for i in range(n_samples):
-                    if per_rank_gradient_steps % cfg.algo.critic.target_network_update_freq == 0:
-                        tau = 1.0 if per_rank_gradient_steps == 0 else cfg.algo.critic.tau
-                    else:
-                        tau = 0.0
-                    # device-side slice of the staged burst — a [L, B, ...]
-                    # view batch-sharded over the data axis; no per-gradient-
-                    # step host→HBM upload
-                    batch = {k: v[i] for k, v in local_data.items()}
-                    root_key, train_key = jax.random.split(root_key)
-                    if train_specs is None and telemetry is not None and telemetry.needs_train_flops():
-                        # specs captured pre-call: the step donates agent_state
-                        train_specs = shape_specs((
-                            agent_state, batch, train_key, jnp.float32(tau)
-                        ))
-                    agent_state, metrics = train_fn(
-                        agent_state, batch, train_key, jnp.float32(tau)
-                    )
-                    per_rank_gradient_steps += 1
-                if metrics is not None:
-                    metrics = jax.device_get(metrics)
-                play_wm = wm_mirror(agent_state["params"]["world_model"])
-                play_actor_expl = actor_expl_mirror(agent_state["params"]["actor_exploration"])
-                play_actor_task = actor_task_mirror(agent_state["params"]["actor_task"])
-                train_step += world_size
-            if train_specs is not None:
-                # the counter advances by world_size per block of
-                # per_rank_gradient_steps single-step dispatches
-                register_train_cost(
-                    telemetry, train_fn, *train_specs,
-                    world_size=world_size,
-                    dispatches_per_step=cfg.algo.per_rank_gradient_steps,
+            metrics = None
+            if n_samples > 0:
+                local_data = staging.sample_device(
+                    cfg.per_rank_batch_size * world_size,
+                    sequence_length=cfg.per_rank_sequence_length,
+                    n_samples=n_samples,
                 )
+                # EMA target updates on the host-computed cadence (first
+                # gradient step hard-copies); metrics are pulled at most
+                # once per burst behind the shared gate
+                taus = tau_schedule(
+                    n_samples,
+                    per_rank_gradient_steps,
+                    cfg.algo.critic.target_network_update_freq,
+                    tau=cfg.algo.critic.tau,
+                    first_hard=True,
+                )
+                fetch_metrics = metric_fetch_gate(
+                    cfg,
+                    aggregator,
+                    policy_step=policy_step,
+                    last_log=last_log,
+                    train_step=train_step,
+                    update=last,
+                    num_updates=num_updates,
+                    policy_steps_per_update=policy_steps_per_update,
+                    world_size=world_size,
+                )
+                with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
+                    # the whole burst (n_samples gradient steps) is ONE
+                    # scanned dispatch (sheeprl_tpu/train): per-call overhead
+                    # on a remote-attached device would otherwise repeat per
+                    # gradient step
+                    root_key, train_key = jax.random.split(root_key)
+                    agent_state, metrics, _ = run_train_burst(
+                        train_fn,
+                        agent_state,
+                        local_data,
+                        (jax.random.split(train_key, n_samples), jnp.asarray(taus)),
+                        world_size=world_size,
+                        fetch_metrics=fetch_metrics,
+                    )
+                    per_rank_gradient_steps += n_samples
+                    play_wm = wm_mirror(agent_state["params"]["world_model"])
+                    play_actor_expl = actor_expl_mirror(agent_state["params"]["actor_exploration"])
+                    play_actor_task = actor_task_mirror(agent_state["params"]["actor_task"])
+                    # cached fresh player state belongs to the previous
+                    # params version — recompute on next episode reset
+                    state_box["fresh"] = None
+                    train_step += world_size
             updates_before_training = cfg.algo.train_every // policy_steps_per_update
             if cfg.algo.actor.expl_decay:
                 expl_decay_steps += 1
@@ -873,7 +948,7 @@ def main(fabric, cfg: Dict[str, Any]):
                     aggregator.update("Params/exploration_amount", expl_amount)
 
         if cfg.metric.log_level > 0 and (
-            policy_step - last_log >= cfg.metric.log_every or update == num_updates
+            policy_step - last_log >= cfg.metric.log_every or last == num_updates
         ):
             if aggregator and not aggregator.disabled:
                 metrics_dict = aggregator.compute()
@@ -893,12 +968,12 @@ def main(fabric, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
+        if should_checkpoint(cfg, policy_step, last_checkpoint, last, num_updates):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": jax.device_get(agent_state),
                 "expl_decay_steps": expl_decay_steps,
-                "update": update * world_size,
+                "update": last * world_size,
                 "batch_size": cfg.per_rank_batch_size * world_size,
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,

@@ -24,6 +24,12 @@ the single chokepoint for that decision:
   draws burst *k+1*'s indices before the env steps collected during burst
   *k+1* land, and the worker's rng interleaving is scheduling-dependent).
 
+The ``n_samples`` axis this facade stages is the contract with the fused
+train-burst engine (:mod:`sheeprl_tpu.train`, howto/train_burst.md): the
+``[n_samples, ...]`` stack ``sample_device`` returns is consumed as ONE
+scanned device program per gradient burst — staging produces the block,
+the burst scans it, and neither side pays a per-gradient-step dispatch.
+
 Telemetry: ring gathers bump ``ring_gathers``; pipeline bursts bump
 ``prefetch_hits``/``prefetch_misses`` and ``prefetch_wait_ms`` (the residue a
 train step still blocked on a not-yet-ready prefetched batch) — all beside

@@ -46,6 +46,7 @@ __all__ = [
     "add_prefetch",
     "add_ring_gather",
     "add_rollout_burst",
+    "add_train_burst",
     "note_plane_policy_version",
     "device_memory_stats",
     "DevicePoller",
@@ -112,6 +113,16 @@ class Counters:
         self.rollout_bursts = 0
         self.act_dispatches = 0
         self.env_steps_jax = 0
+        # train-burst engine (sheeprl_tpu/train): `train_bursts` counts
+        # fused training bursts (one scanned device program per burst),
+        # `train_dispatches` counts train-program device dispatches paid
+        # for them (1 per fused burst, n_samples for a per-step loop), and
+        # `train_burst_steps` counts the gradient steps those dispatches
+        # covered — dispatches/steps is the measured
+        # ``train_dispatches_per_step`` the bench evidence lines report
+        self.train_bursts = 0
+        self.train_dispatches = 0
+        self.train_burst_steps = 0
         # actor–learner plane (sheeprl_tpu/plane): trajectory slabs received
         # by the learner over the shared-memory queues, the newest published
         # policy version (a gauge — max, not a sum), and player processes
@@ -189,6 +200,9 @@ class Counters:
                 "rollout_bursts": self.rollout_bursts,
                 "act_dispatches": self.act_dispatches,
                 "env_steps_jax": self.env_steps_jax,
+                "train_bursts": self.train_bursts,
+                "train_dispatches": self.train_dispatches,
+                "train_burst_steps": self.train_burst_steps,
                 "plane_traj_slabs": self.plane_traj_slabs,
                 "plane_policy_version": self.plane_policy_version,
                 "plane_player_restarts": self.plane_player_restarts,
@@ -353,6 +367,21 @@ def add_act_dispatches(n: int = 1) -> None:
     if c is not None:
         with c._lock:
             c.act_dispatches += int(n)
+
+
+# -- train-burst engine accounting --------------------------------------------
+
+
+def add_train_burst(steps: int = 0, dispatches: int = 1) -> None:
+    """Record one training burst: ``steps`` gradient steps were trained
+    through ``dispatches`` train-program device dispatches (1 for the fused
+    scan, ``steps`` for the per-step reference loop)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.train_bursts += 1
+            c.train_dispatches += int(dispatches)
+            c.train_burst_steps += int(steps)
 
 
 # -- parameter-sharding accounting -------------------------------------------

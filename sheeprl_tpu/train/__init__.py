@@ -1,0 +1,23 @@
+"""Shared train-burst engine: one scanned device program per gradient burst.
+
+Promotes DreamerV3's private ``train_fn.burst`` pattern (one ``lax.scan``
+dispatch per training burst instead of one dispatch per gradient step) to
+framework infrastructure shared by every dreamer-family entrypoint. See
+``howto/train_burst.md`` for the burst contract.
+"""
+
+from sheeprl_tpu.train.burst import (
+    TrainProgram,
+    build_train_burst,
+    metric_fetch_gate,
+    run_train_burst,
+    tau_schedule,
+)
+
+__all__ = [
+    "TrainProgram",
+    "build_train_burst",
+    "metric_fetch_gate",
+    "run_train_burst",
+    "tau_schedule",
+]

@@ -528,6 +528,42 @@ def test_dreamer_v2_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
     _assert_ckpt_bitwise(tmp_path, "dk1", "dk4", written=8)
 
 
+def test_dreamer_v3_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """DreamerV3 equivalence with training ON: unlike DV1/DV2 (zero reset
+    states), DV3's fresh player state depends on the world-model params
+    (learned initial posterior), so episode resets inside the burst apply
+    ``mask * fresh + (1 - mask) * state`` host-side against a fresh-state
+    copy cached per params version — act_burst=4 must still reproduce the
+    per-step run bitwise end-to-end (params, opt state, replay rows)."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    extras = ["algo.world_model.discrete_size=4"]
+    cli.run(_dreamer_burst_args(tmp_path, "dreamer_v3", "vk1", extras))
+    cli.run(_dreamer_burst_args(tmp_path, "dreamer_v3", "vk4", extras + ["env.act_burst=4"]))
+    _assert_ckpt_bitwise(tmp_path, "vk1", "vk4", written=8)
+
+
+@pytest.mark.slow
+def test_p2e_dv3_exploration_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """P2E-DV3 exploration equivalence: the exploration actor's player state
+    rides the same burst carry as DV3's (params-dependent resets cached per
+    params version; ensemble optimizer state riding the train carry), so
+    act_burst=4 is bitwise the per-step run. Slow-marked: two full
+    six-update-per-step e2e runs."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    extras = ["algo.world_model.discrete_size=4", "algo.ensembles.n=2"]
+    cli.run(_dreamer_burst_args(tmp_path, "p2e_dv3_exploration", "pk1", extras))
+    cli.run(
+        _dreamer_burst_args(
+            tmp_path, "p2e_dv3_exploration", "pk4", extras + ["env.act_burst=4"]
+        )
+    )
+    _assert_ckpt_bitwise(tmp_path, "pk1", "pk4", written=8)
+
+
 def test_dreamer_v2_fused_xla_bitwise_off_e2e(tmp_path, monkeypatch):
     """The fused-kernel knob (ISSUE 13) must not change a single bit of a
     DV2 run on CPU: ``algo.fused_kernels=xla`` resolves to ``pad_to=1``

@@ -93,6 +93,10 @@ _LOWER_KEYS = (
     # regression (a model_axis change that stopped sharding, say)
     "params_bytes_per_device",
     "opt_state_bytes_per_device",
+    # train-burst engine (sheeprl_tpu/train): dispatched device programs per
+    # gradient step — 1/n_samples when bursts fuse, 1.0 when a per-step
+    # dispatch loop re-grew somewhere
+    "train_dispatches_per_step",
 )
 
 

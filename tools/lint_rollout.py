@@ -45,15 +45,16 @@ ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
 #: coupled acting loops moved onto the shared BurstActor (K=1 default is
 #: bitwise the old per-step path); a2c and ppo_recurrent followed (the
 #: recurrent player threads its LSTM state through the burst carry, done
-#: masking still host-side). Keep in sync with howto/rollout_engine.md's
-#: support matrix.
+#: masking still host-side); dreamer_v3 and p2e_dv3_exploration followed
+#: (RSSM player state rides the burst obs-carry pytree; DV3's
+#: params-dependent episode-reset state is applied host-side against a
+#: fresh-state copy cached per params version). Keep in sync with
+#: howto/rollout_engine.md's support matrix.
 GRANDFATHERED = {
-    "dreamer_v3/dreamer_v3.py",
     "p2e_dv1/p2e_dv1_exploration.py",
     "p2e_dv1/p2e_dv1_finetuning.py",
     "p2e_dv2/p2e_dv2_exploration.py",
     "p2e_dv2/p2e_dv2_finetuning.py",
-    "p2e_dv3/p2e_dv3_exploration.py",
     "p2e_dv3/p2e_dv3_finetuning.py",
 }
 

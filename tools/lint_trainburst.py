@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Train-burst uniformity lint: no per-step train dispatch over staged batches.
+
+The train-burst engine (``sheeprl_tpu/train``, howto/train_burst.md) exists
+so gradient bursts stop paying one device round trip per gradient step: the
+staged ``[n_samples, ...]`` batch is consumed by ONE scanned program
+(``build_train_burst`` / ``run_train_burst``). The per-step anti-pattern it
+replaces is mechanical and recognizable::
+
+    for i in range(n_samples):                      # the gradient loop
+        batch = jax.tree.map(lambda x: x[i], data)  # slice the staged axis
+        state, metrics = train_fn(state, batch, keys[i], ...)  # dispatch/step
+
+This lint flags any loop in an ``algos/`` entrypoint that BOTH calls a
+train-named callable (name matching ``train``) AND subscripts an array by
+the loop's index variable — i.e. a re-grown per-gradient-step dispatch loop
+over sliced staged data. Converted entrypoints hand the whole staged stack
+to ``run_train_burst`` and never trip it. Single-dispatch callers that loop
+for other reasons (PPO's per-update loop, SAC's whole-burst ``train_fn``)
+do not slice by the loop index and do not trip either.
+
+All eight per-step families (dreamer_v1, dreamer_v2, and the six P2E
+entrypoints) were converted in the same change that introduced this lint,
+so the grandfather list below starts — and should stay — EMPTY. It is
+checked both ways (a listed file that stops tripping must be delisted), so
+a regression is always a visible diff here.
+
+AST-based; descends into lambdas and comprehensions (where the staged-axis
+slice usually hides) but not into nested function defs, which are their own
+scope. Usage: ``python tools/lint_trainburst.py`` — non-zero exit with
+findings on violation. Wired into the CI tier-1 lane
+(.github/workflows/tests.yml).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
+
+#: entrypoints still dispatching a train fn per sliced gradient step.
+#: Intentionally empty — every per-step family rides run_train_burst.
+GRANDFATHERED: set = set()
+
+#: helper files that never own a gradient loop
+SKIP_BASENAMES = {"evaluate.py", "utils.py", "agent.py", "loss.py"}
+
+_TRAIN_NAME = re.compile(r"train", re.IGNORECASE)
+#: burst-engine entrypoints: calling these IS the converted path
+_ENGINE_FUNCS = {"run_train_burst", "build_train_burst", "register_train_cost"}
+
+
+def _name_of(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _target_names(node: ast.AST) -> set:
+    """Names bound by a For target (handles tuple unpacking)."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _walk_same_scope(node: ast.AST):
+    """``ast.walk`` that does not descend into nested function defs (their
+    bodies are separate scopes — burst callbacks live there by design) but
+    DOES descend into lambdas and comprehensions, where the staged-axis
+    slice usually hides (``jax.tree.map(lambda x: x[i], data)``)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield child
+        yield from _walk_same_scope(child)
+
+
+def _is_train_call(call: ast.Call) -> bool:
+    name = _name_of(call.func)
+    return bool(_TRAIN_NAME.search(name)) and name not in _ENGINE_FUNCS
+
+
+def _subscripts_by(node: ast.AST, names: set) -> bool:
+    """True when ``node`` contains ``<expr>[<slice mentioning a name>]``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Subscript):
+            for n in ast.walk(sub.slice):
+                if isinstance(n, ast.Name) and n.id in names:
+                    return True
+    return False
+
+
+def _loop_index_names(loop: ast.AST) -> set:
+    """The loop's index variables: the For target, plus (for While loops)
+    any name the body increments via AugAssign — a manual step counter."""
+    if isinstance(loop, ast.For):
+        return _target_names(loop.target)
+    names = set()
+    for sub in _walk_same_scope(loop):
+        if isinstance(sub, ast.AugAssign) and isinstance(sub.target, ast.Name):
+            names.add(sub.target.id)
+    return names
+
+
+def lint_file(path: str) -> list:
+    tree = ast.parse(open(path).read(), filename=path)
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        idx_names = _loop_index_names(node)
+        if not idx_names:
+            continue
+        calls, slices = [], []
+        for sub in _walk_same_scope(node):
+            if isinstance(sub, ast.Call) and _is_train_call(sub):
+                calls.append(sub.lineno)
+            if isinstance(sub, ast.Subscript) and _subscripts_by(sub, idx_names):
+                slices.append(sub.lineno)
+        if calls and slices:
+            findings.append(
+                (
+                    min(calls + slices),
+                    "per-step train dispatch over a sliced staged batch "
+                    f"(train call at line {calls[0]}, loop-index slice at "
+                    f"line {slices[0]}) — hand the whole [n_samples, ...] "
+                    "stack to run_train_burst (sheeprl_tpu/train)",
+                )
+            )
+    return findings
+
+
+def main() -> int:
+    violations = []
+    tripped = set()
+    for root, _dirs, files in os.walk(ALGOS_DIR):
+        for fname in sorted(files):
+            if not fname.endswith(".py") or fname in SKIP_BASENAMES:
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, ALGOS_DIR).replace(os.sep, "/")
+            findings = lint_file(path)
+            if findings:
+                tripped.add(rel)
+                if rel not in GRANDFATHERED:
+                    violations.extend((rel, line, msg) for line, msg in findings)
+    stale = GRANDFATHERED - tripped
+    rc = 0
+    if violations:
+        print("train-burst uniformity lint FAILED:")
+        for rel, line, msg in violations:
+            print(f"  sheeprl_tpu/algos/{rel}:{line}: {msg}")
+        rc = 1
+    if stale:
+        print(
+            "train-burst uniformity lint: stale grandfather entries (these "
+            "files no longer trip the per-step pattern — delist them so they "
+            f"can't silently regress): {sorted(stale)}"
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            "train-burst uniformity lint OK (every gradient burst is one "
+            "scanned dispatch)"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
