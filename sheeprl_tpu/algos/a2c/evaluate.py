@@ -1,44 +1,15 @@
-"""A2C evaluation entrypoint (upstream sheeprl ``algos/a2c/evaluate.py``)."""
+"""A2C evaluation entrypoint (upstream sheeprl ``algos/a2c/evaluate.py``):
+the agent is PPO's, so the PPO eval-policy builder (registered for ``a2c``
+in ``algos/ppo/evaluate.py``) serves it through the shared service."""
 
 from __future__ import annotations
 
 from typing import Any, Dict
 
-import gymnasium as gym
-import jax
-import numpy as np
-
-from sheeprl_tpu.algos.ppo.agent import build_agent
-from sheeprl_tpu.algos.ppo.utils import test
-from sheeprl_tpu.envs.vector import make_eval_env
-from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.evals.service import run_eval_entrypoint
 from sheeprl_tpu.utils.registry import register_evaluation
-from sheeprl_tpu.utils.utils import params_on_device
 
 
 @register_evaluation(algorithms=["a2c"])
 def evaluate_a2c(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
-    logger, log_dir = create_tensorboard_logger(cfg)
-    fabric.logger = logger
-    if logger is not None:
-        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-
-    env = make_eval_env(cfg, log_dir)
-    observation_space = env.observation_space
-    action_space = env.action_space
-    if not isinstance(observation_space, gym.spaces.Dict):
-        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
-    is_continuous = isinstance(action_space, gym.spaces.Box)
-    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
-    actions_dim = tuple(
-        action_space.shape
-        if is_continuous
-        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
-    )
-    env.close()
-
-    agent = build_agent(
-        cfg, actions_dim, is_continuous, list(cfg.cnn_keys.encoder), list(cfg.mlp_keys.encoder)
-    )
-    params = params_on_device(state["params"])
-    test(agent, params, fabric, cfg, log_dir)
+    run_eval_entrypoint(fabric, cfg, state)

@@ -1,4 +1,6 @@
-"""DreamerV2 evaluation entrypoint (reference ``sheeprl/algos/dreamer_v2/evaluate.py``)."""
+"""DreamerV2 evaluation (reference ``sheeprl/algos/dreamer_v2/evaluate.py``),
+collapsed onto the shared eval service via the common dreamer-family
+builder."""
 
 from __future__ import annotations
 
@@ -6,27 +8,17 @@ from typing import Any, Dict
 
 import gymnasium as gym
 import jax
-import numpy as np
 
 from sheeprl_tpu.algos.dreamer_v2.agent import build_agent, build_player_fns
-from sheeprl_tpu.algos.dreamer_v2.utils import normalize_obs_jnp, test
-from sheeprl_tpu.envs.vector import make_eval_env
-from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.algos.dreamer_v2.utils import normalize_obs_jnp
+from sheeprl_tpu.evals.builders import actions_dim_of, dreamer_eval_policy
+from sheeprl_tpu.evals.service import EvalPolicy, register_eval_builder, run_eval_entrypoint
 from sheeprl_tpu.utils.registry import register_evaluation
 from sheeprl_tpu.utils.utils import params_on_device
 
 
-@register_evaluation(algorithms=["dreamer_v2"])
-def evaluate_dreamer_v2(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
-    logger, log_dir = create_tensorboard_logger(cfg)
-    fabric.logger = logger
-    if logger is not None:
-        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-
-    env = make_eval_env(cfg, log_dir)
-    observation_space = env.observation_space
-    action_space = env.action_space
-
+@register_eval_builder(algorithms=["dreamer_v2"])
+def dreamer_v2_eval_policy(fabric, cfg, state, observation_space, action_space) -> EvalPolicy:
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
     if len(cfg.cnn_keys.encoder) + len(cfg.mlp_keys.encoder) == 0:
@@ -34,21 +26,17 @@ def evaluate_dreamer_v2(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
             "You should specify at least one CNN keys or MLP keys from the cli: "
             "`cnn_keys.encoder=[rgb]` or `mlp_keys.encoder=[state]`"
         )
-    fabric.print("Encoder CNN keys:", cfg.cnn_keys.encoder)
-    fabric.print("Encoder MLP keys:", cfg.mlp_keys.encoder)
-
-    is_continuous = isinstance(action_space, gym.spaces.Box)
-    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
-    actions_dim = tuple(
-        action_space.shape
-        if is_continuous
-        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
-    )
-    env.close()
-
-    world_model, actor, critic, _ = build_agent(
+    actions_dim, is_continuous = actions_dim_of(action_space)
+    world_model, actor, _, _ = build_agent(
         cfg, actions_dim, is_continuous, observation_space, jax.random.PRNGKey(cfg.seed)
     )
     params = params_on_device(state["agent"]["params"])
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
-    test(player_fns, params, fabric, cfg, log_dir, normalize_fn=normalize_obs_jnp)
+    return dreamer_eval_policy(
+        player_fns, params, cfg, is_continuous, normalize_fn=normalize_obs_jnp
+    )
+
+
+@register_evaluation(algorithms=["dreamer_v2"])
+def evaluate_dreamer_v2(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    run_eval_entrypoint(fabric, cfg, state)

@@ -1,14 +1,16 @@
 """DroQ evaluation entrypoint (reference ``sheeprl/algos/droq/evaluate.py``):
-the actor is a plain SAC actor, so evaluation is SAC's greedy test."""
+the actor is a plain SAC actor, so the SAC eval-policy builder (registered
+for ``droq`` in ``algos/sac/evaluate.py``) serves it through the shared
+service."""
 
 from __future__ import annotations
 
 from typing import Any, Dict
 
-from sheeprl_tpu.algos.sac.evaluate import evaluate_sac
+from sheeprl_tpu.evals.service import run_eval_entrypoint
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
 @register_evaluation(algorithms=["droq"])
 def evaluate_droq(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
-    evaluate_sac(fabric, cfg, state)
+    run_eval_entrypoint(fabric, cfg, state)

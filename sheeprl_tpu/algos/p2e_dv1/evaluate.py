@@ -1,5 +1,6 @@
 """P2E-DV1 evaluation (reference ``sheeprl/algos/p2e_dv1/evaluate.py``):
-registered for both phases; always evaluates the **task** actor."""
+registered for both phases; always evaluates the **task** actor, through the
+shared eval service."""
 
 from __future__ import annotations
 
@@ -7,45 +8,36 @@ from typing import Any, Dict
 
 import gymnasium as gym
 import jax
-import numpy as np
 
-from sheeprl_tpu.algos.dreamer_v1.utils import normalize_obs_jnp, test
+from sheeprl_tpu.algos.dreamer_v1.utils import normalize_obs_jnp
 from sheeprl_tpu.algos.p2e_dv1.agent import build_agent, build_player_fns
-from sheeprl_tpu.envs.vector import make_eval_env
-from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.evals.builders import actions_dim_of, dreamer_eval_policy
+from sheeprl_tpu.evals.service import EvalPolicy, register_eval_builder, run_eval_entrypoint
 from sheeprl_tpu.utils.registry import register_evaluation
 from sheeprl_tpu.utils.utils import params_on_device
 
 
-@register_evaluation(algorithms=["p2e_dv1_exploration", "p2e_dv1_finetuning"])
-def evaluate_p2e_dv1(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
-    logger, log_dir = create_tensorboard_logger(cfg)
-    fabric.logger = logger
-    if logger is not None:
-        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-
-    env = make_eval_env(cfg, log_dir)
-    observation_space = env.observation_space
-    action_space = env.action_space
+@register_eval_builder(algorithms=["p2e_dv1_exploration", "p2e_dv1_finetuning"])
+def p2e_dv1_eval_policy(fabric, cfg, state, observation_space, action_space) -> EvalPolicy:
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
-    is_continuous = isinstance(action_space, gym.spaces.Box)
-    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
-    actions_dim = tuple(
-        action_space.shape
-        if is_continuous
-        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
-    )
-    env.close()
-
-    world_model, actor, critic, _, _ = build_agent(
+    actions_dim, is_continuous = actions_dim_of(action_space)
+    world_model, actor, _, _, _ = build_agent(
         cfg, actions_dim, is_continuous, observation_space, jax.random.PRNGKey(cfg.seed)
     )
     params = params_on_device(state["agent"]["params"])
+    # exploration checkpoints carry actor_task; finetuning checkpoints carry actor
     actor_params = params.get("actor_task", params.get("actor"))
     player_fns = build_player_fns(world_model, actor, cfg, actions_dim, is_continuous)
-    test(
+    return dreamer_eval_policy(
         player_fns,
         {"world_model": params["world_model"], "actor": actor_params},
-        fabric, cfg, log_dir, normalize_fn=normalize_obs_jnp,
+        cfg,
+        is_continuous,
+        normalize_fn=normalize_obs_jnp,
     )
+
+
+@register_evaluation(algorithms=["p2e_dv1_exploration", "p2e_dv1_finetuning"])
+def evaluate_p2e_dv1(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    run_eval_entrypoint(fabric, cfg, state)

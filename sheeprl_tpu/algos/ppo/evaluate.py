@@ -1,4 +1,6 @@
-"""PPO evaluation entrypoint (reference ``sheeprl/algos/ppo/evaluate.py:15-66``)."""
+"""PPO evaluation (reference ``sheeprl/algos/ppo/evaluate.py:15-66``),
+collapsed onto the shared eval service. ppo_decoupled and a2c train the same
+agent/checkpoint layout, so one builder serves all three."""
 
 from __future__ import annotations
 
@@ -8,24 +10,16 @@ import gymnasium as gym
 import jax
 import numpy as np
 
-from sheeprl_tpu.algos.ppo.agent import build_agent
-from sheeprl_tpu.algos.ppo.utils import test
-from sheeprl_tpu.envs.vector import make_eval_env
-from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.algos.ppo.agent import build_agent, greedy_actions
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
+from sheeprl_tpu.evals.builders import actions_dim_of
+from sheeprl_tpu.evals.service import EvalPolicy, register_eval_builder, run_eval_entrypoint
 from sheeprl_tpu.utils.registry import register_evaluation
 from sheeprl_tpu.utils.utils import params_on_device
 
 
-@register_evaluation(algorithms=["ppo"])
-def evaluate_ppo(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
-    logger, log_dir = create_tensorboard_logger(cfg)
-    fabric.logger = logger
-    if logger is not None:
-        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-
-    env = make_eval_env(cfg, log_dir)
-    observation_space = env.observation_space
-
+@register_eval_builder(algorithms=["ppo", "ppo_decoupled", "a2c"])
+def ppo_eval_policy(fabric, cfg, state, observation_space, action_space) -> EvalPolicy:
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
     if len(cfg.cnn_keys.encoder) + len(cfg.mlp_keys.encoder) == 0:
@@ -33,26 +27,34 @@ def evaluate_ppo(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
             "You should specify at least one CNN keys or MLP keys from the cli: "
             "`cnn_keys.encoder=[rgb]` or `mlp_keys.encoder=[state]`"
         )
-    fabric.print("Encoder CNN keys:", cfg.cnn_keys.encoder)
-    fabric.print("Encoder MLP keys:", cfg.mlp_keys.encoder)
-
-    is_continuous = isinstance(env.action_space, gym.spaces.Box)
-    is_multidiscrete = isinstance(env.action_space, gym.spaces.MultiDiscrete)
-    actions_dim = tuple(
-        env.action_space.shape
-        if is_continuous
-        else (env.action_space.nvec.tolist() if is_multidiscrete else [env.action_space.n])
-    )
-    env.close()
-
+    actions_dim, is_continuous = actions_dim_of(action_space)
     agent = build_agent(
-        cfg, actions_dim, is_continuous, cfg.cnn_keys.encoder, cfg.mlp_keys.encoder
+        cfg, actions_dim, is_continuous, list(cfg.cnn_keys.encoder), list(cfg.mlp_keys.encoder)
     )
     params = params_on_device(state["params"])
-    test(agent, params, fabric, cfg, log_dir)
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    obs_keys = list(cfg.mlp_keys.encoder) + cnn_keys
+
+    @jax.jit
+    def _act(p, obs):
+        norm = normalize_obs(obs, cnn_keys, obs_keys)
+        pre_dist = agent.apply({"params": p}, norm, method=agent.pre_dist)
+        return greedy_actions(pre_dist, agent.is_continuous)
+
+    def act(obs, policy_state, key):
+        n = int(np.asarray(next(iter(obs.values()))).shape[0])
+        prepared = {k: v for k, v in prepare_obs(obs, cnn_keys, n).items() if k in obs_keys}
+        return np.asarray(_act(params, prepared)), policy_state
+
+    return EvalPolicy(act=act)
+
+
+@register_evaluation(algorithms=["ppo"])
+def evaluate_ppo(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    run_eval_entrypoint(fabric, cfg, state)
 
 
 # Same model as coupled PPO — the checkpoint layout is identical.
 @register_evaluation(algorithms=["ppo_decoupled"])
 def evaluate_ppo_decoupled(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
-    evaluate_ppo(fabric, cfg, state)
+    run_eval_entrypoint(fabric, cfg, state)

@@ -1,5 +1,9 @@
-"""Recurrent-PPO evaluation entrypoint (reference
-``sheeprl/algos/ppo_recurrent/evaluate.py``)."""
+"""Recurrent-PPO evaluation (reference
+``sheeprl/algos/ppo_recurrent/evaluate.py``), collapsed onto the shared eval
+service. The only stateful non-dreamer family: the policy state carries the
+LSTM hidden pair plus the previous (one-hot) actions and the is-first flag,
+all with the episode batch on axis 0 so the service's generic
+finished-row reset applies."""
 
 from __future__ import annotations
 
@@ -7,39 +11,76 @@ from typing import Any, Dict
 
 import gymnasium as gym
 import jax
+import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.algos.ppo.agent import greedy_actions
 from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
-from sheeprl_tpu.algos.ppo_recurrent.utils import test
-from sheeprl_tpu.envs.vector import make_eval_env
-from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.algos.ppo_recurrent.utils import normalize_obs, prepare_obs
+from sheeprl_tpu.evals.builders import actions_dim_of
+from sheeprl_tpu.evals.service import EvalPolicy, register_eval_builder, run_eval_entrypoint
 from sheeprl_tpu.utils.registry import register_evaluation
 from sheeprl_tpu.utils.utils import params_on_device
 
 
-@register_evaluation(algorithms=["ppo_recurrent"])
-def evaluate_ppo_recurrent(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
-    logger, log_dir = create_tensorboard_logger(cfg)
-    fabric.logger = logger
-    if logger is not None:
-        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-
-    env = make_eval_env(cfg, log_dir)
-    observation_space = env.observation_space
-    action_space = env.action_space
+@register_eval_builder(algorithms=["ppo_recurrent"])
+def ppo_recurrent_eval_policy(fabric, cfg, state, observation_space, action_space) -> EvalPolicy:
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
-    is_continuous = isinstance(action_space, gym.spaces.Box)
-    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
-    actions_dim = tuple(
-        action_space.shape
-        if is_continuous
-        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
-    )
-    env.close()
-
+    actions_dim, is_continuous = actions_dim_of(action_space)
     agent = build_agent(
         cfg, actions_dim, is_continuous, list(cfg.cnn_keys.encoder), list(cfg.mlp_keys.encoder)
     )
     params = params_on_device(state["params"])
-    test(agent, params, fabric, cfg, log_dir)
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    obs_keys = list(cfg.mlp_keys.encoder) + cnn_keys
+    act_dim = int(sum(agent.actions_dim))
+
+    @jax.jit
+    def _act(p, obs, prev_actions, is_first, hc):
+        norm = normalize_obs(obs, cnn_keys, obs_keys)
+        seq_obs = {k: v[None] for k, v in norm.items()}  # [T=1, B, ...]
+        pre_dist, _, hc = agent.apply(
+            {"params": p}, seq_obs, prev_actions[None], is_first[None], hc
+        )
+        return greedy_actions([pd[0] for pd in pre_dist], agent.is_continuous), hc
+
+    def init_state(n: int):
+        return {
+            "hc": agent.initial_hc(n),
+            "prev_actions": jnp.zeros((n, act_dim), jnp.float32),
+            "is_first": jnp.ones((n, 1), jnp.float32),
+        }
+
+    def act(obs, policy_state, key):
+        n = int(np.asarray(next(iter(obs.values()))).shape[0])
+        prepared = prepare_obs(obs, cnn_keys, n)
+        real_actions, hc = _act(
+            params,
+            prepared,
+            jnp.asarray(policy_state["prev_actions"]),
+            jnp.asarray(policy_state["is_first"]),
+            jax.tree.map(jnp.asarray, policy_state["hc"]),
+        )
+        real = np.asarray(real_actions)
+        if agent.is_continuous:
+            prev_actions = jnp.asarray(real, jnp.float32).reshape(n, -1)
+        else:
+            onehots = [
+                jax.nn.one_hot(jnp.asarray(real[..., i]), d)
+                for i, d in enumerate(agent.actions_dim)
+            ]
+            prev_actions = jnp.concatenate(onehots, -1).reshape(n, -1)
+        new_state = {
+            "hc": hc,
+            "prev_actions": prev_actions,
+            "is_first": jnp.zeros((n, 1), jnp.float32),
+        }
+        return real, new_state
+
+    return EvalPolicy(act=act, init_state=init_state)
+
+
+@register_evaluation(algorithms=["ppo_recurrent"])
+def evaluate_ppo_recurrent(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    run_eval_entrypoint(fabric, cfg, state)

@@ -1,4 +1,7 @@
-"""SAC evaluation entrypoint (reference ``sheeprl/algos/sac/evaluate.py``)."""
+"""SAC evaluation (reference ``sheeprl/algos/sac/evaluate.py``), collapsed
+onto the shared eval service: this file only knows how to rebuild the frozen
+actor and act greedily on a batch; episode running, artifacts and registry
+appends live in :mod:`sheeprl_tpu.evals.service`."""
 
 from __future__ import annotations
 
@@ -9,39 +12,49 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.sac.agent import SACActor, action_bounds
-from sheeprl_tpu.algos.sac.utils import test
-from sheeprl_tpu.envs.vector import make_eval_env
-from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.algos.sac.agent import SACActor, action_bounds, greedy_action
+from sheeprl_tpu.algos.sac.utils import concat_obs
+from sheeprl_tpu.evals.service import EvalPolicy, register_eval_builder, run_eval_entrypoint
 from sheeprl_tpu.utils.registry import register_evaluation
 from sheeprl_tpu.utils.utils import params_on_device
 
 
-@register_evaluation(algorithms=["sac"])
-def evaluate_sac(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
-    logger, log_dir = create_tensorboard_logger(cfg)
-    fabric.logger = logger
-    if logger is not None:
-        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-
-    env = make_eval_env(cfg, log_dir)
-    action_space = env.action_space
-    observation_space = env.observation_space
+# droq and sac_decoupled train the same SACActor with the same checkpoint
+# layout, so one builder serves all three.
+@register_eval_builder(algorithms=["sac", "sac_decoupled", "droq"])
+def sac_eval_policy(fabric, cfg, state, observation_space, action_space) -> EvalPolicy:
     if not isinstance(action_space, gym.spaces.Box):
         raise ValueError("Only continuous action space is supported for the SAC agent")
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
     if len(cfg.mlp_keys.encoder) == 0:
         raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
-    env.close()
 
     act_dim = int(np.prod(action_space.shape))
     action_scale, action_bias = action_bounds(action_space)
+    scale = jnp.asarray(action_scale)
+    bias = jnp.asarray(action_bias)
     actor = SACActor(action_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size)
     actor_params = params_on_device(state["agent"]["actor"])
-    test(actor, actor_params, jnp.asarray(action_scale), jnp.asarray(action_bias), fabric, cfg, log_dir)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+
+    @jax.jit
+    def _act(params, obs):
+        mean, _ = actor.apply({"params": params}, obs)
+        return greedy_action(mean, scale, bias)
+
+    def act(obs, policy_state, key):
+        n = int(np.asarray(next(iter(obs.values()))).shape[0])
+        return np.asarray(_act(actor_params, concat_obs(obs, mlp_keys, n))), policy_state
+
+    return EvalPolicy(act=act)
+
+
+@register_evaluation(algorithms=["sac"])
+def evaluate_sac(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    run_eval_entrypoint(fabric, cfg, state)
 
 
 @register_evaluation(algorithms=["sac_decoupled"])
 def evaluate_sac_decoupled(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
-    evaluate_sac(fabric, cfg, state)
+    run_eval_entrypoint(fabric, cfg, state)

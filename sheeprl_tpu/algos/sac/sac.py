@@ -62,6 +62,7 @@ from sheeprl_tpu.data.staging import RingStaging, make_replay_staging
 from sheeprl_tpu.envs.rollout import BurstActor, JaxRolloutEngine, make_jax_env
 from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.envs.vector.factory import resolve_backend
+from sheeprl_tpu.evals.inrun import maybe_start_inrun_eval
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -353,6 +354,11 @@ def main(fabric, cfg: Dict[str, Any]):
     actor_mirror = HostParamMirror.from_cfg(agent_state["actor"], fabric, cfg)
     play_actor = actor_mirror(agent_state["actor"])
 
+    # in-run eval (howto/evaluation.md): rank 0 publishes the actor through
+    # the policy channel every eval.every_n_steps; a separate process scores
+    # it, so nothing below touches the train-step critical path
+    inrun = maybe_start_inrun_eval(fabric, cfg, log_dir)
+
     train_fn = build_train_fn(
         actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric, action_scale, action_bias, target_entropy,
         state_plan=state_plan, opt_plan=opt_plan,
@@ -585,6 +591,13 @@ def main(fabric, cfg: Dict[str, Any]):
                 aggregator.update("Loss/policy_loss", losses[1])
                 aggregator.update("Loss/alpha_loss", losses[2])
 
+        if inrun is not None and last >= learning_starts and inrun.due(policy_step):
+            # versioned by policy_step; the npz write runs on the publisher's
+            # writer thread, so the cost here is one actor-sized device_get
+            inrun.maybe_publish(
+                policy_step, {"agent": {"actor": jax.device_get(agent_state["actor"])}}
+            )
+
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or last == num_updates
         ):
@@ -630,6 +643,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 # drains the in-flight write) — leave the train loop cleanly
                 break
 
+    if inrun is not None:
+        inrun.close()
     staging.close()
     if envs is not None:
         envs.close()

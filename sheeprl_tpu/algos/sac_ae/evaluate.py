@@ -1,4 +1,6 @@
-"""SAC-AE evaluation entrypoint (reference ``sheeprl/algos/sac_ae/evaluate.py``)."""
+"""SAC-AE evaluation (reference ``sheeprl/algos/sac_ae/evaluate.py``),
+collapsed onto the shared eval service: encoder + actor trunk rebuilt from
+the run config, greedy tanh action on a batch."""
 
 from __future__ import annotations
 
@@ -11,37 +13,45 @@ import numpy as np
 
 from sheeprl_tpu.algos.sac.agent import action_bounds
 from sheeprl_tpu.algos.sac_ae.agent import build_agent
-from sheeprl_tpu.algos.sac_ae.utils import test
-from sheeprl_tpu.envs.vector import make_eval_env
-from sheeprl_tpu.utils.logger import create_tensorboard_logger
+from sheeprl_tpu.algos.sac_ae.utils import normalize_obs_jnp, prepare_obs
+from sheeprl_tpu.evals.service import EvalPolicy, register_eval_builder, run_eval_entrypoint
 from sheeprl_tpu.utils.registry import register_evaluation
 from sheeprl_tpu.utils.utils import params_on_device
 
 
-@register_evaluation(algorithms=["sac_ae"])
-def evaluate_sac_ae(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
-    logger, log_dir = create_tensorboard_logger(cfg)
-    fabric.logger = logger
-    if logger is not None:
-        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-
-    env = make_eval_env(cfg, log_dir)
-    observation_space = env.observation_space
-    action_space = env.action_space
+@register_eval_builder(algorithms=["sac_ae"])
+def sac_ae_eval_policy(fabric, cfg, state, observation_space, action_space) -> EvalPolicy:
     if not isinstance(action_space, gym.spaces.Box):
         raise ValueError("Only continuous action space is supported for the SAC-AE agent")
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+
     act_dim = int(np.prod(action_space.shape))
     action_scale, action_bias = action_bounds(action_space)
-    env.close()
-
-    encoder, decoder, qf, actor_trunk, _ = build_agent(
+    scale = jnp.asarray(action_scale)
+    bias = jnp.asarray(action_bias)
+    encoder, _, _, actor_trunk, _ = build_agent(
         cfg, act_dim, observation_space, jax.random.PRNGKey(cfg.seed)
     )
     params = params_on_device(state["agent"])
-    test(
-        encoder, actor_trunk, params,
-        jnp.asarray(action_scale), jnp.asarray(action_bias),
-        fabric, cfg, log_dir,
-    )
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+
+    @jax.jit
+    def _act(p, obs):
+        feat = encoder.apply({"params": p["encoder"]}, obs)
+        mean, _ = actor_trunk.apply({"params": p["actor"]}, feat)
+        return jnp.tanh(mean) * scale + bias
+
+    def act(obs, policy_state, key):
+        n = int(np.asarray(next(iter(obs.values()))).shape[0])
+        prepared = prepare_obs(obs, cnn_keys, mlp_keys, n)
+        norm = normalize_obs_jnp(prepared, cnn_keys)
+        return np.asarray(_act(params, norm)), policy_state
+
+    return EvalPolicy(act=act)
+
+
+@register_evaluation(algorithms=["sac_ae"])
+def evaluate_sac_ae(fabric, cfg: Dict[str, Any], state: Dict[str, Any]):
+    run_eval_entrypoint(fabric, cfg, state)

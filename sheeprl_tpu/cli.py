@@ -215,12 +215,27 @@ def check_configs(cfg) -> None:
         "ppo_decoupled",
         "dreamer_v1",
         "dreamer_v2",
+        "p2e_dv1_exploration",
+        "p2e_dv1_finetuning",
     ):
         warnings.warn(
             f"env.act_burst={cfg.env.act_burst} is only consumed by the "
-            f"SAC-family/PPO/dreamer-v1/v2 rollout paths (coupled loops and "
-            f"plane players); '{algo_name}' acts per-step "
+            f"SAC-family/PPO/dreamer-v1/v2/P2E-DV1 rollout paths (coupled "
+            f"loops and plane players); '{algo_name}' acts per-step "
             "(howto/rollout_engine.md)",
+            UserWarning,
+        )
+
+    # in-run eval (eval.every_n_steps, sheeprl_tpu/evals/inrun) is wired into
+    # the coupled SAC loop; elsewhere the knob would silently do nothing —
+    # the same silent-ignore trap as env.act_burst above
+    if int((cfg.get("eval", {}) or {}).get("every_n_steps", 0) or 0) > 0 and algo_name not in (
+        "sac",
+    ):
+        warnings.warn(
+            f"eval.every_n_steps={cfg.eval.every_n_steps} is only consumed by "
+            f"the coupled SAC entrypoint for now; '{algo_name}' runs without "
+            "in-run eval (howto/evaluation.md)",
             UserWarning,
         )
 
@@ -513,7 +528,38 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
     ckpt_path = eval_cfg.get("checkpoint_path")
     if not ckpt_path or ckpt_path == "???":
         raise ValueError("You must specify the checkpoint path: checkpoint_path=/path/to/ckpt")
+    if str(ckpt_path).startswith("registry:"):
+        # `registry:best:<algo>:<env id>` → the model registry's best record
+        # (evals/registry.py; deterministic mean/n/append-order resolution)
+        from sheeprl_tpu.evals.registry import ModelRegistry
+
+        parts = str(ckpt_path).split(":")
+        if len(parts) != 4 or parts[1] != "best":
+            raise ValueError(
+                "registry checkpoint refs look like registry:best:<algo>:<env id>, "
+                f"got {ckpt_path!r}"
+            )
+        registry = ModelRegistry(
+            str((eval_cfg.get("eval", {}) or {}).get("registry_dir", "logs/registry"))
+        )
+        record = registry.best(env=parts[3], algo=parts[2])
+        if record is None:
+            raise ValueError(
+                f"no registry record for algo={parts[2]!r} env={parts[3]!r} "
+                f"in {registry.path}"
+            )
+        ckpt_path = record["checkpoint"]
+        print(
+            f"[registry] best {parts[2]} on {parts[3]}: {ckpt_path} "
+            f"(mean {record.get('metrics', {}).get('mean')})"
+        )
     cfg, log_dir = _load_run_config(ckpt_path)
+    # eval-time service knobs come from the eval CLI's composed `eval` group
+    # (the run's persisted knobs configured its own in-run eval, not this
+    # re-score); missing keys fall back to the shipped defaults
+    from sheeprl_tpu.evals.service import eval_settings
+
+    cfg["eval"] = eval_settings(eval_cfg)
 
     cfg.run_name = os.path.join(
         os.path.basename(log_dir), f"evaluation_{np.random.randint(0, 2**16)}"

@@ -1,0 +1,34 @@
+"""Evaluation & model-registry subsystem (howto/evaluation.md).
+
+- :mod:`sheeprl_tpu.evals.service` — the parallel frozen-policy eval
+  service every ``algos/*/evaluate.py`` entrypoint rides.
+- :mod:`sheeprl_tpu.evals.registry` — the append-only ``registry.jsonl``
+  model registry with deterministic ``best(env, algo)`` resolution.
+- :mod:`sheeprl_tpu.evals.inrun` — periodic in-run eval in a separate
+  process, fed by the policy-publication channel (off the critical path).
+"""
+
+from sheeprl_tpu.evals.registry import ModelRegistry, RegistryError
+from sheeprl_tpu.evals.service import (
+    EvalPolicy,
+    EvalService,
+    eval_settings,
+    evaluate_checkpoint,
+    find_eval_builder,
+    iqm,
+    register_eval_builder,
+    run_eval_entrypoint,
+)
+
+__all__ = [
+    "ModelRegistry",
+    "RegistryError",
+    "EvalPolicy",
+    "EvalService",
+    "eval_settings",
+    "evaluate_checkpoint",
+    "find_eval_builder",
+    "iqm",
+    "register_eval_builder",
+    "run_eval_entrypoint",
+]

@@ -1,0 +1,91 @@
+"""Shared eval-policy builder helpers.
+
+The algorithm-specific ``algos/*/evaluate.py`` files register *builders*
+(:func:`~sheeprl_tpu.evals.service.register_eval_builder`) that map a frozen
+checkpoint to one batched greedy act function. The dreamer families (DV1,
+DV2, DV3 and their P2E variants) all share the same player-fns contract —
+``init_states(wm_params, n)`` / ``greedy_action(wm, actor, state, obs, key)``
+over a leading-batch-axis state pytree — so their builders collapse onto
+:func:`dreamer_eval_policy` here and only differ in agent construction and
+pixel normalization.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.evals.service import EvalPolicy
+
+__all__ = ["actions_dim_of", "dreamer_eval_policy"]
+
+
+def actions_dim_of(action_space) -> Tuple[Tuple[int, ...], bool]:
+    """``(actions_dim, is_continuous)`` with the same convention every
+    train entrypoint uses (Box → shape, MultiDiscrete → nvec, Discrete →
+    [n])."""
+    import gymnasium as gym
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    return actions_dim, is_continuous
+
+
+def dreamer_eval_policy(
+    player_fns: Dict[str, Any],
+    params: Dict[str, Any],
+    cfg,
+    is_continuous: bool,
+    sample_actions: bool = False,
+    normalize_fn: Optional[Callable] = None,
+) -> EvalPolicy:
+    """One batched eval policy over a dreamer-family player-fns dict.
+
+    ``params`` must carry ``{"world_model", "actor"}`` (the caller resolves
+    P2E's ``actor_task`` vs ``actor`` split). ``sample_actions=True`` routes
+    through ``exploration_action`` with zero exploration noise — DV3's
+    historical test-time behaviour, where the action is still a sample from
+    the (near-deterministic) policy head rather than its mode.
+    ``normalize_fn(obs, cnn_keys)`` overrides the /255 default (DV1/DV2 use
+    /255 − 0.5).
+    """
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.dreamer_v3.utils import normalize_obs_jnp, prepare_obs
+
+    if normalize_fn is None:
+        normalize_fn = normalize_obs_jnp
+    cnn_keys = list(cfg.cnn_keys.encoder)
+    mlp_keys = list(cfg.mlp_keys.encoder)
+    wm_params = params["world_model"]
+    actor_params = params["actor"]
+    act_fn = player_fns["exploration_action"] if sample_actions else player_fns["greedy_action"]
+
+    def act(obs, state, key):
+        n = int(np.asarray(next(iter(obs.values()))).shape[0])
+        prepared = prepare_obs(obs, cnn_keys, mlp_keys, n)
+        norm = normalize_fn(prepared, cnn_keys)
+        if sample_actions:
+            actions, state = act_fn(
+                wm_params, actor_params, state, norm, key, jnp.float32(0.0)
+            )
+        else:
+            actions, state = act_fn(wm_params, actor_params, state, norm, key)
+        if is_continuous:
+            real = np.concatenate([np.asarray(a) for a in actions], -1)
+        else:
+            real = np.stack(
+                [np.argmax(np.asarray(a), axis=-1) for a in actions], axis=-1
+            )
+        return real, state
+
+    def init_state(n: int):
+        return player_fns["init_states"](wm_params, n)
+
+    return EvalPolicy(act=act, init_state=init_state)

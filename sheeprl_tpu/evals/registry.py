@@ -1,0 +1,170 @@
+"""File-based model registry: an append-only ``registry.jsonl``.
+
+The reference repo's v0.5.x ``model_manager`` answers "which checkpoint is
+the published model for <env>, <algo>?" with a mutable directory tree; this
+registry answers the same question with one append-only JSONL file so that
+(a) concurrent writers never corrupt each other past a torn final line,
+(b) history is never rewritten — every eval round of every run stays
+diffable, and (c) `best()` resolution is a pure fold over the file.
+
+One line per evaluation: ``(run, checkpoint, env, algo, config_hash,
+metrics)`` plus the eval protocol fields the service emits (seeds, n,
+mean/std/iqm). Appends are ``write → flush → fsync`` of a single line, so a
+crash can only tear the *last* line; :meth:`ModelRegistry.scan` tolerates
+exactly that (a torn tail parses as garbage and is skipped, everything
+before it survives).
+
+Config-hash integrity: when the record points at a manifest checkpoint
+(``sheeprl_tpu.ckpt`` layout) whose ``manifest.json`` carries a
+``config_hash``, an append with a *different* hash is rejected — a registry
+row must describe the run that produced the weights, not whatever config
+happened to be composed at eval time (the version-skew trap the SURVEY
+notes about the reference's model manager).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = ["ModelRegistry", "RegistryError", "REGISTRY_SCHEMA", "registry_config_hash"]
+
+#: schema tag stamped on every record (bump on breaking layout changes)
+REGISTRY_SCHEMA = "sheeprl_tpu/registry/v1"
+
+#: fields every record must carry to be appendable
+REQUIRED_FIELDS = ("run", "checkpoint", "env", "algo", "metrics")
+
+
+class RegistryError(RuntimeError):
+    """A record failed validation (missing fields, config-hash mismatch)."""
+
+
+def registry_config_hash(cfg) -> Optional[str]:
+    """The canonical run-config hash — same recipe the checkpoint manager
+    stamps into ``manifest.json`` (ckpt/manager.py), so registry rows and
+    manifests agree byte-for-byte when hashing the same config."""
+    try:
+        import hashlib
+
+        from sheeprl_tpu.config.engine import to_yaml
+
+        return hashlib.sha256(to_yaml(cfg).encode()).hexdigest()[:16]
+    except Exception:
+        return None
+
+
+def _manifest_config_hash(checkpoint: str) -> Optional[str]:
+    """``config_hash`` from a manifest checkpoint dir, else None."""
+    path = os.path.join(str(checkpoint), "manifest.json")
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    value = manifest.get("config_hash") if isinstance(manifest, dict) else None
+    return str(value) if value else None
+
+
+class ModelRegistry:
+    """Append-only JSONL model registry rooted at ``root/registry.jsonl``."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.path = os.path.join(self.root, "registry.jsonl")
+
+    # ------------------------------------------------------------------ write
+
+    def append(self, record: Dict[str, Any], verify: bool = True) -> Dict[str, Any]:
+        """Validate and append one record; returns the stamped record.
+
+        ``verify=True`` cross-checks ``record["config_hash"]`` against the
+        checkpoint's manifest when both exist — mismatch raises
+        :class:`RegistryError` instead of poisoning the file.
+        """
+        rec = dict(record)
+        rec.setdefault("schema", REGISTRY_SCHEMA)
+        missing = [k for k in REQUIRED_FIELDS if not rec.get(k)]
+        if missing:
+            raise RegistryError(f"registry record missing fields: {missing}")
+        metrics = rec.get("metrics")
+        if not isinstance(metrics, dict) or not isinstance(
+            metrics.get("mean"), (int, float)
+        ):
+            raise RegistryError("registry record needs metrics.mean (a number)")
+        if verify:
+            manifest_hash = _manifest_config_hash(rec["checkpoint"])
+            rec_hash = rec.get("config_hash")
+            if manifest_hash and rec_hash and str(rec_hash) != manifest_hash:
+                raise RegistryError(
+                    f"config_hash mismatch for {rec['checkpoint']}: record has "
+                    f"{rec_hash}, manifest says {manifest_hash} — refusing to "
+                    "register eval metrics against weights from a different config"
+                )
+            if manifest_hash and not rec_hash:
+                rec["config_hash"] = manifest_hash
+        line = json.dumps(rec, sort_keys=True, default=float)
+        os.makedirs(self.root, exist_ok=True)
+        # single write + fsync: a crash tears at most this (final) line, which
+        # scan() then skips — all previously fsynced lines stay intact
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        return rec
+
+    # ------------------------------------------------------------------- read
+
+    def scan(self) -> List[Dict[str, Any]]:
+        """All parseable records in append order; torn/garbage lines skipped."""
+        records: List[Dict[str, Any]] = []
+        try:
+            with open(self.path, encoding="utf-8", errors="replace") as f:
+                for raw in f:
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        rec = json.loads(raw)
+                    except json.JSONDecodeError:
+                        continue  # torn tail (or hand-edited garbage)
+                    if isinstance(rec, dict):
+                        records.append(rec)
+        except FileNotFoundError:
+            return []
+        return records
+
+    def records(
+        self, env: Optional[str] = None, algo: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        """Scan filtered by env id and/or algo name."""
+        out = []
+        for rec in self.scan():
+            if env is not None and str(rec.get("env")) != str(env):
+                continue
+            if algo is not None and str(rec.get("algo")) != str(algo):
+                continue
+            out.append(rec)
+        return out
+
+    def best(self, env: str, algo: str) -> Optional[Dict[str, Any]]:
+        """The best record for ``(env, algo)`` — deterministic resolution.
+
+        Ranking: highest ``metrics.mean``; ties broken by larger episode
+        count ``metrics.n`` (more evidence wins); remaining ties by append
+        order (the later record wins — it is the one an operator most
+        recently produced and can regenerate).
+        """
+        best_rec: Optional[Dict[str, Any]] = None
+        best_key = None
+        for idx, rec in enumerate(self.records(env=env, algo=algo)):
+            metrics = rec.get("metrics") or {}
+            mean = metrics.get("mean")
+            if not isinstance(mean, (int, float)):
+                continue
+            n = metrics.get("n")
+            key = (float(mean), int(n) if isinstance(n, (int, float)) else 0, idx)
+            if best_key is None or key > best_key:
+                best_key, best_rec = key, rec
+        return best_rec

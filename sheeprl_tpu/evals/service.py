@@ -1,0 +1,537 @@
+"""Parallel frozen-policy evaluation service.
+
+One evaluation pipeline all algorithm families ride, replacing the twelve
+copy-pasted ``algos/*/evaluate.py`` single-env while-loops:
+
+- **Checkpoint resolution** goes through the ``sheeprl_tpu.ckpt`` manifest
+  layer (``fabric.load`` verifies per-array checksums for manifest
+  checkpoints); the run's persisted config supplies the agent architecture.
+- **Agent rebuild** is a per-family *builder* registered with
+  :func:`register_eval_builder` — the only algorithm-specific code left in
+  an ``evaluate.py`` file. A builder returns an :class:`EvalPolicy`: one
+  batched, jitted act function plus (for recurrent families) an initial
+  state factory.
+- **Episodes run in parallel**: N ≥ 10 deterministic episodes, one env per
+  episode with per-episode seeds ``seed0 + i``, stepped as a single vector
+  pool (sync or the PR-5 async shared-memory pool — ``eval.vectorization``)
+  with **batched policy inference** (SEED-RL shape: one device program per
+  step for the whole episode batch, not one per episode). Each episode's
+  return freezes at its first termination, so pool autoreset never leaks
+  post-episode reward and the same seed yields bitwise-identical returns on
+  any backend.
+- **Artifacts**: a versioned ``eval.json`` (per-episode returns, seeds,
+  config hash, policy version, mean ± std ± IQM — the n≥10 /
+  interquartile-mean protocol of Agarwal et al., NeurIPS 2021) and an
+  append to the model registry (:mod:`sheeprl_tpu.evals.registry`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.utils.utils import dotdict
+
+__all__ = [
+    "EvalPolicy",
+    "EvalService",
+    "register_eval_builder",
+    "find_eval_builder",
+    "registered_eval_builders",
+    "eval_settings",
+    "run_parallel_episodes",
+    "run_eval_entrypoint",
+    "evaluate_checkpoint",
+    "iqm",
+    "EVAL_SCHEMA",
+]
+
+#: schema tag stamped on every eval.json (bump on breaking layout changes)
+EVAL_SCHEMA = "sheeprl_tpu/eval/v1"
+
+#: shipped defaults for the ``eval`` config group — also the fallbacks when
+#: evaluating a checkpoint whose persisted run config predates the group
+_EVAL_DEFAULTS: Dict[str, Any] = {
+    "episodes": 10,
+    "seed0": 1000,
+    "vectorization": None,  # null → inherit env.vectorization / env.sync_env
+    "max_steps": 0,  # 0 → rely on the env's own TimeLimit
+    "every_n_steps": 0,  # 0 → in-run eval off
+    "inrun_episodes": 2,
+    "write_json": True,
+    "write_registry": True,
+    "registry_dir": "logs/registry",
+}
+
+
+# ---------------------------------------------------------------------------
+# builder registry (mirrors utils/registry's evaluation_registry shape)
+# ---------------------------------------------------------------------------
+
+_EVAL_BUILDERS: Dict[str, Callable] = {}
+
+
+def register_eval_builder(algorithms: Sequence[str]):
+    """Class/function decorator: register an eval-policy builder for one or
+    more algorithm names. A builder has the signature
+    ``(fabric, cfg, state, observation_space, action_space) -> EvalPolicy``.
+    """
+
+    def decorator(fn: Callable) -> Callable:
+        for name in algorithms:
+            _EVAL_BUILDERS[str(name)] = fn
+        return fn
+
+    return decorator
+
+
+def find_eval_builder(algo_name: str) -> Optional[Callable]:
+    return _EVAL_BUILDERS.get(str(algo_name))
+
+
+def registered_eval_builders() -> List[str]:
+    return sorted(_EVAL_BUILDERS)
+
+
+@dataclass
+class EvalPolicy:
+    """The frozen agent as the service sees it — family-agnostic.
+
+    ``act(obs, state, key) -> (real_actions, new_state)``: ``obs`` is the
+    raw batched observation dict from the vector pool (leading axis =
+    episode batch), ``real_actions`` a numpy array the pool can step
+    (``reshape((B,) + single_action_space.shape)`` is applied by the
+    runner). ``init_state(n)`` builds the recurrent state for an n-episode
+    batch (None for stateless families). ``reset(state, keep)`` re-seeds
+    finished rows (``keep`` is a bool [B] mask, False = row just finished);
+    when omitted, a generic ``where(keep, state, init_state(n))`` over
+    leading-batch-axis leaves is used.
+    """
+
+    act: Callable[[Dict[str, np.ndarray], Any, Any], Tuple[np.ndarray, Any]]
+    init_state: Optional[Callable[[int], Any]] = None
+    reset: Optional[Callable[[Any, np.ndarray], Any]] = None
+
+
+def eval_settings(cfg) -> dotdict:
+    """The run's ``eval`` knobs with shipped defaults filled in (persisted
+    configs from runs that predate the ``eval`` group compose cleanly)."""
+    merged = dict(_EVAL_DEFAULTS)
+    try:
+        user = cfg.get("eval", {}) or {}
+    except AttributeError:
+        user = {}
+    for key, value in dict(user).items():
+        merged[key] = value
+    return dotdict(merged)
+
+
+def iqm(values: Sequence[float]) -> float:
+    """Interquartile mean: the mean of the middle 50% of episode returns
+    (Agarwal et al. 2021's recommended point estimate — robust to the
+    outlier episodes that dominate plain means at small n)."""
+    x = np.sort(np.asarray(values, dtype=np.float64))
+    n = x.size
+    if n == 0:
+        return float("nan")
+    k = int(np.floor(n * 0.25))
+    trimmed = x[k : n - k] if n - 2 * k > 0 else x
+    return float(trimmed.mean())
+
+
+# ---------------------------------------------------------------------------
+# parallel episode runner
+# ---------------------------------------------------------------------------
+
+
+def _generic_reset(init_state_fn: Callable[[int], Any], n: int):
+    """Default recurrent-state reset: replace finished rows with fresh
+    initial state, assuming every leaf carries the episode batch on axis 0
+    (true for all in-tree families; builders with exotic layouts pass an
+    explicit ``reset``)."""
+    import jax
+
+    def reset(state, keep: np.ndarray):
+        fresh = init_state_fn(n)
+
+        def mask(cur, init):
+            cur_arr = np.asarray(cur)
+            init_arr = np.asarray(init)
+            k = keep.reshape((n,) + (1,) * (cur_arr.ndim - 1))
+            return np.where(k, cur_arr, init_arr)
+
+        return jax.tree.map(mask, state, fresh)
+
+    return reset
+
+
+def run_parallel_episodes(
+    policy: EvalPolicy,
+    pool,
+    seeds: Sequence[int],
+    key,
+    single_action_shape: Tuple[int, ...],
+    max_steps: int = 0,
+    dry_run: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Step the whole episode batch until every episode has terminated once.
+
+    Returns ``(returns, lengths)`` (float64 / int64 arrays, one entry per
+    episode). Episode i's return accumulates only while it is *alive* —
+    frozen at the first ``terminated|truncated`` — so the pool's SAME_STEP
+    autoreset can keep finished slots busy without polluting results, and
+    the figures are independent of which backend stepped the pool.
+    """
+    import jax
+
+    n = len(seeds)
+    obs, _ = pool.reset(seed=[int(s) for s in seeds])
+    state = policy.init_state(n) if policy.init_state is not None else None
+    reset_fn = policy.reset
+    if reset_fn is None and policy.init_state is not None:
+        reset_fn = _generic_reset(policy.init_state, n)
+
+    returns = np.zeros(n, dtype=np.float64)
+    lengths = np.zeros(n, dtype=np.int64)
+    alive = np.ones(n, dtype=bool)
+    steps = 0
+    while alive.any():
+        key, act_key = jax.random.split(key)
+        real_actions, state = policy.act(obs, state, act_key)
+        real_actions = np.asarray(real_actions).reshape((n,) + tuple(single_action_shape))
+        obs, rewards, terminated, truncated, _ = pool.step(real_actions)
+        done = np.logical_or(
+            np.asarray(terminated).reshape(n), np.asarray(truncated).reshape(n)
+        )
+        rewards = np.asarray(rewards, dtype=np.float64).reshape(n)
+        returns += rewards * alive
+        lengths += alive.astype(np.int64)
+        alive &= ~done
+        steps += 1
+        if dry_run or (max_steps and steps >= max_steps):
+            break
+        if done.any() and alive.any() and state is not None and reset_fn is not None:
+            # rows that finished re-enter via autoreset: hand them a fresh
+            # recurrent state (their rewards no longer count, but a stale
+            # state would make the batch composition run-order dependent)
+            state = reset_fn(state, ~done)
+    return returns, lengths
+
+
+# ---------------------------------------------------------------------------
+# pool construction
+# ---------------------------------------------------------------------------
+
+
+def make_eval_pool(cfg, log_dir: Optional[str], n: int, seed0: int, prefix: str = "test"):
+    """One env per episode, wrapped exactly like the train-time factory's
+    envs, vectorized with the configured backend (``eval.vectorization``
+    overrides ``env.vectorization``/``env.sync_env`` for the eval pool
+    only). Video capture, when enabled, follows the factory's gate: episode
+    0 only."""
+    from sheeprl_tpu.envs.vector.factory import vectorize_thunks
+    from sheeprl_tpu.utils.env import make_env
+
+    settings = eval_settings(cfg)
+    pool_cfg = cfg
+    if settings.vectorization is not None:
+        pool_cfg = dotdict(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+        pool_cfg.env.vectorization = settings.vectorization
+    seeds = [int(seed0) + i for i in range(n)]
+    thunks = [
+        make_env(
+            pool_cfg,
+            seeds[i],
+            0,
+            log_dir if i == 0 else None,
+            prefix,
+            vector_env_idx=i,
+        )
+        for i in range(n)
+    ]
+    pool = vectorize_thunks(
+        thunks, pool_cfg, env_seeds_list=seeds, log_dir=log_dir, rank=0
+    )
+    return pool, seeds
+
+
+def _probe_spaces(cfg):
+    """Build one throwaway env to read the observation/action spaces (no
+    log_dir: the probe must never trigger video capture)."""
+    from sheeprl_tpu.envs.vector import make_eval_env
+
+    env = make_eval_env(cfg, None)
+    try:
+        return env.observation_space, env.action_space
+    finally:
+        env.close()
+
+
+def _policy_version_of(checkpoint: Optional[str]) -> Optional[int]:
+    """The checkpoint's training step from its manifest, if resolvable."""
+    if not checkpoint:
+        return None
+    try:
+        from sheeprl_tpu.ckpt.manifest import read_manifest
+
+        step = read_manifest(str(checkpoint)).get("step")
+        return int(step) if step is not None else None
+    except Exception:
+        return None
+
+
+def _config_hash_of(cfg, checkpoint: Optional[str]) -> Optional[str]:
+    """Manifest hash when the checkpoint carries one (authoritative — the
+    eval-time config mutates run_name/fabric and would hash differently),
+    else the canonical hash of the config in hand."""
+    if checkpoint:
+        from sheeprl_tpu.evals.registry import _manifest_config_hash
+
+        manifest_hash = _manifest_config_hash(str(checkpoint))
+        if manifest_hash:
+            return manifest_hash
+    from sheeprl_tpu.evals.registry import registry_config_hash
+
+    return registry_config_hash(cfg)
+
+
+# ---------------------------------------------------------------------------
+# the service
+# ---------------------------------------------------------------------------
+
+
+class EvalService:
+    """Run the frozen-greedy protocol for one policy and emit artifacts."""
+
+    def __init__(self, cfg, log_dir: Optional[str] = None, fabric=None):
+        self.cfg = cfg
+        self.log_dir = log_dir
+        self.fabric = fabric
+        self.settings = eval_settings(cfg)
+
+    def run(
+        self,
+        policy: EvalPolicy,
+        checkpoint: Optional[str] = None,
+        episodes: Optional[int] = None,
+        seed0: Optional[int] = None,
+        prefix: str = "test",
+        write_json: Optional[bool] = None,
+        write_registry: Optional[bool] = None,
+        policy_version: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        import gymnasium as gym
+        import jax
+
+        cfg = self.cfg
+        settings = self.settings
+        n = int(episodes if episodes is not None else settings.episodes)
+        if n < 1:
+            raise ValueError(f"eval.episodes must be >= 1, got {n}")
+        seed0 = int(seed0 if seed0 is not None else settings.seed0)
+
+        pool, seeds = make_eval_pool(cfg, self.log_dir, n, seed0, prefix=prefix)
+        try:
+            single_space = getattr(pool, "single_action_space", None)
+            shape = tuple(single_space.shape) if single_space is not None else ()
+            returns, lengths = run_parallel_episodes(
+                policy,
+                pool,
+                seeds,
+                jax.random.PRNGKey(seed0),
+                shape,
+                max_steps=int(settings.max_steps or 0),
+                dry_run=bool(cfg.get("dry_run", False)),
+            )
+        finally:
+            pool.close()
+
+        if policy_version is None:
+            policy_version = _policy_version_of(checkpoint)
+        result: Dict[str, Any] = {
+            "schema": EVAL_SCHEMA,
+            "algo": str(cfg.algo.name),
+            "env": str(cfg.env.id),
+            "run": str(cfg.get("run_name", "")),
+            "checkpoint": os.path.abspath(str(checkpoint)) if checkpoint else None,
+            "config_hash": _config_hash_of(cfg, checkpoint),
+            "policy_version": policy_version,
+            "protocol": "frozen-greedy",
+            "n": n,
+            "seed0": seed0,
+            "seeds": [int(s) for s in seeds],
+            "returns": [float(r) for r in returns],
+            "lengths": [int(l) for l in lengths],
+            "mean": float(np.mean(returns)),
+            "std": float(np.std(returns)),
+            "iqm": iqm(returns),
+            "min": float(np.min(returns)),
+            "max": float(np.max(returns)),
+        }
+
+        from sheeprl_tpu.obs.counters import add_eval_episodes, add_eval_rounds
+
+        add_eval_rounds(1)
+        add_eval_episodes(n)
+
+        if write_json is None:
+            write_json = bool(settings.write_json)
+        if write_json and self.log_dir:
+            result["path"] = self._write_json(result)
+        if write_registry is None:
+            write_registry = bool(settings.write_registry)
+        if write_registry and result["checkpoint"]:
+            self._append_registry(result)
+        return result
+
+    def _write_json(self, result: Dict[str, Any]) -> str:
+        """Atomic, non-clobbering ``eval.json`` (then ``eval_<k>.json``)."""
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, "eval.json")
+        k = 1
+        while os.path.exists(path):
+            path = os.path.join(self.log_dir, f"eval_{k}.json")
+            k += 1
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    def _append_registry(self, result: Dict[str, Any]) -> None:
+        from sheeprl_tpu.evals.registry import ModelRegistry
+
+        registry = ModelRegistry(str(self.settings.registry_dir))
+        try:
+            registry.append(
+                {
+                    "run": result["run"] or os.path.basename(os.path.dirname(result["checkpoint"])),
+                    "checkpoint": result["checkpoint"],
+                    "env": result["env"],
+                    "algo": result["algo"],
+                    "config_hash": result["config_hash"],
+                    "policy_version": result["policy_version"],
+                    "protocol": result["protocol"],
+                    "seed0": result["seed0"],
+                    "metrics": {
+                        "mean": result["mean"],
+                        "std": result["std"],
+                        "iqm": result["iqm"],
+                        "n": result["n"],
+                    },
+                }
+            )
+        except Exception as exc:  # registry is an artifact, not a gate
+            import warnings
+
+            warnings.warn(f"model-registry append failed: {exc}")
+
+
+# ---------------------------------------------------------------------------
+# entrypoints
+# ---------------------------------------------------------------------------
+
+
+def run_eval_entrypoint(fabric, cfg, state: Dict[str, Any]) -> Dict[str, Any]:
+    """The shared body of every ``algos/*/evaluate.py`` entrypoint: logger,
+    space probe, builder lookup, service run, metric logging."""
+    from sheeprl_tpu.utils.logger import create_tensorboard_logger
+
+    logger, log_dir = create_tensorboard_logger(cfg)
+    fabric.logger = logger
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+
+    builder = find_eval_builder(cfg.algo.name)
+    if builder is None:
+        raise RuntimeError(
+            f"No eval-policy builder registered for '{cfg.algo.name}'. "
+            f"Registered: {registered_eval_builders()}"
+        )
+    observation_space, action_space = _probe_spaces(cfg)
+    policy = builder(fabric, cfg, state, observation_space, action_space)
+
+    service = EvalService(cfg, log_dir=log_dir, fabric=fabric)
+    result = service.run(policy, checkpoint=cfg.get("checkpoint_path"))
+    fabric.print(
+        f"Test - {result['n']} episodes: mean={result['mean']:.2f} "
+        f"std={result['std']:.2f} iqm={result['iqm']:.2f}"
+    )
+    if cfg.metric.log_level > 0 and getattr(fabric, "logger", None) is not None:
+        fabric.logger.log_metrics({"Test/cumulative_reward": result["mean"]}, 0)
+    return result
+
+
+def evaluate_checkpoint(
+    checkpoint_path: str,
+    episodes: Optional[int] = None,
+    seed0: Optional[int] = None,
+    write_json: bool = False,
+    write_registry: Optional[bool] = None,
+    registry_dir: Optional[str] = None,
+    capture_video: bool = False,
+    vectorization: Optional[str] = None,
+    state: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Standalone service entry: checkpoint path in, eval result out.
+
+    Used by ``tools/bench_matrix.py`` cells, the in-run eval child process
+    (which passes published ``state`` directly), and ad-hoc re-scoring. The
+    run's persisted config supplies the agent; fabric is forced to one
+    device like the eval CLI.
+    """
+    import jax
+
+    import sheeprl_tpu
+    from sheeprl_tpu.cli import _load_run_config
+    from sheeprl_tpu.config.instantiate import instantiate
+
+    sheeprl_tpu.register_algorithms()
+    cfg, log_dir = _load_run_config(checkpoint_path)
+    cfg.env.capture_video = bool(capture_video)
+    eval_cfg = eval_settings(cfg)
+    if vectorization is not None:
+        eval_cfg.vectorization = vectorization
+    if registry_dir is not None:
+        eval_cfg.registry_dir = registry_dir
+    cfg["eval"] = eval_cfg
+    run_fabric = cfg.get("fabric", {}) or {}
+    cfg.fabric = dotdict(
+        {
+            "_target_": "sheeprl_tpu.fabric.Fabric",
+            "devices": 1,
+            "num_nodes": 1,
+            "strategy": "auto",
+            "accelerator": "auto",
+            "precision": run_fabric.get("precision", "32-true"),
+            "prng_impl": run_fabric.get("prng_impl", "rbg"),
+            "callbacks": [],
+        }
+    )
+    fabric = instantiate(cfg.fabric)
+    if state is None:
+        state = fabric.load(checkpoint_path)
+
+    builder = find_eval_builder(cfg.algo.name)
+    if builder is None:
+        raise RuntimeError(
+            f"No eval-policy builder registered for '{cfg.algo.name}'. "
+            f"Registered: {registered_eval_builders()}"
+        )
+    observation_space, action_space = _probe_spaces(cfg)
+    policy = builder(fabric, cfg, state, observation_space, action_space)
+    service = EvalService(cfg, log_dir=log_dir if write_json else None, fabric=fabric)
+    return service.run(
+        policy,
+        checkpoint=checkpoint_path,
+        episodes=episodes,
+        seed0=seed0,
+        write_json=write_json,
+        write_registry=write_registry,
+    )

@@ -151,6 +151,13 @@ class Counters:
         # tier was auto-degraded at agent-build time (pallas on a non-TPU
         # backend, or a family with no pallas kernel yet)
         self.kernel_tier_degraded = 0
+        # evaluation subsystem (sheeprl_tpu/evals): service rounds and
+        # episodes run in this process, plus in-run eval policy publications
+        # (the async channel feeding the separate eval process — the eval
+        # episodes themselves run over there, never in the trainer)
+        self.eval_rounds = 0
+        self.eval_episodes = 0
+        self.inrun_eval_publishes = 0
 
     def add(self, field: str, amount) -> None:
         with self._lock:
@@ -210,6 +217,9 @@ class Counters:
                 "opt_state_bytes_per_device": self.opt_state_bytes_per_device,
                 "model_axis_size": self.model_axis_size,
                 "kernel_tier_degraded": self.kernel_tier_degraded,
+                "eval_rounds": self.eval_rounds,
+                "eval_episodes": self.eval_episodes,
+                "inrun_eval_publishes": self.inrun_eval_publishes,
                 "comms_ops": self.comms_ops,
                 "comms_bytes": self.comms_bytes,
                 "comms_ms": round(self.comms_ms, 3),
@@ -461,6 +471,33 @@ def add_ckpt_write(ms: float, nbytes: int, failed: bool = False) -> None:
                 c.ckpt_failures += 1
             else:
                 c.ckpt_saves += 1
+
+
+# -- evaluation accounting --------------------------------------------------
+
+
+def add_eval_rounds(n: int = 1) -> None:
+    """Record ``n`` eval-service rounds run in this process (evals/service)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.eval_rounds += int(n)
+
+
+def add_eval_episodes(n: int) -> None:
+    """Record ``n`` frozen-policy eval episodes completed (evals/service)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.eval_episodes += int(n)
+
+
+def add_inrun_eval_publishes(n: int = 1) -> None:
+    """Record ``n`` in-run eval policy publications (evals/inrun)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.inrun_eval_publishes += int(n)
 
 
 # -- recompile accounting ---------------------------------------------------

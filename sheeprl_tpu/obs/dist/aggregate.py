@@ -82,6 +82,9 @@ SUMMED_RANK_COUNTERS = (
     "comms_bytes",
     "comms_ms",
     "flight_dumps",
+    "eval_rounds",
+    "eval_episodes",
+    "inrun_eval_publishes",
 )
 
 

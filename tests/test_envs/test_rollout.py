@@ -564,6 +564,52 @@ def test_p2e_dv3_exploration_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatc
     _assert_ckpt_bitwise(tmp_path, "pk1", "pk4", written=8)
 
 
+@pytest.mark.slow
+def test_p2e_dv1_exploration_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """P2E-DV1 exploration equivalence: same carry layout as DreamerV1
+    (zero reset states applied host-side), exploration actor fed per
+    rollout — act_burst=4 reproduces the per-step run bitwise end-to-end.
+    Slow-marked: two full ensemble-training e2e runs."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    extras = ["algo.ensembles.n=2"]
+    cli.run(_dreamer_burst_args(tmp_path, "p2e_dv1_exploration", "ek1", extras))
+    cli.run(
+        _dreamer_burst_args(
+            tmp_path, "p2e_dv1_exploration", "ek4", extras + ["env.act_burst=4"]
+        )
+    )
+    _assert_ckpt_bitwise(tmp_path, "ek1", "ek4", written=8)
+
+
+@pytest.mark.slow
+def test_p2e_dv1_finetuning_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """P2E-DV1 finetuning equivalence: the converted loop clamps every burst
+    to the exploration→task actor switch at ``learning_starts`` (no burst may
+    span the swap) and never enters the random phase (resuming plan), so
+    act_burst=4 from the same exploration checkpoint reproduces the per-step
+    finetuning run bitwise end-to-end. Slow-marked: three e2e runs
+    (exploration seed + two finetunings)."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    extras = ["algo.ensembles.n=2"]
+    cli.run(_dreamer_burst_args(tmp_path, "p2e_dv1_exploration", "fe", extras))
+    expl = sorted(
+        glob.glob(f"{tmp_path}/logs/**/fe/**/checkpoint/ckpt_*_0", recursive=True)
+    )
+    assert expl, "no exploration checkpoint written"
+    fine = [f"checkpoint.exploration_ckpt_path={os.path.abspath(expl[-1])}"]
+    cli.run(_dreamer_burst_args(tmp_path, "p2e_dv1_finetuning", "fk1", fine))
+    cli.run(
+        _dreamer_burst_args(
+            tmp_path, "p2e_dv1_finetuning", "fk4", fine + ["env.act_burst=4"]
+        )
+    )
+    _assert_ckpt_bitwise(tmp_path, "fk1", "fk4", written=8)
+
+
 def test_dreamer_v2_fused_xla_bitwise_off_e2e(tmp_path, monkeypatch):
     """The fused-kernel knob (ISSUE 13) must not change a single bit of a
     DV2 run on CPU: ``algo.fused_kernels=xla`` resolves to ``pad_to=1``

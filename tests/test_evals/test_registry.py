@@ -1,0 +1,111 @@
+"""Model-registry unit tests: append-only JSONL semantics, torn-line
+tolerance, deterministic ``best()`` resolution, and the config-hash
+integrity gate (sheeprl_tpu/evals/registry.py)."""
+
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.evals.registry import REGISTRY_SCHEMA, ModelRegistry, RegistryError
+
+
+def _rec(run="r1", ckpt="/tmp/nonexistent/ckpt_1_0", env="E", algo="A", mean=1.0, n=10, **extra):
+    rec = {
+        "run": run,
+        "checkpoint": ckpt,
+        "env": env,
+        "algo": algo,
+        "metrics": {"mean": mean, "std": 0.0, "iqm": mean, "n": n},
+    }
+    rec.update(extra)
+    return rec
+
+
+def test_append_rescan_roundtrip(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    a = reg.append(_rec(run="a", mean=1.5))
+    b = reg.append(_rec(run="b", mean=2.5))
+    assert a["schema"] == REGISTRY_SCHEMA
+    got = reg.scan()
+    assert [r["run"] for r in got] == ["a", "b"]
+    assert got[1]["metrics"]["mean"] == 2.5
+    # a second handle over the same root sees the same records
+    assert [r["run"] for r in ModelRegistry(str(tmp_path)).scan()] == ["a", "b"]
+
+
+def test_scan_tolerates_torn_final_line(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    reg.append(_rec(run="a"))
+    reg.append(_rec(run="b"))
+    # simulate a crash mid-append: a torn, unparseable final line
+    with open(reg.path, "a") as f:
+        f.write('{"run": "torn", "checkpoint": "/x", "met')
+    got = reg.scan()
+    assert [r["run"] for r in got] == ["a", "b"]
+    # the registry stays appendable after the tear — but a bare append would
+    # concatenate onto the torn line; the class fsyncs whole lines only, so
+    # the next line starts clean once a newline terminates the tear
+    with open(reg.path, "a") as f:
+        f.write("\n")
+    reg.append(_rec(run="c"))
+    assert [r["run"] for r in reg.scan()] == ["a", "b", "c"]
+
+
+def test_scan_missing_file_is_empty(tmp_path):
+    assert ModelRegistry(str(tmp_path / "nope")).scan() == []
+
+
+def test_append_rejects_missing_fields(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    bad = _rec()
+    del bad["checkpoint"]
+    with pytest.raises(RegistryError, match="missing fields"):
+        reg.append(bad)
+    with pytest.raises(RegistryError, match="metrics.mean"):
+        reg.append(_rec(mean="not-a-number"))
+    assert reg.scan() == []  # failed validation never touches the file
+
+
+def test_best_resolution_and_tie_breaking(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    reg.append(_rec(run="low", env="E", algo="A", mean=1.0, n=10))
+    reg.append(_rec(run="high", env="E", algo="A", mean=3.0, n=10))
+    reg.append(_rec(run="other-env", env="F", algo="A", mean=99.0, n=10))
+    reg.append(_rec(run="other-algo", env="E", algo="B", mean=99.0, n=10))
+    assert reg.best("E", "A")["run"] == "high"
+    # mean tie: larger episode count (more evidence) wins
+    reg.append(_rec(run="tie-small-n", env="T", algo="A", mean=5.0, n=5))
+    reg.append(_rec(run="tie-big-n", env="T", algo="A", mean=5.0, n=20))
+    assert reg.best("T", "A")["run"] == "tie-big-n"
+    # full tie: the later append wins (most recently regenerated)
+    reg.append(_rec(run="tie-late", env="T", algo="A", mean=5.0, n=20))
+    assert reg.best("T", "A")["run"] == "tie-late"
+    assert reg.best("missing", "A") is None
+
+
+def test_config_hash_mismatch_rejected(tmp_path):
+    ckpt = tmp_path / "ckpt_64_0"
+    ckpt.mkdir()
+    (ckpt / "manifest.json").write_text(json.dumps({"config_hash": "aaaa1111"}))
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    with pytest.raises(RegistryError, match="config_hash mismatch"):
+        reg.append(_rec(ckpt=str(ckpt), config_hash="bbbb2222"))
+    assert reg.scan() == []
+    # matching hash appends fine; a record WITHOUT a hash adopts the manifest's
+    reg.append(_rec(run="match", ckpt=str(ckpt), config_hash="aaaa1111"))
+    adopted = reg.append(_rec(run="adopt", ckpt=str(ckpt)))
+    assert adopted["config_hash"] == "aaaa1111"
+    assert [r["run"] for r in reg.scan()] == ["match", "adopt"]
+    # verify=False skips the cross-check (ad-hoc/no-manifest flows)
+    reg.append(_rec(run="unverified", ckpt=str(ckpt), config_hash="cccc3333"), verify=False)
+    assert reg.scan()[-1]["run"] == "unverified"
+
+
+def test_iqm_trims_quartiles():
+    from sheeprl_tpu.evals.service import iqm
+
+    # 8 values: floor(8*0.25)=2 trimmed each end -> mean of the middle 4
+    vals = [100.0, 1.0, 2.0, 3.0, 4.0, -100.0, 2.0, 3.0]
+    assert iqm(vals) == pytest.approx((2.0 + 2.0 + 3.0 + 3.0) / 4.0)
+    assert iqm([5.0]) == pytest.approx(5.0)

@@ -31,17 +31,17 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 #: units where a larger value is a better result
-HIGHER_BETTER_UNITS = ("steps/s", "it/s", "fps")
+HIGHER_BETTER_UNITS = ("steps/s", "it/s", "fps", "return")
 
 
-def find_rounds(repo: str) -> List[str]:
-    """BENCH_r*.json sorted by round number (ascending)."""
+def find_rounds(repo: str, prefix: str = "BENCH") -> List[str]:
+    """<prefix>_r*.json sorted by round number (ascending)."""
 
     def round_no(path: str) -> int:
-        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        m = re.search(rf"{re.escape(prefix)}_r(\d+)\.json$", path)
         return int(m.group(1)) if m else -1
 
-    return sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")), key=round_no)
+    return sorted(glob.glob(os.path.join(repo, f"{prefix}_r*.json")), key=round_no)
 
 
 def parse_round(path: str) -> Dict[str, Dict[str, Any]]:
@@ -69,9 +69,17 @@ def goodness_change(old: Dict[str, Any], new: Dict[str, Any]) -> Optional[float]
     exactly a 10% slowdown (for seconds: ``new = 1.1 × old``) — the
     threshold semantics the CI step documents."""
     ov, nv = old.get("value"), new.get("value")
-    if not isinstance(ov, (int, float)) or not isinstance(nv, (int, float)) or ov <= 0:
+    if not isinstance(ov, (int, float)) or not isinstance(nv, (int, float)):
         return None
     unit = str(new.get("unit", old.get("unit", "")))
+    if unit == "return":
+        # episode returns are signed (Pendulum lives near -1300), so the
+        # relative change is anchored on |old|
+        if abs(ov) < 1e-9:
+            return None
+        return (nv - ov) / abs(ov)
+    if ov <= 0:
+        return None
     if unit in HIGHER_BETTER_UNITS:
         return nv / ov - 1.0
     return 1.0 - nv / ov
@@ -192,11 +200,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         default=0.10,
         help="relative slowdown that counts as a regression (default 0.10)",
     )
+    parser.add_argument(
+        "--prefix",
+        default="BENCH",
+        help="round-file prefix to diff, e.g. MATRIX for MATRIX_r*.json (default BENCH)",
+    )
     args = parser.parse_args(argv)
 
-    rounds = find_rounds(args.dir)
+    rounds = find_rounds(args.dir, args.prefix)
     if len(rounds) < 2:
-        print(f"bench-compare: need two BENCH_r*.json rounds, found {len(rounds)} — nothing to diff")
+        print(
+            f"bench-compare: need two {args.prefix}_r*.json rounds, "
+            f"found {len(rounds)} — nothing to diff"
+        )
         return 0
     old_path, new_path = rounds[-2], rounds[-1]
     old_lines, new_lines = parse_round(old_path), parse_round(new_path)

@@ -1,0 +1,336 @@
+#!/usr/bin/env python
+"""Regenerating multi-env benchmark matrix: train × eval cells, committed
+as ``MATRIX_r<k>.json`` rounds that ``tools/bench_compare.py --prefix
+MATRIX`` diffs for return regressions.
+
+Each cell trains a fresh agent from scratch in a subprocess
+(``python -m sheeprl_tpu exp=<algo> env.id=<env> ...``), then scores the
+final checkpoint through the eval service (``evaluate_checkpoint``:
+frozen-greedy, n parallel deterministic episodes, fixed seed ladder) and
+emits one JSON evidence line::
+
+    {"metric": "matrix.<algo>.<env>", "value": <mean return>,
+     "unit": "return", "n": 10, "std": ..., "iqm": ..., "returns": [...]}
+
+The round document mirrors the ``BENCH_r<k>.json`` shape (``tail`` holds
+the evidence lines) so ``bench_compare.py`` parses it unchanged; the
+``return`` unit is higher-better there, anchored on ``|old|`` because
+returns are signed. Same seeds + same training config ⇒ the eval side is
+bitwise deterministic, so cell drift isolates *training* changes.
+
+Modes::
+
+    python tools/bench_matrix.py                  # full matrix (5 envs x 2 algos)
+    python tools/bench_matrix.py --quick          # 2-env x 2-algo CI smoke
+    python tools/bench_matrix.py --offpath-check  # SAC in-run-eval p95 evidence
+
+``--offpath-check`` trains the same SAC run twice — in-run eval off, then
+on (``eval.every_n_steps>0``) — and reports both runs' train-phase p95
+(``phase_percentiles["Time/train_time"]`` from telemetry.json) plus the
+eval child's publish count: the in-run evaluator lives in a separate
+process fed by the policy-publish channel, so the train-step tail must not
+move when it is enabled.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import shlex
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/bench_matrix.py` puts tools/ first
+    sys.path.insert(0, REPO)
+
+#: (algo, env id) cells. PPO covers the classic-control suite (discrete and
+#: continuous); SAC covers the continuous half. Both are fast enough on CPU
+#: to retrain every round — the matrix *regenerates*, it is not a cache.
+FULL_CELLS: List[Tuple[str, str]] = [
+    ("ppo", "CartPole-v1"),
+    ("ppo", "Acrobot-v1"),
+    ("ppo", "MountainCar-v0"),
+    ("ppo", "Pendulum-v1"),
+    ("ppo", "MountainCarContinuous-v0"),
+    ("sac", "Pendulum-v1"),
+    ("sac", "MountainCarContinuous-v0"),
+    ("sac", "LunarLanderContinuous-v3"),
+]
+
+#: CI smoke subset: 2 envs × 2 algos, one discrete + one continuous
+QUICK_CELLS: List[Tuple[str, str]] = [
+    ("ppo", "CartPole-v1"),
+    ("ppo", "Pendulum-v1"),
+    ("sac", "Pendulum-v1"),
+    ("sac", "MountainCarContinuous-v0"),
+]
+
+#: overrides shared by every training cell: telemetry-only metrics, no
+#: video, sync envs (deterministic collection), final checkpoint only
+COMMON_OVERRIDES = [
+    "metric=telemetry",
+    "env.capture_video=False",
+    "env.sync_env=True",
+    "checkpoint.every=0",
+    "checkpoint.save_last=True",
+    "algo.run_test=False",
+]
+
+
+def _run_id(algo: str, env_id: str) -> str:
+    return f"{algo}__{re.sub(r'[^A-Za-z0-9_-]', '_', env_id)}"
+
+
+def train_cell(
+    algo: str,
+    env_id: str,
+    workdir: str,
+    total_steps: int,
+    seed: int,
+    extra: Sequence[str] = (),
+    run_id: Optional[str] = None,
+) -> Tuple[str, float, int]:
+    """Train one cell in a subprocess; return (run_dir, wall_s, returncode)."""
+    run_id = run_id or _run_id(algo, env_id)
+    args = [
+        sys.executable,
+        "-m",
+        "sheeprl_tpu",
+        f"exp={algo}",
+        f"env.id={env_id}",
+        f"total_steps={total_steps}",
+        f"seed={seed}",
+        f"root_dir=matrix/{algo}",
+        f"exp_name={run_id}",
+        *COMMON_OVERRIDES,
+        *extra,
+    ]
+    # the training run's cwd is the scratch dir; make the repo importable
+    # there even when sheeprl_tpu is used from a checkout, not installed
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.monotonic()
+    proc = subprocess.run(args, cwd=workdir, capture_output=True, text=True, env=env)
+    wall = time.monotonic() - t0
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout[-4000:] + proc.stderr[-4000:])
+    pattern = os.path.join(workdir, "logs", "runs", "matrix", algo, f"*_{run_id}_*")
+    runs = sorted(glob.glob(pattern))
+    return (runs[-1] if runs else ""), wall, proc.returncode
+
+
+def last_checkpoint(run_dir: str) -> Optional[str]:
+    """Newest ``ckpt_<step>_0`` under the run dir (by step number)."""
+
+    def step_of(path: str) -> int:
+        m = re.search(r"ckpt_(\d+)_\d+$", path)
+        return int(m.group(1)) if m else -1
+
+    ckpts = sorted(
+        glob.glob(os.path.join(run_dir, "**", "checkpoint", "ckpt_*"), recursive=True),
+        key=step_of,
+    )
+    return ckpts[-1] if ckpts else None
+
+
+def eval_cell(ckpt: str, episodes: int, seed0: int, registry_dir: Optional[str]) -> Dict[str, Any]:
+    from sheeprl_tpu.evals.service import evaluate_checkpoint
+
+    return evaluate_checkpoint(
+        ckpt,
+        episodes=episodes,
+        seed0=seed0,
+        write_json=False,
+        write_registry=registry_dir is not None,
+        registry_dir=registry_dir,
+    )
+
+
+def run_matrix(args) -> Tuple[List[Dict[str, Any]], int]:
+    cells = QUICK_CELLS if args.quick else FULL_CELLS
+    lines: List[Dict[str, Any]] = []
+    failures = 0
+    for algo, env_id in cells:
+        metric = f"matrix.{algo}.{env_id}"
+        print(f"[bench-matrix] {metric}: training {args.total_steps} steps ...", flush=True)
+        run_dir, train_s, rc = train_cell(
+            algo, env_id, args.workdir, args.total_steps, args.seed
+        )
+        ckpt = last_checkpoint(run_dir) if run_dir else None
+        if rc != 0 or not ckpt:
+            failures += 1
+            lines.append(
+                {
+                    "metric": metric,
+                    "skipped": f"training failed (rc={rc}, ckpt={'yes' if ckpt else 'no'})",
+                    "unit": "return",
+                }
+            )
+            continue
+        t0 = time.monotonic()
+        result = eval_cell(ckpt, args.episodes, args.seed0, args.registry_dir)
+        eval_s = time.monotonic() - t0
+        line = {
+            "metric": metric,
+            "value": round(result["mean"], 4),
+            "unit": "return",
+            "n": result["n"],
+            "std": round(result["std"], 4),
+            "iqm": round(result["iqm"], 4),
+            "min": round(result["min"], 4),
+            "max": round(result["max"], 4),
+            "returns": [round(r, 4) for r in result["returns"]],
+            "seed0": result["seed0"],
+            "train_steps": args.total_steps,
+            "train_seed": args.seed,
+            "config_hash": result.get("config_hash"),
+            "policy_version": result.get("policy_version"),
+            "train_s": round(train_s, 1),
+            "eval_s": round(eval_s, 1),
+        }
+        lines.append(line)
+        print(f"[bench-matrix] {json.dumps(line)}", flush=True)
+    return lines, failures
+
+
+def _train_phase_p95(run_dir: str) -> Optional[float]:
+    tel = glob.glob(os.path.join(run_dir, "**", "telemetry.json"), recursive=True)
+    if not tel:
+        return None
+    doc = json.load(open(sorted(tel)[-1]))
+    phase = (doc.get("phase_percentiles") or {}).get("Time/train_time") or {}
+    return phase.get("p95_ms")
+
+
+def _telemetry_counter(run_dir: str, key: str) -> int:
+    tel = glob.glob(os.path.join(run_dir, "**", "telemetry.json"), recursive=True)
+    if not tel:
+        return 0
+    return int(json.load(open(sorted(tel)[-1])).get(key, 0) or 0)
+
+
+def run_offpath_check(args) -> Tuple[List[Dict[str, Any]], int]:
+    """Train-phase p95 with in-run eval ON vs OFF — the off-critical-path
+    evidence behind ``eval.every_n_steps`` (howto/evaluation.md)."""
+    algo, env_id = "sac", "Pendulum-v1"
+    extra_off: List[str] = []
+    extra_on = [
+        f"eval.every_n_steps={max(args.total_steps // 4, 1)}",
+        "eval.inrun_episodes=2",
+    ]
+    rows = {}
+    failures = 0
+    for tag, extra in (("off", extra_off), ("on", extra_on)):
+        print(f"[bench-matrix] offpath {tag}: training {args.total_steps} steps ...", flush=True)
+        run_dir, wall, rc = train_cell(
+            algo, env_id, args.workdir, args.total_steps, args.seed,
+            extra=extra, run_id=f"offpath_{tag}",
+        )
+        if rc != 0 or not run_dir:
+            failures += 1
+            continue
+        rows[tag] = {
+            "run_dir": run_dir,
+            "p95": _train_phase_p95(run_dir),
+            "publishes": _telemetry_counter(run_dir, "inrun_eval_publishes"),
+            "wall_s": round(wall, 1),
+        }
+    lines: List[Dict[str, Any]] = []
+    if "off" in rows and "on" in rows and rows["off"]["p95"] and rows["on"]["p95"]:
+        line = {
+            "metric": f"eval.offpath.{algo}",
+            "value": rows["on"]["p95"],
+            "unit": "ms",
+            "baseline_p95_ms": rows["off"]["p95"],
+            "ratio": round(rows["on"]["p95"] / rows["off"]["p95"], 3),
+            "inrun_eval_publishes": rows["on"]["publishes"],
+            "train_steps": args.total_steps,
+            "wall_on_s": rows["on"]["wall_s"],
+            "wall_off_s": rows["off"]["wall_s"],
+        }
+        lines.append(line)
+        print(f"[bench-matrix] {json.dumps(line)}", flush=True)
+    else:
+        failures += 1
+    return lines, failures
+
+
+def next_round(out_dir: str, prefix: str) -> int:
+    rounds = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(out_dir, f"{prefix}_r*.json"))
+        if (m := re.search(rf"{prefix}_r(\d+)\.json$", p))
+    ]
+    return (max(rounds) + 1) if rounds else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--quick", action="store_true", help="2-env x 2-algo CI smoke subset")
+    parser.add_argument(
+        "--offpath-check",
+        action="store_true",
+        help="SAC in-run-eval train-p95 evidence instead of the return matrix",
+    )
+    parser.add_argument("--total-steps", type=int, default=4096, dest="total_steps")
+    parser.add_argument("--episodes", type=int, default=10, help="eval episodes per cell (n)")
+    parser.add_argument("--seed", type=int, default=5, help="training seed")
+    parser.add_argument("--seed0", type=int, default=1000, help="first eval episode seed")
+    parser.add_argument(
+        "--workdir",
+        default=None,
+        help="scratch dir for training runs (default: <out-dir>/.matrix_runs)",
+    )
+    parser.add_argument("--out-dir", default=REPO, dest="out_dir")
+    parser.add_argument("--round", type=int, default=None, help="round number (default: next)")
+    parser.add_argument(
+        "--registry-dir",
+        default=None,
+        dest="registry_dir",
+        help="also append each cell's score to this model registry",
+    )
+    parser.add_argument(
+        "--no-write", action="store_true", help="print evidence lines only, no round file"
+    )
+    args = parser.parse_args(argv)
+
+    if args.workdir is None:
+        args.workdir = os.path.join(args.out_dir, ".matrix_runs")
+    os.makedirs(args.workdir, exist_ok=True)
+
+    prefix = "MATRIX"
+    t0 = time.monotonic()
+    if args.offpath_check:
+        lines, failures = run_offpath_check(args)
+        prefix = "EVAL_OFFPATH"
+    else:
+        lines, failures = run_matrix(args)
+    wall = time.monotonic() - t0
+
+    doc = {
+        "n": args.round if args.round is not None else next_round(args.out_dir, prefix),
+        "cmd": shlex.join([os.path.basename(sys.executable), "tools/bench_matrix.py", *(argv or sys.argv[1:])]),
+        "rc": 1 if failures else 0,
+        "schema": "sheeprl_tpu/matrix/v1",
+        "wall_s": round(wall, 1),
+        "cells": len(lines),
+        "tail": "\n".join(json.dumps(line) for line in lines),
+    }
+    if args.no_write:
+        print(json.dumps(doc, indent=1))
+    else:
+        path = os.path.join(args.out_dir, f"{prefix}_r{doc['n']:02d}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"[bench-matrix] wrote {path} ({doc['cells']} cells, {doc['wall_s']}s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Eval-uniformity lint: evaluation rides the eval service, not ad-hoc loops.
+
+The eval subsystem (``sheeprl_tpu/evals``, howto/evaluation.md) exists so
+every algorithm scores checkpoints the same way: one parallel frozen-greedy
+protocol (``EvalService``/``run_parallel_episodes``), one manifest-aware
+checkpoint resolution path, one versioned ``eval.json`` artifact, one
+registry append. The anti-patterns it replaced are mechanical::
+
+    while not done:                # hand-rolled single-episode loop
+        obs, r, done, ... = env.step(action)
+
+    state = fabric.load(ckpt)      # raw checkpoint load inside evaluate.py
+
+This lint walks every ``algos/*/evaluate.py`` and flags:
+
+1. an env-step loop — any ``For``/``While`` whose body calls ``*.step(...)``
+   (episode stepping belongs to ``run_parallel_episodes``);
+2. a raw checkpoint load — any call to ``*.load(...)``/``np.load``/
+   ``pickle.load`` (entrypoints receive ``state`` from the CLI, which is the
+   only place checkpoint resolution/migration lives).
+
+AST-based; comments/docstrings are fine. Usage: ``python
+tools/lint_eval.py`` — non-zero exit with findings on violation. Wired into
+the CI tier-1 lane (.github/workflows/tests.yml) next to lint_rollout.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
+
+_LOAD_NAMES = {"load", "load_checkpoint", "restore"}
+
+
+def _calls(node: ast.AST):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def _attr_name(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def check_file(path: str) -> List[str]:
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    rel = os.path.relpath(path, REPO)
+    findings: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            for call in _calls(node):
+                if _attr_name(call) == "step" and isinstance(call.func, ast.Attribute):
+                    findings.append(
+                        f"{rel}:{call.lineno}: env-step loop in an evaluate entrypoint — "
+                        "episode stepping belongs to the eval service "
+                        "(sheeprl_tpu/evals/service.py run_parallel_episodes)"
+                    )
+    for call in _calls(tree):
+        if _attr_name(call) in _LOAD_NAMES:
+            findings.append(
+            f"{rel}:{call.lineno}: raw checkpoint load in an evaluate entrypoint — "
+                "entrypoints receive the resolved state from the CLI "
+                "(cli.py evaluation / evals.service.evaluate_checkpoint)"
+            )
+    return findings
+
+
+def main() -> int:
+    files = sorted(glob.glob(os.path.join(ALGOS_DIR, "*", "evaluate.py")))
+    if not files:
+        print("eval-uniformity lint: no algos/*/evaluate.py files found", file=sys.stderr)
+        return 2
+    findings: List[str] = []
+    for path in files:
+        findings.extend(check_file(path))
+    if findings:
+        print("eval-uniformity lint FAILED:")
+        for f in findings:
+            print(f"  {f}")
+        return 1
+    print(f"eval-uniformity lint OK ({len(files)} evaluate entrypoints ride the eval service)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

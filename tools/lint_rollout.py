@@ -48,11 +48,12 @@ ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
 #: masking still host-side); dreamer_v3 and p2e_dv3_exploration followed
 #: (RSSM player state rides the burst obs-carry pytree; DV3's
 #: params-dependent episode-reset state is applied host-side against a
-#: fresh-state copy cached per params version). Keep in sync with
-#: howto/rollout_engine.md's support matrix.
+#: fresh-state copy cached per params version); p2e_dv1 exploration and
+#: finetuning followed (same carry layout as dreamer_v1; finetuning clamps
+#: each burst to the exploration→task actor switch at learning_starts so no
+#: burst spans the swap). Keep in sync with howto/rollout_engine.md's
+#: support matrix.
 GRANDFATHERED = {
-    "p2e_dv1/p2e_dv1_exploration.py",
-    "p2e_dv1/p2e_dv1_finetuning.py",
     "p2e_dv2/p2e_dv2_exploration.py",
     "p2e_dv2/p2e_dv2_finetuning.py",
     "p2e_dv3/p2e_dv3_finetuning.py",
