@@ -100,14 +100,22 @@ def _remaining() -> float:
 
 
 def _skip_line(metric: str, need_s: float) -> str:
+    # On a single-core host the minutes-long device stages are not merely
+    # over-budget — they structurally cannot run (one core serves the env
+    # loop, the XLA compile, and the dispatch pump at once), so the skip is
+    # disclosed as "host-bound" instead of the generic budget marker:
+    # bench_compare reads the round as "this host can't measure it", not
+    # "the stage regressed to nothing".
+    host_bound = (os.cpu_count() or 1) < 2
     return json.dumps(
         {
             "metric": metric,
             "value": None,
-            "skipped": "budget",
+            "skipped": "host-bound" if host_bound else "budget",
             "need_s": round(need_s, 1),
             "remaining_s": round(max(_remaining(), 0.0), 1),
             "wall_budget_s": WALL_BUDGET_S,
+            "host_cores": os.cpu_count() or 1,
         }
     )
 
@@ -271,6 +279,18 @@ def _phase_tails(tel) -> dict:
         out["train_dispatches_per_step"] = round(
             tel["train_dispatches"] / bursts_steps, 3
         )
+    # learning-health plane (obs/learn): the training-dynamics tails next to
+    # the wall-clock — a perf win bought by destabilizing the optimizer
+    # (grad_norm_p95 drifting up round over round, warn/critical events
+    # appearing) is a regression this matrix must show. learn_warnings keeps
+    # a legitimate 0 (zero events IS the healthy reading on an instrumented
+    # run); the keys are absent entirely when the learn plane was off.
+    for key in ("grad_norm_p95", "update_ratio_p50"):
+        if tel.get(key) is not None:
+            out[key] = tel[key]
+    if tel.get("learn_probe_fetches"):
+        out["learn_warnings"] = tel.get("learn_warnings", 0)
+        out["learn_criticals"] = tel.get("learn_criticals", 0)
     return out
 
 

@@ -41,8 +41,17 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import count_h2d, log_sps_metrics, profile_tick, span
+from sheeprl_tpu.obs import (
+    count_h2d,
+    learn_probes,
+    log_sps_metrics,
+    observe_probes,
+    probes_enabled,
+    profile_tick,
+    span,
+)
 from sheeprl_tpu.obs.dist import pmean
+from sheeprl_tpu.utils.optim import clip_norm_of
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_tensor, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -69,6 +78,9 @@ def build_update_fn(
     ent_coef = float(cfg.algo.ent_coef)
     norm_adv = bool(cfg.algo.normalize_advantages)
     axis = fabric.data_axis
+    # learning-health probes (obs/learn): build-time gate, zero ops when off
+    learn_on = probes_enabled(cfg)
+    learn_clips = {"agent": clip_norm_of(tx)}
 
     def loss_fn(params, batch):
         obs = normalize_obs(batch, cnn_keys, obs_keys)
@@ -97,18 +109,30 @@ def build_update_fn(
             (_, metrics), grads = grad_fn(params, batch)
             grads = pmean(grads, axis)
             updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return (params, opt_state), metrics
+            new_params = optax.apply_updates(params, updates)
+            if learn_on:
+                probes = learn_probes(
+                    {"agent": grads},
+                    params={"agent": params},
+                    updates={"agent": updates},
+                    losses=metrics,
+                    clip_norms=learn_clips,
+                )
+                return (new_params, opt_state), (metrics, probes)
+            return (new_params, opt_state), metrics
 
-        (params, opt_state), metrics = jax.lax.scan(mb_step, (params, opt_state), mb_idx)
+        (params, opt_state), ys = jax.lax.scan(mb_step, (params, opt_state), mb_idx)
+        metrics, probes = ys if learn_on else (ys, None)
         metrics = pmean(jnp.mean(metrics, axis=0), axis)
+        if learn_on:
+            return params, opt_state, metrics, probes
         return params, opt_state, metrics
 
     shmapped = shard_map(
         local_update,
         mesh=fabric.mesh,
         in_specs=(P(), P(), P(axis), P()),
-        out_specs=(P(), P(), P()),
+        out_specs=(P(), P(), P()) + ((P(),) if learn_on else ()),
         check_vma=False,
     )
     return jax.jit(shmapped, donate_argnums=(0, 1))
@@ -359,7 +383,9 @@ def main(fabric, cfg: Dict[str, Any]):
 
         with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
             root_key, update_key = jax.random.split(root_key)
-            params, opt_state, losses = update_fn(params, opt_state, local_data, update_key)
+            outs = update_fn(params, opt_state, local_data, update_key)
+            params, opt_state, losses = outs[0], outs[1], outs[2]
+            observe_probes(outs[3] if len(outs) > 3 else None, step=policy_step)
             losses = fetch_losses_if_observed(losses, aggregator)
         play_params = to_host(params)
         train_step += world_size

@@ -61,8 +61,9 @@ from sheeprl_tpu.train import build_train_burst, metric_fetch_gate, run_train_bu
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import log_sps_metrics, profile_tick, span
+from sheeprl_tpu.obs import learn_probes, log_sps_metrics, probes_enabled, profile_tick, span
 from sheeprl_tpu.obs.dist import pmean
+from sheeprl_tpu.utils.optim import clip_norm_of
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
 sg = jax.lax.stop_gradient
@@ -91,6 +92,12 @@ def build_train_fn(
     axis = fabric.data_axis
     cnn_keys = tuple(cfg.cnn_keys.encoder)
     mlp_keys = tuple(cfg.mlp_keys.encoder)
+    learn_on = probes_enabled(cfg)
+    learn_clips = {
+        "world_model": clip_norm_of(world_tx),
+        "actor": clip_norm_of(actor_tx),
+        "critic": clip_norm_of(critic_tx),
+    }
     wm_cfg = cfg.algo.world_model
     stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
     rec_size = int(wm_cfg.recurrent_model.recurrent_state_size)
@@ -347,6 +354,30 @@ def build_train_fn(
         metrics["Grads/actor"] = optax.global_norm(actor_grads)
         metrics["Grads/critic"] = optax.global_norm(critic_grads)
         metrics = pmean(metrics, axis)
+        if learn_on:
+            # grads are already pmean'd, so the probe scalars are identical
+            # on every shard — the learn plane adds no collectives
+            metrics.update(
+                learn_probes(
+                    {
+                        "world_model": wm_grads,
+                        "actor": actor_grads,
+                        "critic": critic_grads,
+                    },
+                    params={
+                        "world_model": params["world_model"],
+                        "actor": params["actor"],
+                        "critic": params["critic"],
+                    },
+                    updates={
+                        "world_model": wm_updates,
+                        "actor": actor_updates,
+                        "critic": critic_updates,
+                    },
+                    losses=(wm_loss, actor_loss, critic_loss),
+                    clip_norms=learn_clips,
+                )
+            )
 
         new_state = {
             "params": {

@@ -67,12 +67,15 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import (
     LoopProbe,
+    learn_probes,
     log_sps_metrics,
+    probes_enabled,
     profile_tick,
     set_shard_footprint,
     span,
 )
 from sheeprl_tpu.obs.dist import pmean
+from sheeprl_tpu.utils.optim import clip_norm_of
 from sheeprl_tpu.parallel.shard import measured_bytes_per_device
 from sheeprl_tpu.train import (
     TrainProgram,
@@ -152,6 +155,12 @@ def build_train_fn(
     m_high = float(moments_cfg.percentile.high)
     dims = tuple(int(d) for d in actions_dim)
     splits = list(np.cumsum(dims)[:-1])
+    learn_on = probes_enabled(cfg)
+    learn_clips = {
+        "world_model": clip_norm_of(world_tx),
+        "actor": clip_norm_of(actor_tx),
+        "critic": clip_norm_of(critic_tx),
+    }
 
     def wm_apply(params, method, *args):
         return world_model.apply({"params": params}, *args, method=method)
@@ -438,6 +447,30 @@ def build_train_fn(
         metrics["Grads/actor"] = optax.global_norm(actor_grads)
         metrics["Grads/critic"] = optax.global_norm(critic_grads)
         metrics = pmean(metrics, axis)
+        if learn_on:
+            # grads are already pmean'd above, so every shard computes the
+            # same probe scalars — no extra collectives for the learn plane
+            metrics.update(
+                learn_probes(
+                    {
+                        "world_model": wm_grads,
+                        "actor": actor_grads,
+                        "critic": critic_grads,
+                    },
+                    params={
+                        "world_model": params["world_model"],
+                        "actor": params["actor"],
+                        "critic": params["critic"],
+                    },
+                    updates={
+                        "world_model": wm_updates,
+                        "actor": actor_updates,
+                        "critic": critic_updates,
+                    },
+                    losses=(wm_loss, actor_loss, critic_loss),
+                    clip_norms=learn_clips,
+                )
+            )
 
         new_state = {
             "params": {
@@ -1003,6 +1036,13 @@ def main(fabric, cfg: Dict[str, Any]):
 
     burst_actor = BurstActor(_act_fn, _host_env_step, state_box["carry"])
 
+    # in-run eval (howto/evaluation.md): rank 0 publishes the frozen params
+    # through the policy channel every eval.every_n_steps; a separate process
+    # scores them, so nothing below touches the train-step critical path
+    from sheeprl_tpu.evals.inrun import maybe_start_inrun_eval
+
+    inrun = maybe_start_inrun_eval(fabric, cfg, log_dir)
+
     update = start_step
     while update <= num_updates:
         n_act, random_phase = train_gated_burst_plan(
@@ -1127,6 +1167,14 @@ def main(fabric, cfg: Dict[str, Any]):
                 if "Params/exploration_amount" in aggregator:
                     aggregator.update("Params/exploration_amount", expl_amount)
 
+        if inrun is not None and last >= learning_starts and inrun.due(policy_step):
+            # versioned by policy_step; the npz write runs on the publisher's
+            # writer thread, so the cost here is one params-sized device_get
+            inrun.maybe_publish(
+                policy_step,
+                {"agent": {"params": jax.device_get(agent_state["params"])}},
+            )
+
         # Log metrics (reference main :768-800)
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or last == num_updates
@@ -1176,6 +1224,8 @@ def main(fabric, cfg: Dict[str, Any]):
                 # drains the in-flight write) — leave the train loop cleanly
                 break
 
+    if inrun is not None:
+        inrun.close()
     staging.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():

@@ -47,8 +47,16 @@ from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import log_sps_metrics, profile_tick, span
+from sheeprl_tpu.obs import (
+    learn_probes,
+    log_sps_metrics,
+    observe_probes,
+    probes_enabled,
+    profile_tick,
+    span,
+)
 from sheeprl_tpu.obs.dist import pmean
+from sheeprl_tpu.utils.optim import clip_norm_of
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -75,6 +83,13 @@ def build_train_fn(
     scale = jnp.asarray(action_scale)
     bias = jnp.asarray(action_bias)
     tgt_entropy = jnp.float32(target_entropy)
+    # learning-health probes (obs/learn): build-time gate, zero ops when off
+    learn_on = probes_enabled(cfg)
+    learn_clips = {
+        "actor": clip_norm_of(actor_tx),
+        "critic": clip_norm_of(qf_tx),
+        "alpha": clip_norm_of(alpha_tx),
+    }
 
     def critic_step(carry, batch_and_key):
         state, qf_opt = carry
@@ -104,15 +119,25 @@ def build_train_fn(
         targets = jax.tree_util.tree_map(
             lambda p, t: tau * p + (1.0 - tau) * t, critics, state["target_critics"]
         )
-        state = {**state, "critics": critics, "target_critics": targets}
-        return (state, qf_opt), qf_loss
+        new_state = {**state, "critics": critics, "target_critics": targets}
+        if learn_on:
+            probes = learn_probes(
+                {"critic": qf_grads},
+                params={"critic": state["critics"]},
+                updates={"critic": qf_updates},
+                losses=qf_loss,
+                clip_norms=learn_clips,
+            )
+            return (new_state, qf_opt), (qf_loss, probes)
+        return (new_state, qf_opt), qf_loss
 
     def local_train(state, opt_states, critic_batch, actor_batch, key):
         g = jax.tree_util.tree_leaves(critic_batch)[0].shape[0]
         keys = jax.random.split(key, g + 2)
-        (state, qf_opt), qf_losses = jax.lax.scan(
+        (state, qf_opt), qf_ys = jax.lax.scan(
             critic_step, (state, opt_states["qf"]), (critic_batch, keys[:g])
         )
+        qf_losses, critic_probes = qf_ys if learn_on else (qf_ys, None)
 
         # ---- actor update from the separate batch, mean over the ensemble
         alpha = jax.lax.stop_gradient(jnp.exp(state["log_alpha"]))
@@ -139,18 +164,36 @@ def build_train_fn(
         alpha_updates, alpha_opt = alpha_tx.update(alpha_grad, opt_states["alpha"], state["log_alpha"])
         log_alpha = optax.apply_updates(state["log_alpha"], alpha_updates)
 
-        state = {**state, "actor": actor_params, "log_alpha": log_alpha}
+        new_state = {**state, "actor": actor_params, "log_alpha": log_alpha}
         opt_states = {"actor": actor_opt, "qf": qf_opt, "alpha": alpha_opt}
         metrics = pmean(
             jnp.stack([jnp.mean(qf_losses), actor_loss, alpha_loss]), axis
         )
-        return state, opt_states, metrics
+        if learn_on:
+            actor_probes = learn_probes(
+                {"actor": actor_grads, "alpha": alpha_grad},
+                params={"actor": state["actor"], "alpha": state["log_alpha"]},
+                updates={"actor": actor_updates, "alpha": alpha_updates},
+                losses=(actor_loss, alpha_loss),
+                clip_norms=learn_clips,
+            )
+            # the critic scan yields [G]-stacked samples, the actor/alpha
+            # update one more — concatenate per key (the sentinel ravels)
+            probes = {}
+            for d in (critic_probes, actor_probes):
+                for k, v in d.items():
+                    v = jnp.ravel(v)
+                    probes[k] = (
+                        v if k not in probes else jnp.concatenate([probes[k], v])
+                    )
+            return new_state, opt_states, metrics, probes
+        return new_state, opt_states, metrics
 
     shmapped = shard_map(
         local_train,
         mesh=fabric.mesh,
         in_specs=(P(), P(), P(None, axis), P(axis), P()),
-        out_specs=(P(), P(), P()),
+        out_specs=(P(), P(), P()) + ((P(),) if learn_on else ()),
         check_vma=False,
     )
     return jax.jit(shmapped, donate_argnums=(0, 1))
@@ -391,9 +434,11 @@ def main(fabric, cfg: Dict[str, Any]):
 
             with span("Time/train_time", SumMetric(sync_on_compute=cfg.metric.sync_on_compute), phase="train"):
                 root_key, train_key = jax.random.split(root_key)
-                agent_state, opt_states, losses = train_fn(
+                outs = train_fn(
                     agent_state, opt_states, critic_batch, actor_batch, train_key
                 )
+                agent_state, opt_states, losses = outs[0], outs[1], outs[2]
+                observe_probes(outs[3] if len(outs) > 3 else None, step=policy_step)
                 losses = fetch_losses_if_observed(losses, aggregator)
                 play_actor = actor_mirror(agent_state["actor"])
             train_step += world_size

@@ -56,8 +56,9 @@ from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import log_sps_metrics, profile_tick, span
+from sheeprl_tpu.obs import learn_probes, log_sps_metrics, probes_enabled, profile_tick, span
 from sheeprl_tpu.obs.dist import pmean
+from sheeprl_tpu.utils.optim import clip_norm_of
 from sheeprl_tpu.train import build_train_burst, metric_fetch_gate, run_train_burst, tau_schedule
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
@@ -80,6 +81,8 @@ def build_train_fn(
     axis = fabric.data_axis
     cnn_keys = tuple(cfg.cnn_keys.encoder)
     mlp_keys = tuple(cfg.mlp_keys.encoder)
+    learn_on = probes_enabled(cfg)
+    learn_clips = {name: clip_norm_of(tx) for name, tx in txs.items()}
     wm_cfg = cfg.algo.world_model
     stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
     rec_size = int(wm_cfg.recurrent_model.recurrent_state_size)
@@ -339,6 +342,39 @@ def build_train_fn(
         metrics["Grads/actor_task"] = optax.global_norm(a_task_grads)
         metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
         metrics = pmean(metrics, axis)
+        if learn_on:
+            # grads are already pmean'd, so the probe scalars are identical
+            # on every shard — the learn plane adds no collectives
+            metrics.update(
+                learn_probes(
+                    {
+                        "world_model": wm_grads,
+                        "ensembles": ens_grads,
+                        "actor_exploration": a_expl_grads,
+                        "critic_exploration": ce_grads,
+                        "actor_task": a_task_grads,
+                        "critic_task": ct_grads,
+                    },
+                    params={
+                        "world_model": params["world_model"],
+                        "ensembles": params["ensembles"],
+                        "actor_exploration": params["actor_exploration"],
+                        "critic_exploration": params["critic_exploration"],
+                        "actor_task": params["actor_task"],
+                        "critic_task": params["critic_task"],
+                    },
+                    updates={
+                        "world_model": wm_updates,
+                        "ensembles": ens_updates,
+                        "actor_exploration": a_expl_updates,
+                        "critic_exploration": ce_updates,
+                        "actor_task": a_task_updates,
+                        "critic_task": ct_updates,
+                    },
+                    losses=(wm_loss, ens_loss, pl_expl, ce_loss, pl_task, ct_loss),
+                    clip_norms=learn_clips,
+                )
+            )
 
         new_state = {
             "params": {

@@ -66,8 +66,9 @@ from sheeprl_tpu.plane import train_gated_burst_plan
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import log_sps_metrics, profile_tick, span
+from sheeprl_tpu.obs import learn_probes, log_sps_metrics, probes_enabled, profile_tick, span
 from sheeprl_tpu.obs.dist import pmean
+from sheeprl_tpu.utils.optim import clip_norm_of
 from sheeprl_tpu.train import build_train_burst, metric_fetch_gate, run_train_burst, tau_schedule
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
@@ -94,6 +95,8 @@ def build_train_fn(
     mlp_keys = tuple(cfg.mlp_keys.encoder)
     cnn_dec_keys = tuple(cfg.cnn_keys.decoder)
     mlp_dec_keys = tuple(cfg.mlp_keys.decoder)
+    learn_on = probes_enabled(cfg)
+    learn_clips = {name: clip_norm_of(tx) for name, tx in txs.items()}
     wm_cfg = cfg.algo.world_model
     stoch_flat = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
     rec_size = int(wm_cfg.recurrent_model.recurrent_state_size)
@@ -416,6 +419,9 @@ def build_train_fn(
         new_critics_expl = {}
         critics_expl_opt = {}
         critic_metrics = {}
+        critics_expl_grads = {}
+        critics_expl_updates = {}
+        critics_expl_losses = []
         for k in critics_cfg:
             c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(
                 params["critics_exploration"][k]["module"],
@@ -435,6 +441,9 @@ def build_train_fn(
             }
             critics_expl_opt[k] = c_opt
             critic_metrics[f"Loss/value_loss_exploration_{k}"] = c_loss
+            critics_expl_grads[k] = c_grads
+            critics_expl_updates[k] = c_updates
+            critics_expl_losses.append(c_loss)
 
         # 5. task actor
         (pl_task, aux_task), a_task_grads = jax.value_and_grad(
@@ -474,6 +483,42 @@ def build_train_fn(
         metrics["Grads/actor_task"] = optax.global_norm(a_task_grads)
         metrics["Grads/critic_task"] = optax.global_norm(ct_grads)
         metrics = pmean(metrics, axis)
+        if learn_on:
+            # grads are already pmean'd, so the probe scalars are identical
+            # on every shard — the learn plane adds no collectives; the per-k
+            # exploration critics fold into ONE module (dict of per-k grads)
+            metrics.update(
+                learn_probes(
+                    {
+                        "world_model": wm_grads,
+                        "ensembles": ens_grads,
+                        "actor_exploration": a_expl_grads,
+                        "critics_exploration": critics_expl_grads,
+                        "actor_task": a_task_grads,
+                        "critic_task": ct_grads,
+                    },
+                    params={
+                        "world_model": params["world_model"],
+                        "ensembles": params["ensembles"],
+                        "actor_exploration": params["actor_exploration"],
+                        "critics_exploration": {
+                            k: params["critics_exploration"][k]["module"] for k in critics_cfg
+                        },
+                        "actor_task": params["actor_task"],
+                        "critic_task": params["critic_task"],
+                    },
+                    updates={
+                        "world_model": wm_updates,
+                        "ensembles": ens_updates,
+                        "actor_exploration": a_expl_updates,
+                        "critics_exploration": critics_expl_updates,
+                        "actor_task": a_task_updates,
+                        "critic_task": ct_updates,
+                    },
+                    losses=(wm_loss, ens_loss, pl_expl, pl_task, ct_loss, *critics_expl_losses),
+                    clip_norms=learn_clips,
+                )
+            )
 
         new_state = {
             "params": {

@@ -56,7 +56,10 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import (
     count_h2d,
     get_telemetry,
+    learn_probes,
     log_sps_metrics,
+    observe_probes,
+    probes_enabled,
     profile_tick,
     register_train_cost,
     shape_specs,
@@ -67,7 +70,7 @@ from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
-from sheeprl_tpu.utils.optim import set_lr
+from sheeprl_tpu.utils.optim import clip_norm_of, set_lr
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, gae, normalize_tensor, polynomial_decay, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
@@ -106,6 +109,9 @@ def build_update_fn(
     clip_vloss = bool(cfg.algo.clip_vloss)
     norm_adv = bool(cfg.algo.normalize_advantages)
     axis = fabric.data_axis
+    # learning-health probes (obs/learn): build-time gate, zero ops when off
+    learn_on = probes_enabled(cfg)
+    learn_clips = {"agent": clip_norm_of(tx)}
 
     def loss_fn(params, batch, clip_coef, ent_coef):
         obs = normalize_obs(batch, cnn_keys, obs_keys)
@@ -146,14 +152,28 @@ def build_update_fn(
                 (_, metrics), grads = grad_fn(params, batch, clip_coef, ent_coef)
                 grads = pmean(grads, axis)
                 updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, opt_state), metrics
+                new_params = optax.apply_updates(params, updates)
+                if learn_on:
+                    probes = learn_probes(
+                        {"agent": grads},
+                        params={"agent": params},
+                        updates={"agent": updates},
+                        losses=metrics,
+                        clip_norms=learn_clips,
+                    )
+                    return (new_params, opt_state), (metrics, probes)
+                return (new_params, opt_state), metrics
 
             carry, metrics = jax.lax.scan(mb_step, (params, opt_state), mb_idx)
             return carry, metrics
 
-        (params, opt_state), metrics = jax.lax.scan(epoch_step, (params, opt_state), ep_keys)
+        (params, opt_state), ys = jax.lax.scan(epoch_step, (params, opt_state), ep_keys)
+        metrics, probes = ys if learn_on else (ys, None)
         metrics = pmean(jnp.mean(metrics, axis=(0, 1)), axis)
+        if learn_on:
+            # probes stacked [epochs, n_mb]: every minibatch gradient step is
+            # a sentinel sample (the host ravels them in order)
+            return params, opt_state, metrics, probes
         return params, opt_state, metrics
 
     data_spec = P() if share else P(axis)
@@ -161,7 +181,7 @@ def build_update_fn(
         local_update,
         mesh=fabric.mesh,
         in_specs=(P(), P(), data_spec, P(), P(), P()),
-        out_specs=(P(), P(), P()),
+        out_specs=(P(), P(), P()) + ((P(),) if learn_on else ()),
         check_vma=False,
     )
     # decoupled mode keeps the old params alive for the player thread, so
@@ -485,7 +505,9 @@ def main(fabric, cfg: Dict[str, Any]):
                 # abstract specs captured pre-call: the update donates its
                 # params/opt_state buffers, so the live arrays are gone after
                 update_specs = shape_specs(update_args)
-            params, opt_state, losses = update_fn(*update_args)
+            outs = update_fn(*update_args)
+            params, opt_state, losses = outs[0], outs[1], outs[2]
+            observe_probes(outs[3] if len(outs) > 3 else None, step=policy_step)
             losses = fetch_losses_if_observed(losses, aggregator)
         if update_specs is not None:
             # per train-step UNIT (FLOPs + bytes accessed): the counter

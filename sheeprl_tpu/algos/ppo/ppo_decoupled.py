@@ -51,6 +51,7 @@ from sheeprl_tpu.obs import (
     count_h2d,
     get_telemetry,
     log_sps_metrics,
+    observe_probes,
     profile_tick,
     register_train_cost,
     shape_specs,
@@ -300,7 +301,9 @@ def main(fabric, cfg: Dict[str, Any]):
                     jnp.float32(cfg.algo.clip_coef),
                     jnp.float32(cfg.algo.ent_coef),
                 )
-                params, opt_state, losses = update_fn(*update_args)
+                outs = update_fn(*update_args)
+                params, opt_state, losses = outs[0], outs[1], outs[2]
+                observe_probes(outs[3] if len(outs) > 3 else None, step=policy_step)
                 losses = fetch_losses_if_observed(losses, aggregator)
             if telemetry is not None and telemetry.needs_train_flops():
                 # donation is off in decoupled mode, so the live args are

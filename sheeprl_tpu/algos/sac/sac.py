@@ -68,7 +68,10 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.obs import (
     get_telemetry,
+    learn_probes,
     log_sps_metrics,
+    observe_probes,
+    probes_enabled,
     profile_tick,
     register_train_cost,
     set_shard_footprint,
@@ -76,6 +79,7 @@ from sheeprl_tpu.obs import (
     span,
 )
 from sheeprl_tpu.obs.dist import pmean
+from sheeprl_tpu.utils.optim import clip_norm_of, get_lr, set_lr
 from sheeprl_tpu.parallel.shard import measured_bytes_per_device
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
@@ -119,6 +123,15 @@ def build_train_fn(
     scale = jnp.asarray(action_scale)
     bias = jnp.asarray(action_bias)
     tgt_entropy = jnp.float32(target_entropy)
+    # learning-health probes (obs/learn): build-time gate — with the sentinel
+    # uninstalled the program carries zero probe ops and its outputs (and
+    # params) are bitwise those of a probes-off build
+    learn_on = probes_enabled(cfg)
+    learn_clips = {
+        "actor": clip_norm_of(actor_tx),
+        "critic": clip_norm_of(qf_tx),
+        "alpha": clip_norm_of(alpha_tx),
+    }
 
     def one_step(carry, batch_and_key):
         state, opt_states, do_ema = carry
@@ -183,26 +196,54 @@ def build_train_fn(
         }
         new_opts = {"actor": actor_opt, "qf": qf_opt, "alpha": alpha_opt}
         metrics = jnp.stack([qf_loss, actor_loss, alpha_loss])
+        if learn_on:
+            # grads are already pmean'd above, so every shard computes the
+            # identical probe values — no extra collective needed
+            probes = learn_probes(
+                {
+                    "actor": actor_grads,
+                    "critic": qf_grads,
+                    "alpha": alpha_grad,
+                },
+                params={
+                    "actor": state["actor"],
+                    "critic": state["critics"],
+                    "alpha": state["log_alpha"],
+                },
+                updates={
+                    "actor": actor_updates,
+                    "critic": qf_updates,
+                    "alpha": alpha_updates,
+                },
+                losses=(qf_loss, actor_loss, alpha_loss),
+                clip_norms=learn_clips,
+            )
+            return (new_state, new_opts, do_ema), (metrics, probes)
         return (new_state, new_opts, do_ema), metrics
 
     def local_train(state, opt_states, batch, key, do_ema):
         g = jax.tree_util.tree_leaves(batch)[0].shape[0]
         keys = jax.random.split(key, g)
-        (state, opt_states, _), metrics = jax.lax.scan(
+        (state, opt_states, _), ys = jax.lax.scan(
             one_step, (state, opt_states, do_ema), (batch, keys)
         )
+        metrics, probes = ys if learn_on else (ys, None)
         metrics = pmean(jnp.mean(metrics, axis=0), axis)
+        if learn_on:
+            # probes ride the scan ys stacked [G]: per-gradient-step samples
+            return state, opt_states, metrics, probes
         return state, opt_states, metrics
 
     # decoupled mode keeps the old actor params alive for the player
     # thread, so donation must be off there
     donate_argnums = (0, 1) if donate else ()
+    n_learn = 1 if learn_on else 0
     if state_plan is None:
         shmapped = shard_map(
             local_train,
             mesh=fabric.mesh,
             in_specs=(P(), P(), P(None, data_axis), P(), P()),
-            out_specs=(P(), P(), P()),
+            out_specs=(P(), P(), P()) + (P(),) * n_learn,
             check_vma=False,
         )
         return jax.jit(shmapped, donate_argnums=donate_argnums)
@@ -216,7 +257,8 @@ def build_train_fn(
             rep,
             rep,
         ),
-        out_shardings=(state_plan.shardings(), opt_plan.shardings(), rep),
+        out_shardings=(state_plan.shardings(), opt_plan.shardings(), rep)
+        + (rep,) * n_learn,
         donate_argnums=donate_argnums,
     )
 
@@ -433,6 +475,18 @@ def main(fabric, cfg: Dict[str, Any]):
 
     per_rank_gradient_steps = int(cfg.algo.per_rank_gradient_steps)
     ema_every = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_update + 1
+    # fault injection (metric.telemetry.learn.inject_lr_spike_*): multiply
+    # every optimizer's LR once at the configured update — drives the
+    # divergence-sentinel acceptance tests, never enabled in a real run
+    lr_spike_at = None
+    lr_spike_factor = 0.0
+    try:
+        _lcfg = (cfg.metric.get("telemetry", {}) or {}).get("learn", {}) or {}
+        if _lcfg.get("inject_lr_spike_at") is not None:
+            lr_spike_at = int(_lcfg["inject_lr_spike_at"])
+            lr_spike_factor = float(_lcfg.get("inject_lr_spike_factor", 0) or 0)
+    except AttributeError:
+        pass
     # burst acting (tier b, howto/rollout_engine.md): K env steps per device
     # dispatch; 1 reproduces the per-step path exactly
     act_burst = max(int(cfg.env.get("act_burst", 1) or 1), 1)
@@ -569,11 +623,21 @@ def main(fabric, cfg: Dict[str, Any]):
                 do_ema = jnp.bool_(
                     any(u % ema_every == 0 for u in range(first, last + 1))
                 )
+                if lr_spike_at is not None and lr_spike_factor and first <= lr_spike_at <= last:
+                    lr_spike_at = None  # fires exactly once
+                    opt_states = {
+                        k: set_lr(v, jnp.float32(get_lr(v) * lr_spike_factor))
+                        for k, v in opt_states.items()
+                    }
                 train_args = (agent_state, opt_states, batch, train_key, do_ema)
                 if telemetry is not None and telemetry.needs_train_flops():
                     # specs captured pre-call: the train step donates its state
                     train_specs = shape_specs(train_args)
-                agent_state, opt_states, losses = train_fn(*train_args)
+                outs = train_fn(*train_args)
+                agent_state, opt_states, losses = outs[0], outs[1], outs[2]
+                # [G]-stacked learn probes (4th output when probes are on):
+                # one cadence-gated device_get inside observe_probes
+                observe_probes(outs[3] if len(outs) > 3 else None, step=policy_step)
                 losses = fetch_losses_if_observed(losses, aggregator)
             if train_specs is not None:
                 # per train-step UNIT (FLOPs + bytes accessed): the counter

@@ -54,6 +54,7 @@ from sheeprl_tpu.envs.vector import make_eval_env
 from sheeprl_tpu.obs import (
     get_telemetry,
     log_sps_metrics,
+    observe_probes,
     profile_tick,
     register_train_cost,
     shape_specs,
@@ -304,7 +305,11 @@ def main(fabric, cfg: Dict[str, Any]):
                         any(u % ema_every == 0 for u in range(first, last + 1))
                     )
                     train_args = (agent_state, opt_states, batch, train_key, do_ema)
-                    agent_state, opt_states, losses = train_fn(*train_args)
+                    outs = train_fn(*train_args)
+                    agent_state, opt_states, losses = outs[0], outs[1], outs[2]
+                    observe_probes(
+                        outs[3] if len(outs) > 3 else None, step=policy_step
+                    )
                     losses = fetch_losses_if_observed(losses, aggregator)
                 if telemetry is not None and telemetry.needs_train_flops():
                     # donation is off in decoupled mode; one AOT cost

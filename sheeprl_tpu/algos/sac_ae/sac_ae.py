@@ -42,8 +42,16 @@ from sheeprl_tpu.envs.vector import make_vector_env
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.obs import log_sps_metrics, profile_tick, span
+from sheeprl_tpu.obs import (
+    learn_probes,
+    log_sps_metrics,
+    observe_probes,
+    probes_enabled,
+    profile_tick,
+    span,
+)
 from sheeprl_tpu.obs.dist import pmean
+from sheeprl_tpu.utils.optim import clip_norm_of
 from sheeprl_tpu.utils.utils import fetch_losses_if_observed, save_configs
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -78,6 +86,14 @@ def build_train_fn(
     scale = jnp.asarray(action_scale)
     bias = jnp.asarray(action_bias)
     tgt_entropy = jnp.float32(target_entropy)
+    # learning-health probes (obs/learn): build-time gate, zero ops when off
+    learn_on = probes_enabled(cfg)
+    learn_clips = {
+        "critic": clip_norm_of(txs["qf"]),
+        "actor": clip_norm_of(txs["actor"]),
+        "alpha": clip_norm_of(txs["alpha"]),
+        "decoder": clip_norm_of(txs["decoder"]),
+    }
 
     def normalize(batch, prefix=""):
         out = {}
@@ -227,20 +243,47 @@ def build_train_fn(
             "decoder": dec_opt,
         }
         metrics = jnp.stack([qf_loss, actor_loss, alpha_loss, recon_loss])
+        if learn_on:
+            probes = learn_probes(
+                {
+                    "critic": qf_grads,
+                    "actor": actor_grads,
+                    "alpha": alpha_grad,
+                    "decoder": recon_grads,
+                },
+                params={
+                    "critic": {"encoder": state["encoder"], "qfs": state["qfs"]},
+                    "actor": state["actor"],
+                    "alpha": state["log_alpha"],
+                    "decoder": state["decoder"],
+                },
+                updates={
+                    "critic": qf_updates,
+                    "actor": actor_updates,
+                    "alpha": alpha_updates,
+                    "decoder": dec_updates,
+                },
+                losses=(qf_loss, actor_loss, alpha_loss, recon_loss),
+                clip_norms=learn_clips,
+            )
+            return (new_state, new_opts, gates), (metrics, probes)
         return (new_state, new_opts, gates), metrics
 
     def local_train(state, opts, batch, key, gates):
         g = jax.tree_util.tree_leaves(batch)[0].shape[0]
         keys = jax.random.split(key, g)
-        (state, opts, _), metrics = jax.lax.scan(one_step, (state, opts, gates), (batch, keys))
+        (state, opts, _), ys = jax.lax.scan(one_step, (state, opts, gates), (batch, keys))
+        metrics, probes = ys if learn_on else (ys, None)
         metrics = pmean(jnp.mean(metrics, axis=0), axis)
+        if learn_on:
+            return state, opts, metrics, probes
         return state, opts, metrics
 
     shmapped = shard_map(
         local_train,
         mesh=fabric.mesh,
         in_specs=(P(), P(), P(None, axis), P(), P()),
-        out_specs=(P(), P(), P()),
+        out_specs=(P(), P(), P()) + ((P(),) if learn_on else ()),
         check_vma=False,
     )
     return jax.jit(shmapped, donate_argnums=(0, 1))
@@ -512,9 +555,9 @@ def main(fabric, cfg: Dict[str, Any]):
                     "do_actor": jnp.bool_(u % actor_every == 0),
                     "do_decoder": jnp.bool_(u % decoder_every == 0),
                 }
-                agent_state, opt_states, losses = train_fn(
-                    agent_state, opt_states, batch, train_key, gates
-                )
+                outs = train_fn(agent_state, opt_states, batch, train_key, gates)
+                agent_state, opt_states, losses = outs[0], outs[1], outs[2]
+                observe_probes(outs[3] if len(outs) > 3 else None, step=policy_step)
                 losses = fetch_losses_if_observed(losses, aggregator)
             play_params = actor_mirror(_acting_subtree(agent_state))
             train_step += world_size

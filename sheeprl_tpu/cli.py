@@ -202,27 +202,19 @@ def check_configs(cfg) -> None:
             UserWarning,
         )
 
-    # burst acting (env.act_burst, envs/rollout) is consumed by the coupled
-    # SAC-family/PPO loops and the decoupled plane players; elsewhere a >1
-    # value would silently act per-step — the exact silent-ignore trap the
-    # resume-override accounting closes, so warn
-    if int(cfg.env.get("act_burst", 1) or 1) > 1 and algo_name not in (
-        "sac",
-        "sac_ae",
-        "droq",
-        "ppo",
-        "sac_decoupled",
-        "ppo_decoupled",
-        "dreamer_v1",
-        "dreamer_v2",
-        "p2e_dv1_exploration",
-        "p2e_dv1_finetuning",
+    # burst acting (env.act_burst, envs/rollout) is consumed by every
+    # entrypoint except the two grandfathered P2E-DV2 per-step loops; there
+    # a >1 value would silently act per-step — the exact silent-ignore trap
+    # the resume-override accounting closes, so warn
+    if int(cfg.env.get("act_burst", 1) or 1) > 1 and algo_name in (
+        "p2e_dv2_exploration",
+        "p2e_dv2_finetuning",
     ):
         warnings.warn(
-            f"env.act_burst={cfg.env.act_burst} is only consumed by the "
-            f"SAC-family/PPO/dreamer-v1/v2/P2E-DV1 rollout paths (coupled "
-            f"loops and plane players); '{algo_name}' acts per-step "
-            "(howto/rollout_engine.md)",
+            f"env.act_burst={cfg.env.act_burst} is not consumed by "
+            f"'{algo_name}' — the P2E-DV2 loops are the last per-step acting "
+            "entrypoints (tools/lint_rollout.py grandfather list, "
+            "howto/rollout_engine.md)",
             UserWarning,
         )
 
@@ -231,11 +223,12 @@ def check_configs(cfg) -> None:
     # the same silent-ignore trap as env.act_burst above
     if int((cfg.get("eval", {}) or {}).get("every_n_steps", 0) or 0) > 0 and algo_name not in (
         "sac",
+        "dreamer_v3",
     ):
         warnings.warn(
             f"eval.every_n_steps={cfg.eval.every_n_steps} is only consumed by "
-            f"the coupled SAC entrypoint for now; '{algo_name}' runs without "
-            "in-run eval (howto/evaluation.md)",
+            f"the coupled SAC and dreamer_v3 entrypoints for now; "
+            f"'{algo_name}' runs without in-run eval (howto/evaluation.md)",
             UserWarning,
         )
 

@@ -19,6 +19,9 @@ pieces (see ``howto/telemetry.md``):
 - :mod:`~sheeprl_tpu.obs.live` — the live plane: periodic atomic
   ``telemetry/live.json`` snapshots, an optional Prometheus endpoint, and
   the anomaly-triggered flight recorder;
+- :mod:`~sheeprl_tpu.obs.learn` — learning-health: in-jit training-dynamics
+  probes (grad/param/update norms, clip fraction, non-finite counts) and the
+  divergence early-warning sentinel (``howto/learning_health.md``);
 - :mod:`~sheeprl_tpu.obs.prof` — device-time profiling: in-run xplane
   capture + parsing, per-module attribution, and the roofline
   (MFU / bandwidth / binding-constraint) accounting
@@ -55,6 +58,13 @@ from sheeprl_tpu.obs.dist.comms import collective_span, pmean, psum
 from sheeprl_tpu.obs.dist.staleness import StalenessTracker
 from sheeprl_tpu.obs.health import NonFiniteGuard, StallWatchdog
 from sheeprl_tpu.obs.hist import HistogramSet, StreamingHist
+from sheeprl_tpu.obs.learn import (
+    LearnSentinel,
+    learn_probes,
+    observe_probes,
+    probes_enabled,
+    split_probes,
+)
 from sheeprl_tpu.obs.live import (
     FlightRecorder,
     LiveExporter,
@@ -85,6 +95,7 @@ __all__ = [
     "DevicePoller",
     "FlightRecorder",
     "HistogramSet",
+    "LearnSentinel",
     "LiveExporter",
     "LoopProbe",
     "NonFiniteGuard",
@@ -114,9 +125,12 @@ __all__ = [
     "finalize_telemetry",
     "get_telemetry",
     "get_tracer",
+    "learn_probes",
     "log_sps_metrics",
     "mfu_pct",
     "note_plane_policy_version",
+    "observe_probes",
+    "probes_enabled",
     "set_shard_footprint",
     "pmean",
     "profile_tick",
@@ -128,6 +142,7 @@ __all__ = [
     "setup_telemetry",
     "shape_specs",
     "span",
+    "split_probes",
     "staged_device_put",
     "tree_nbytes",
 ]

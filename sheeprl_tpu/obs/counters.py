@@ -41,6 +41,7 @@ __all__ = [
     "add_env_worker_restart",
     "add_h2d_bytes",
     "add_kernel_tier_degraded",
+    "add_learn_fetch",
     "add_plane_player_restart",
     "add_plane_slabs",
     "add_prefetch",
@@ -158,6 +159,18 @@ class Counters:
         self.eval_rounds = 0
         self.eval_episodes = 0
         self.inrun_eval_publishes = 0
+        # learning-health plane (sheeprl_tpu/obs/learn): graded sentinel
+        # events plus the extra device→host probe pulls actually paid (the
+        # "uninstrumented runs pay nothing" invariant is asserted on
+        # learn_probe_fetches staying 0 when learn probes are off)
+        self.learn_warnings = 0
+        self.learn_criticals = 0
+        self.learn_probe_fetches = 0
+
+    def add_learn_event(self, warnings: int = 0, criticals: int = 0) -> None:
+        with self._lock:
+            self.learn_warnings += int(warnings)
+            self.learn_criticals += int(criticals)
 
     def add(self, field: str, amount) -> None:
         with self._lock:
@@ -220,6 +233,9 @@ class Counters:
                 "eval_rounds": self.eval_rounds,
                 "eval_episodes": self.eval_episodes,
                 "inrun_eval_publishes": self.inrun_eval_publishes,
+                "learn_warnings": self.learn_warnings,
+                "learn_criticals": self.learn_criticals,
+                "learn_probe_fetches": self.learn_probe_fetches,
                 "comms_ops": self.comms_ops,
                 "comms_bytes": self.comms_bytes,
                 "comms_ms": round(self.comms_ms, 3),
@@ -392,6 +408,14 @@ def add_train_burst(steps: int = 0, dispatches: int = 1) -> None:
             c.train_bursts += 1
             c.train_dispatches += int(dispatches)
             c.train_burst_steps += int(steps)
+
+
+def add_learn_fetch(n: int = 1) -> None:
+    """Record one learn-probe device→host pull (obs/learn.observe_probes)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.learn_probe_fetches += int(n)
 
 
 # -- parameter-sharding accounting -------------------------------------------

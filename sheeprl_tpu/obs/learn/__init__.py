@@ -1,0 +1,94 @@
+"""Learning-health observability: in-jit dynamics probes + the divergence
+sentinel (howto/learning_health.md).
+
+The machine-health planes (spans, counters, roofline, staleness) say nothing
+about whether the *learning* is healthy: a diverging run looks identical to a
+converging one in telemetry.json until the NaN guard fires, long after the
+damage is done. This package closes that gap with two pieces:
+
+- :mod:`~sheeprl_tpu.obs.learn.probes` — ``learn_probes(...)``, a helper
+  computed INSIDE the jitted train program (global/per-module grad norms,
+  update-to-weight ratio, param norm, clip-fraction, non-finite leaf count)
+  returned as a flat dict of f32 scalars under the ``learn/`` key prefix.
+  ``train/burst.py`` stacks those keys across the scanned burst regardless of
+  the family's ``metric_mode`` and feeds them to the sentinel behind the
+  fetch cadence — at most one extra scalar pull per burst, zero extra
+  dispatches. The fused programs (SAC/PPO/...) stack them through their
+  existing ``lax.scan`` and call :func:`observe_probes` host-side.
+- :mod:`~sheeprl_tpu.obs.learn.sentinel` — streaming-histogram baselines per
+  probe (obs/hist.py) with graded events: ``warn`` on grad-norm z-score
+  excursions or update-ratio collapse, ``critical`` on sustained explosion
+  (before any NaN lands) or non-finite gradients — each firing the flight
+  recorder's ``learn_divergence`` trigger and landing in
+  telemetry.json/live.json/Prometheus.
+
+Like every other obs plane the module-global is installed by
+``setup_telemetry`` and everything is a no-op without it: with the sentinel
+uninstalled, :func:`probes_enabled` is False, so the probe computation is
+never even *compiled* into the train program and instrumented runs stay
+bitwise identical to uninstrumented ones.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from sheeprl_tpu.obs.learn.probes import LEARN_PREFIX, learn_probes, split_probes
+from sheeprl_tpu.obs.learn.sentinel import LearnSentinel
+
+__all__ = [
+    "LEARN_PREFIX",
+    "LearnSentinel",
+    "install",
+    "installed",
+    "learn_probes",
+    "observe_probes",
+    "probes_enabled",
+    "split_probes",
+]
+
+_SENTINEL: Optional[LearnSentinel] = None
+
+
+def install(sentinel: Optional[LearnSentinel]) -> None:
+    """Activate (or with ``None`` deactivate) the run's learn sentinel."""
+    global _SENTINEL
+    _SENTINEL = sentinel
+
+
+def installed() -> Optional[LearnSentinel]:
+    return _SENTINEL
+
+
+def probes_enabled(cfg: Any = None) -> bool:
+    """Should the train program being built compute learn probes?
+
+    True exactly when a sentinel is installed (telemetry on AND
+    ``metric.telemetry.learn.enabled``) — the build-time gate every algo
+    checks, so probes-off programs carry zero probe ops. ``cfg`` is accepted
+    for call-site symmetry; the installed sentinel is the single source of
+    truth.
+    """
+    return _SENTINEL is not None
+
+
+def observe_probes(probes: Any, step: Optional[int] = None) -> None:
+    """Feed one burst's stacked probe pytree to the sentinel (host side).
+
+    ``probes`` may be device arrays — they are pulled with ONE ``device_get``
+    only when the sentinel's ``every_n_bursts`` cadence is due (the
+    ``learn_probe_fetches`` counter records every pull). No-op when probes
+    are None (program built without them) or no sentinel is installed.
+    """
+    s = _SENTINEL
+    if s is None or probes is None:
+        return
+    if not s.due_burst():
+        return
+    import jax
+
+    from sheeprl_tpu.obs.counters import add_learn_fetch
+
+    vals = jax.device_get(probes)
+    add_learn_fetch()
+    s.observe(vals, step=step)

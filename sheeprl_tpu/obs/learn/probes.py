@@ -1,0 +1,136 @@
+"""In-jit training-dynamics probes.
+
+:func:`learn_probes` runs INSIDE the jitted gradient step, over intermediates
+the step already has in hand (grads, params, optimizer updates, losses) — it
+adds a handful of reductions and zero extra dispatches. The result is a flat
+``{"learn/...": f32 scalar}`` dict designed to ride the family's existing
+metric pytree: ``train/burst.py`` recognizes the ``learn/`` prefix and
+stack-accumulates those keys across the burst whatever the metric mode, and
+the fused programs stack them through their own ``lax.scan`` ys.
+
+Probe definitions (howto/learning_health.md):
+
+- ``learn/grad_norm`` — global L2 norm over every module's gradients;
+- ``learn/grad_norm/<module>`` — per-top-level-module L2 grad norms;
+- ``learn/param_norm`` — global L2 norm of the parameters *entering* the
+  step (the sentinel derives param-norm drift host-side from successive
+  samples);
+- ``learn/update_ratio`` — ‖updates‖ / (‖params‖ + eps), the update-to-weight
+  ratio (the classic ~1e-3 rule of thumb; collapse → dead optimizer,
+  explosion → LR too hot);
+- ``learn/clip_frac`` — the fraction of clip-configured modules whose raw
+  grad norm exceeded their ``optax.clip_by_global_norm`` threshold this step.
+  The threshold is SURFACED from the optimizer factory
+  (``utils.optim.clip_norm_of``), not recomputed from config;
+- ``learn/nonfinite`` — count of gradient leaves (plus loss entries)
+  containing any non-finite value: the earliest possible NaN signal, one
+  full metric-fetch cadence ahead of the aggregator-level NonFiniteGuard.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+__all__ = ["LEARN_PREFIX", "learn_probes", "split_probes"]
+
+#: metric-dict key prefix the burst engine stack-accumulates unconditionally
+LEARN_PREFIX = "learn/"
+
+#: update-ratio denominator guard (a zero-norm param tree is init-only)
+_EPS = 1e-12
+
+
+def _sq_norm(tree: Any):
+    """Sum of squares over every leaf of a pytree (f32 scalar)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+
+
+def _nonfinite_leaves(tree: Any):
+    """Count of leaves with ANY non-finite entry (f32 scalar)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(
+        jnp.any(~jnp.isfinite(x)).astype(jnp.float32) for x in leaves
+    )
+
+
+def learn_probes(
+    grads: Mapping[str, Any],
+    params: Optional[Mapping[str, Any]] = None,
+    updates: Optional[Mapping[str, Any]] = None,
+    losses: Any = None,
+    *,
+    clip_norms: Optional[Mapping[str, Optional[float]]] = None,
+) -> Dict[str, Any]:
+    """Compute the learning-dynamics probe dict inside a jitted step.
+
+    ``grads``/``params``/``updates`` are dicts keyed by top-level module name
+    (``{"world_model": ..., "actor": ...}``); ``params`` are the parameters
+    the step STARTED from, ``updates`` the optax update trees actually
+    applied. ``losses`` is any pytree of loss scalars (folded into the
+    non-finite count). ``clip_norms`` maps module → ``clip_by_global_norm``
+    threshold (None/absent: module not clipped; from
+    ``utils.optim.clip_norm_of``).
+
+    Returns a flat ``{"learn/...": f32 scalar}`` dict — merge it into the
+    step's metric dict (burst families) or return it as a scan y (fused
+    programs).
+    """
+    import jax.numpy as jnp
+
+    grads = dict(grads)
+    clip_norms = dict(clip_norms or {})
+    out: Dict[str, Any] = {}
+
+    grad_sq = jnp.float32(0.0)
+    nonfinite = _nonfinite_leaves(losses) if losses is not None else jnp.float32(0.0)
+    clip_flags = []
+    for name in sorted(grads):
+        sq = _sq_norm(grads[name])
+        gnorm = jnp.sqrt(sq)
+        grad_sq = grad_sq + sq
+        out[f"{LEARN_PREFIX}grad_norm/{name}"] = gnorm
+        nonfinite = nonfinite + _nonfinite_leaves(grads[name])
+        clip = clip_norms.get(name)
+        if clip is not None and clip > 0:
+            clip_flags.append((gnorm > jnp.float32(clip)).astype(jnp.float32))
+    out[f"{LEARN_PREFIX}grad_norm"] = jnp.sqrt(grad_sq)
+    out[f"{LEARN_PREFIX}clip_frac"] = (
+        sum(clip_flags) / jnp.float32(len(clip_flags))
+        if clip_flags
+        else jnp.float32(0.0)
+    )
+    out[f"{LEARN_PREFIX}nonfinite"] = nonfinite
+
+    if params is not None:
+        param_norm = jnp.sqrt(sum(_sq_norm(t) for t in dict(params).values()))
+        out[f"{LEARN_PREFIX}param_norm"] = param_norm
+        if updates is not None:
+            update_norm = jnp.sqrt(sum(_sq_norm(t) for t in dict(updates).values()))
+            out[f"{LEARN_PREFIX}update_ratio"] = update_norm / (param_norm + _EPS)
+    return out
+
+
+def split_probes(metrics: Any) -> Tuple[Any, Optional[Dict[str, Any]]]:
+    """Partition a metric dict into ``(rest, learn_subtree_or_None)``.
+
+    Non-dict metric pytrees pass through untouched (fused programs hand
+    their probes around separately).
+    """
+    if not isinstance(metrics, dict):
+        return metrics, None
+    learn = {k: v for k, v in metrics.items() if k.startswith(LEARN_PREFIX)}
+    if not learn:
+        return metrics, None
+    rest = {k: v for k, v in metrics.items() if not k.startswith(LEARN_PREFIX)}
+    return rest, learn

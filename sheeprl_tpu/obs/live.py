@@ -223,6 +223,11 @@ def prometheus_text(snap: Dict[str, Any], prefix: str = "sheeprl") -> str:
     ``<prefix>_policy_lag_versions{quantile="..."}``, queue gauges as
     ``<prefix>_queue_depth{queue="..."}``, and every merged source
     process's numeric counters as ``<prefix>_<key>{source="player0"}``.
+    The learning-health plane (obs/learn) exports its per-probe baselines —
+    including the per-module grad norms — as
+    ``<prefix>_learn_probe{probe="learn/grad_norm/actor",stat="p95"}``
+    (the headline ``learn_warnings`` / ``learn_criticals`` /
+    ``grad_norm_p95`` / ``update_ratio_p50`` ride the flat-scalar path).
     """
     lines = []
 
@@ -231,7 +236,15 @@ def prometheus_text(snap: Dict[str, Any], prefix: str = "sheeprl") -> str:
             return
         lines.append(f"{prefix}_{name}{labels} {float(value):g}")
 
-    skip = ("phase_percentiles", "rolling", "watchdog_beat_age_s", "comms", "staleness", "sources")
+    skip = (
+        "phase_percentiles",
+        "rolling",
+        "watchdog_beat_age_s",
+        "comms",
+        "staleness",
+        "sources",
+        "learn",
+    )
     for key, value in sorted(snap.items()):
         if key in skip:
             continue
@@ -267,6 +280,16 @@ def prometheus_text(snap: Dict[str, Any], prefix: str = "sheeprl") -> str:
     for queue, gauge in sorted((stale.get("queue_depth") or {}).items()):
         emit("queue_depth", gauge.get("last"), '{queue="%s"}' % queue)
         emit("queue_depth_max", gauge.get("max"), '{queue="%s"}' % queue)
+    lrn = snap.get("learn") or {}
+    emit("learn_bursts_observed", lrn.get("bursts_observed"))
+    for probe, rec in sorted((lrn.get("probes") or {}).items()):
+        emit("learn_probe_count", rec.get("n"), '{probe="%s"}' % probe)
+        for stat in ("last", "p50", "p95", "max"):
+            emit(
+                "learn_probe",
+                rec.get(stat),
+                '{probe="%s",stat="%s"}' % (probe, stat),
+            )
     for source, src_snap in sorted((snap.get("sources") or {}).items()):
         if not isinstance(src_snap, dict):
             continue

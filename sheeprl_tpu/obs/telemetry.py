@@ -31,6 +31,14 @@ key                       meaning
 ``peak_hbm_bytes``        peak device ``bytes_in_use`` seen by the poller
 ``hbm_bytes_limit``       device memory limit (0 where the runtime hides it)
 ``nonfinite_metrics``     NaN/inf values caught by the loss guard
+``learn_warnings``        warn-grade learning-health events (obs/learn)
+``learn_criticals``       critical-grade learning-health events (sustained
+                          grad explosion, non-finite grads/metrics)
+``grad_norm_p95``         p95 global gradient norm over the run (null until
+                          the learn sentinel observed a burst)
+``update_ratio_p50``      median update-to-weight ratio (same plane)
+``learn``                 the sentinel's sub-dict: event list, per-probe
+                          baselines, ``first_nonfinite_ts``
 ``stalls``                watchdog stall episodes
 ``ckpt_blocked_ms``       train-step wall ms blocked on checkpoints (host
                           snapshot + double-buffer wait — the step-path cost)
@@ -121,6 +129,7 @@ class Telemetry:
 
         self.counters = _counters.Counters()
         self.staleness = None  # StalenessTracker, built in start()
+        self.sentinel = None  # LearnSentinel (obs/learn), built in start()
         self.tracer: Optional[TraceWriter] = None
         self.poller: Optional[_counters.DevicePoller] = None
         self.guard: Optional[NonFiniteGuard] = None
@@ -200,13 +209,30 @@ class Telemetry:
                 on_slow=self._on_slow_span if self.flight is not None else None,
             )
             _hist.install(self.hists)
+        lcfg = dict(self.cfg.get("learn", {}) or {})
+        if bool(lcfg.get("enabled", True)):
+            from sheeprl_tpu.obs import learn as _learn
+
+            self.sentinel = _learn.LearnSentinel(
+                lcfg,
+                counters=self.counters,
+                flight=self.flight,
+                step_source=lambda: self.policy_steps,
+            )
+            _learn.install(self.sentinel)
         guard_cfg = self.cfg.get("health", {}) or {}
         if bool(guard_cfg.get("nan_guard", True)):
             self.guard = NonFiniteGuard(
                 prefixes=tuple(guard_cfg.get("nan_guard_prefixes", ("Loss/", "Grads/"))),
                 raise_on_nonfinite=bool(guard_cfg.get("raise_on_nonfinite", False)),
                 counters=self.counters,
-                on_fire=self._on_nonfinite if self.flight is not None else None,
+                # terminal stage: flight evidence dump AND the learn
+                # sentinel's first_nonfinite timestamp (acceptance ordering)
+                on_fire=(
+                    self._on_nonfinite
+                    if (self.flight is not None or self.sentinel is not None)
+                    else None
+                ),
             )
             from sheeprl_tpu.utils.metric import set_value_guard
 
@@ -327,7 +353,10 @@ class Telemetry:
         self.flight.trigger("recompile", {"compile_s": round(duration_s, 3)})
 
     def _on_nonfinite(self, name: str, value: float) -> None:
-        self.flight.trigger("nonfinite", {"metric": name, "value": str(value)})
+        if self.flight is not None:
+            self.flight.trigger("nonfinite", {"metric": name, "value": str(value)})
+        if self.sentinel is not None:
+            self.sentinel.on_nonfinite(name, value)
 
     def _live_snapshot(self) -> Dict[str, Any]:
         snap = self.summary()
@@ -479,6 +508,15 @@ class Telemetry:
                 )
             }
             out["prof"]["peaks"] = (p.get("peaks") or {}).get("label")
+        # learning health (obs/learn): headline percentiles flat (Prometheus
+        # exports scalars), the event/baseline detail as a sub-dict
+        if self.sentinel is not None:
+            out["grad_norm_p95"] = self.sentinel.quantile("learn/grad_norm", 0.95)
+            out["update_ratio_p50"] = self.sentinel.quantile("learn/update_ratio", 0.50)
+            out["learn"] = self.sentinel.summary()
+        else:
+            out["grad_norm_p95"] = None
+            out["update_ratio_p50"] = None
         # distributed observability (obs/dist): data-staleness lineage plus
         # the per-source breakdown of every process feeding this run
         staleness = self.staleness.summary() if self.staleness is not None else None
@@ -581,6 +619,11 @@ class Telemetry:
             self.tracer.close()
         _counters.install(None)
         _hist.install(None)
+        if self.sentinel is not None:
+            from sheeprl_tpu.obs import learn as _learn
+
+            if _learn.installed() is self.sentinel:
+                _learn.install(None)
         from sheeprl_tpu.obs.dist import staleness as _staleness
 
         if _staleness.installed() is self.staleness:
@@ -695,6 +738,16 @@ class Telemetry:
                 tails.append(f"{label} p50/p95 {pct['p50_ms']:.0f}/{pct['p95_ms']:.0f} ms")
         if tails:
             lines.append("  tails: " + " · ".join(tails))
+        if s.get("learn_warnings") or s.get("learn_criticals"):
+            lines.append(
+                f"  learning health: {s.get('learn_warnings', 0)} warning(s) · "
+                f"{s.get('learn_criticals', 0)} CRITICAL"
+                + (
+                    f" · grad_norm p95 {s['grad_norm_p95']:.3g}"
+                    if s.get("grad_norm_p95") is not None
+                    else ""
+                )
+            )
         if s.get("crashed"):
             lines.append(f"  CRASHED: {s.get('exception', '?')}")
         if s.get("flight_dumps"):

@@ -36,7 +36,9 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from sheeprl_tpu.obs import get_telemetry, register_train_cost, shape_specs
+from sheeprl_tpu.obs import learn as _learn
 from sheeprl_tpu.obs.counters import add_train_burst
+from sheeprl_tpu.obs.learn import split_probes
 from sheeprl_tpu.utils.jax_compat import shard_map
 
 
@@ -121,12 +123,21 @@ def build_train_burst(
                 lambda x: jax.lax.dynamic_index_in_dim(x, i, 0, keepdims=False), tree
             )
 
-        # abstract-eval one step to build the metric carry structure
+        # abstract-eval one step to build the metric carry structure; the
+        # learn-probe keys (obs/learn, "learn/" prefix) are split out and
+        # ALWAYS stack-accumulated — the sentinel grades every per-step
+        # sample, so "last"/"mean" reductions would hide exactly the
+        # excursions it exists to catch
         metric_shapes = jax.eval_shape(
             local_step, agent_state, at(0, data_stack), *at(0, scanned)
         )[1]
+        metric_shapes, learn_shapes = split_probes(metric_shapes)
+        n_stack = (
+            int(np.shape(jax.tree_util.tree_leaves(scanned[0])[0])[0])
+            if (metric_mode == "stack" or learn_shapes)
+            else 0
+        )
         if metric_mode == "stack":
-            n_stack = int(np.shape(jax.tree_util.tree_leaves(scanned[0])[0])[0])
             init_metrics = jax.tree_util.tree_map(
                 lambda s: jnp.zeros((n_stack,) + tuple(s.shape), s.dtype), metric_shapes
             )
@@ -134,10 +145,24 @@ def build_train_burst(
             init_metrics = jax.tree_util.tree_map(
                 lambda s: jnp.zeros(tuple(s.shape), s.dtype), metric_shapes
             )
+        init_learn = (
+            {
+                k: jnp.zeros((n_stack,) + tuple(s.shape), s.dtype)
+                for k, s in learn_shapes.items()
+            }
+            if learn_shapes
+            else {}
+        )
 
         def body(i, carry):
-            state, metrics = carry
+            state, metrics, learn = carry
             new_state, m = local_step(state, at(i, data_stack), *at(i, scanned))
+            m, lm = split_probes(m)
+            if lm:
+                learn = {
+                    k: jax.lax.dynamic_update_index_in_dim(learn[k], lm[k], i, 0)
+                    for k in learn
+                }
             if metric_mode == "last":
                 metrics = m
             elif metric_mode == "mean":
@@ -148,16 +173,18 @@ def build_train_burst(
                     metrics,
                     m,
                 )
-            return (new_state, metrics)
+            return (new_state, metrics, learn)
 
-        state, metrics = jax.lax.fori_loop(
-            start, start + count, body, (agent_state, init_metrics)
+        state, metrics, learn = jax.lax.fori_loop(
+            start, start + count, body, (agent_state, init_metrics, init_learn)
         )
         if metric_mode == "mean":
             denom = jnp.maximum(count, 1)
             metrics = jax.tree_util.tree_map(
                 lambda x: x / denom.astype(x.dtype), metrics
             )
+        if learn:
+            metrics = {**metrics, **learn}
         outs = (state, metrics)
         if extra_outputs is not None:
             outs = outs + (extra_outputs(state),)
@@ -309,6 +336,11 @@ def run_train_burst(
 
     ``probe`` (an ``obs.LoopProbe`` or anything with ``.lap(name)``) gets
     ``train_dispatch``/``metric_fetch`` lap marks around the two phases.
+
+    When the step's metrics carry ``learn/`` probe keys (obs/learn), the
+    stacked probe subtree is split off before the fetch/pacing logic and fed
+    to the installed sentinel — one extra scalar pull per burst at most,
+    nothing when probes are off (the keys simply don't exist).
     """
     scanned = tuple(scanned)
     n = int(np.shape(scanned[0])[0])
@@ -320,6 +352,7 @@ def run_train_burst(
         specs = shape_specs(burst_args) if want_cost else None
         out = train_fn.burst(*burst_args)
         agent_state, metrics = out[0], out[1]
+        metrics, learn_dev = split_probes(metrics)
         extras = tuple(out[2:])
         add_train_burst(steps=n, dispatches=1)
         if specs is not None:
@@ -336,12 +369,29 @@ def run_train_burst(
         specs = None
         metrics = None
         out = None
+        learn_rows = []
         for i in range(n):
             step_args = (agent_state, data_stack, np.int32(i), np.int32(1)) + scanned
             if specs is None and want_cost:
                 specs = shape_specs(step_args)
             out = train_fn.burst(*step_args)
             agent_state, metrics = out[0], out[1]
+            metrics, learn_i = split_probes(metrics)
+            if learn_i:
+                # each count=1 call writes exactly slot i of its [n] learn
+                # buffers; that row is bitwise the fused stack's row i (same
+                # executable wrote it)
+                learn_rows.append(
+                    jax.tree_util.tree_map(
+                        lambda x: jax.lax.index_in_dim(x, i, 0, keepdims=False),
+                        learn_i,
+                    )
+                )
+        learn_dev = (
+            {k: jnp.stack([r[k] for r in learn_rows]) for k in learn_rows[0]}
+            if learn_rows
+            else None
+        )
         extras = tuple(out[2:]) if out is not None else ()
         add_train_burst(steps=n, dispatches=n)
         if specs is not None:
@@ -354,6 +404,10 @@ def run_train_burst(
             )
     if probe is not None:
         probe.lap("train_dispatch")
+    # learn-probe feed: at most ONE extra device_get per burst (cadence- and
+    # install-gated inside observe_probes; uninstrumented runs see no learn
+    # keys at all and pay nothing here)
+    _learn.observe_probes(learn_dev)
     if metrics is not None and fetch_metrics:
         metrics = jax.device_get(metrics)
     elif metrics is not None:

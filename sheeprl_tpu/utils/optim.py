@@ -11,15 +11,34 @@ updates inside the jitted step instead of host-side mutation.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import optax
+
+#: clip-threshold side table keyed by id() of the returned transformation.
+#: ``GradientTransformation`` is a NamedTuple (no attributes, no weakrefs),
+#: so the factory records the threshold here and keeps a strong reference to
+#: the tx itself — the identity check in :func:`clip_norm_of` makes a
+#: recycled id() harmless. This is how the learn probes SURFACE the clip
+#: threshold (``learn/clip_frac``) instead of recomputing it from config.
+_CLIP_NORMS: Dict[int, Tuple[optax.GradientTransformation, float]] = {}
 
 
 def _clipped(tx: optax.GradientTransformation, max_grad_norm: Optional[float]) -> optax.GradientTransformation:
     if max_grad_norm and max_grad_norm > 0:
-        return optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+        out = optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+        _CLIP_NORMS[id(out)] = (out, float(max_grad_norm))
+        return out
     return tx
+
+
+def clip_norm_of(tx) -> Optional[float]:
+    """The ``clip_by_global_norm`` threshold this factory wrapped ``tx``
+    with, or None when the optimizer is unclipped (or not from here)."""
+    entry = _CLIP_NORMS.get(id(tx))
+    if entry is not None and entry[0] is tx:
+        return entry[1]
+    return None
 
 
 def Adam(

@@ -610,6 +610,35 @@ def test_p2e_dv1_finetuning_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch
     _assert_ckpt_bitwise(tmp_path, "fk1", "fk4", written=8)
 
 
+@pytest.mark.slow
+def test_p2e_dv3_finetuning_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """P2E-DV3 finetuning equivalence: combines the DV3 wrinkle
+    (params-dependent fresh player state, resets applied host-side against a
+    per-params-version cache) with the finetuning wrinkle (every burst is
+    clamped to the exploration→task actor switch at ``learning_starts`` and
+    the resuming plan skips the random phase) — act_burst=4 from the same
+    exploration checkpoint reproduces the per-step finetuning run bitwise
+    end-to-end. Slow-marked: three e2e runs (exploration seed + two
+    finetunings)."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    extras = ["algo.world_model.discrete_size=4", "algo.ensembles.n=2"]
+    cli.run(_dreamer_burst_args(tmp_path, "p2e_dv3_exploration", "f3e", extras))
+    expl = sorted(
+        glob.glob(f"{tmp_path}/logs/**/f3e/**/checkpoint/ckpt_*_0", recursive=True)
+    )
+    assert expl, "no exploration checkpoint written"
+    fine = extras + [f"checkpoint.exploration_ckpt_path={os.path.abspath(expl[-1])}"]
+    cli.run(_dreamer_burst_args(tmp_path, "p2e_dv3_finetuning", "f3k1", fine))
+    cli.run(
+        _dreamer_burst_args(
+            tmp_path, "p2e_dv3_finetuning", "f3k4", fine + ["env.act_burst=4"]
+        )
+    )
+    _assert_ckpt_bitwise(tmp_path, "f3k1", "f3k4", written=8)
+
+
 def test_dreamer_v2_fused_xla_bitwise_off_e2e(tmp_path, monkeypatch):
     """The fused-kernel knob (ISSUE 13) must not change a single bit of a
     DV2 run on CPU: ``algo.fused_kernels=xla`` resolves to ``pad_to=1``

@@ -24,5 +24,7 @@ def _reset_telemetry_globals():
     set_value_guard(None)
 
     from sheeprl_tpu.obs import hist as obs_hist
+    from sheeprl_tpu.obs import learn as obs_learn
 
     obs_hist.install(None)
+    obs_learn.install(None)

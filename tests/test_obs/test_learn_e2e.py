@@ -1,0 +1,165 @@
+"""Learning-health acceptance: the divergence story end to end on real SAC
+CPU runs (howto/learning_health.md).
+
+One seeded SAC Pendulum run with an injected LR spike
+(``metric.telemetry.learn.inject_lr_spike_*``) must produce
+``learn_criticals >= 1``, a ``flight_learn_divergence_*.json`` evidence
+dump, and a critical event timestamped BEFORE the first non-finite value —
+while the same run without the injection reports zero sentinel events and
+final parameters bitwise identical to a probes-disabled run (the plane's
+zero-cost-when-off contract at entrypoint scale). ``tools/run_report.py``
+must render the spike run's report with the CRITICAL verdict and flag it in
+``--compare`` mode against the clean run.
+"""
+
+import glob
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu import cli
+
+TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "tools"
+)
+
+#: update index the LR spike fires at — past the sentinel's 20-sample warmup
+#: (updates start training at learning_starts/num_envs = 32)
+_SPIKE_AT = 180
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(name, os.path.join(TOOLS, f"{name}.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _sac_args(tmp_path, run_name, extra=()):
+    return [
+        "exp=sac",
+        "env=gym",
+        "env.id=Pendulum-v1",
+        "env.sync_env=True",
+        "env.num_envs=2",
+        "env.capture_video=False",
+        "env.act_burst=4",
+        "seed=5",
+        "fabric.accelerator=cpu",
+        "fabric.devices=1",
+        "total_steps=512",
+        "algo.learning_starts=64",
+        "algo.hidden_size=8",
+        "algo.run_test=False",
+        "per_rank_batch_size=16",
+        "buffer.size=1024",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "metric.log_level=1",
+        "metric.log_every=100",
+        "metric.telemetry.enabled=true",
+        "metric.telemetry.trace=false",
+        f"root_dir={tmp_path}/logs",
+        f"run_name={run_name}",
+        *extra,
+    ]
+
+
+def _run_dir(tmp_path, run_name):
+    tels = sorted(
+        glob.glob(f"{tmp_path}/logs/**/{run_name}/**/telemetry.json", recursive=True)
+    )
+    assert tels, f"no telemetry.json written for {run_name}"
+    return os.path.dirname(tels[-1])
+
+
+def _summary(run_dir):
+    with open(os.path.join(run_dir, "telemetry.json")) as f:
+        return json.load(f)
+
+
+def _ckpt_arrays(tmp_path, run_name):
+    d = sorted(glob.glob(f"{tmp_path}/logs/**/{run_name}/**/ckpt_*_0", recursive=True))
+    assert d, f"no checkpoint written for {run_name}"
+    out = {}
+    for f in sorted(glob.glob(os.path.join(d[-1], "*.npz"))):
+        z = np.load(f)
+        for k in z.files:
+            out[(os.path.basename(f), k)] = z[k]
+    return out
+
+
+@pytest.mark.slow
+def test_sac_divergence_acceptance(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+
+    # -- spike run: LR x1e6 once at update _SPIKE_AT -------------------------
+    cli.run(
+        _sac_args(
+            tmp_path,
+            "spike",
+            (
+                f"metric.telemetry.learn.inject_lr_spike_at={_SPIKE_AT}",
+                "metric.telemetry.learn.inject_lr_spike_factor=1000000",
+            ),
+        )
+    )
+    spike_dir = _run_dir(tmp_path, "spike")
+    spike = _summary(spike_dir)
+    assert spike["learn_criticals"] >= 1, spike.get("learn")
+    learn = spike["learn"]
+    # the flight recorder captured the divergence as evidence
+    dumps = glob.glob(os.path.join(spike_dir, "telemetry", "flight_learn_divergence_*.json"))
+    assert dumps, "no learn_divergence flight dump written"
+    # acceptance ordering: the first critical fired BEFORE the first
+    # non-finite value anywhere (probe, gradient, or logged metric)
+    crit_ts = min(
+        e["ts_unix"]
+        for e in learn["events"]
+        if e["severity"] == "critical"
+    )
+    assert learn["first_nonfinite_ts"] is not None, (
+        "the injected spike must drive the run to a non-finite value "
+        "(otherwise the before-NaN ordering is vacuous)"
+    )
+    assert crit_ts <= learn["first_nonfinite_ts"]
+    # and the first critical must be the explosion grading, not the NaN
+    # itself arriving (a NaN-triggered critical would be timestamped AT the
+    # non-finite moment, not before it)
+    first_crit = next(e for e in learn["events"] if e["severity"] == "critical")
+    assert first_crit["reason"] == "sustained_explosion", learn["events"]
+
+    # -- clean run: same seed, no injection → zero events --------------------
+    cli.run(_sac_args(tmp_path, "clean"))
+    clean_dir = _run_dir(tmp_path, "clean")
+    clean = _summary(clean_dir)
+    assert clean["learn_warnings"] == 0 and clean["learn_criticals"] == 0, clean.get("learn")
+    assert clean["learn_probe_fetches"] > 0  # the plane WAS on and observing
+    assert clean["grad_norm_p95"] is not None
+
+    # -- probes-off run: bitwise-identical final params ----------------------
+    cli.run(
+        _sac_args(tmp_path, "probesoff", ("metric.telemetry.learn.enabled=false",))
+    )
+    off = _summary(_run_dir(tmp_path, "probesoff"))
+    assert off.get("learn_probe_fetches", 0) == 0  # paid nothing
+    a = _ckpt_arrays(tmp_path, "clean")
+    b = _ckpt_arrays(tmp_path, "probesoff")
+    assert a and a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=str(k))
+
+    # -- the unified run report ----------------------------------------------
+    run_report = _load_tool("run_report")
+    assert run_report.main([spike_dir]) == 0
+    report = open(os.path.join(spike_dir, "report.md")).read()
+    assert "CRITICAL — divergence events fired" in report
+    assert "sustained_explosion" in report
+    assert "flight_learn_divergence_" in report
+    # --compare flags the spike run against the clean one and exits non-zero
+    assert run_report.main([spike_dir, "--compare", clean_dir]) == 1
+    assert run_report.main([clean_dir, "--compare", clean_dir]) == 0

@@ -105,6 +105,14 @@ _LOWER_KEYS = (
     # gradient step — 1/n_samples when bursts fuse, 1.0 when a per-step
     # dispatch loop re-grew somewhere
     "train_dispatches_per_step",
+    # learning-health plane (obs/learn): a perf win that destabilizes the
+    # optimizer shows up here — grad_norm_p95 drifting up round over round,
+    # or warn/critical sentinel events appearing on a workload that used to
+    # run clean. update_ratio_p50 is directionless (collapse AND explosion
+    # are both bad) so it rides the line un-diffed.
+    "grad_norm_p95",
+    "learn_warnings",
+    "learn_criticals",
 )
 
 

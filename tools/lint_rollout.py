@@ -51,12 +51,12 @@ ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
 #: fresh-state copy cached per params version); p2e_dv1 exploration and
 #: finetuning followed (same carry layout as dreamer_v1; finetuning clamps
 #: each burst to the exploration→task actor switch at learning_starts so no
-#: burst spans the swap). Keep in sync with howto/rollout_engine.md's
-#: support matrix.
+#: burst spans the swap); p2e_dv3_finetuning followed (DV3 fresh-state
+#: reset cache + the same learning_starts burst clamp). Keep in sync with
+#: howto/rollout_engine.md's support matrix.
 GRANDFATHERED = {
     "p2e_dv2/p2e_dv2_exploration.py",
     "p2e_dv2/p2e_dv2_finetuning.py",
-    "p2e_dv3/p2e_dv3_finetuning.py",
 }
 
 #: helper files that legitimately step envs per-step (single eval episodes)

@@ -22,6 +22,12 @@ lint fails when a file under ``sheeprl_tpu/algos/`` re-grows its own copy:
   advances at the log boundary, so an entrypoint that logs rates but never
   ticks the profiler silently opts out of ``device_ms_per_step``/roofline
   coverage;
+- a ``register_train_cost`` or ``build_train_burst`` call without
+  ``learn_probes``/``observe_probes`` in the same file — an entrypoint that
+  declares its train cost (or builds a burst program) without wiring the
+  learning-health plane (``obs/learn``) ships no grad-norm/update-ratio
+  telemetry and the divergence sentinel is blind to it
+  (howto/learning_health.md);
 - a raw collective — ``jax.lax.pmean``/``psum``/``all_gather``/... or a
   direct ``fabric.all_gather``/``broadcast``/``barrier``/``all_reduce``
   call — instead of the instrumented chokepoints in
@@ -132,6 +138,15 @@ def lint_file(path: str) -> list:
              "(sheeprl_tpu.obs.profile_tick) must advance at the same log "
              "boundary or this entrypoint has no device_ms_per_step/roofline "
              "coverage")
+        )
+    cost_call = calls.get("register_train_cost", calls.get("build_train_burst"))
+    if cost_call is not None and "learn_probes" not in calls and "observe_probes" not in calls:
+        findings.append(
+            (cost_call,
+             "train cost registered without learning-health probe wiring — "
+             "compute sheeprl_tpu.obs.learn_probes inside the train step (or "
+             "feed the host side via observe_probes) so the divergence "
+             "sentinel covers this entrypoint (howto/learning_health.md)")
         )
     for node in ast.walk(tree):
         if (
