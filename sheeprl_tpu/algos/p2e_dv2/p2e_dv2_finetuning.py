@@ -25,7 +25,9 @@ from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.staging import make_replay_staging
+from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
+from sheeprl_tpu.plane import train_gated_burst_plan
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -236,36 +238,33 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         return play_actor
 
     per_rank_gradient_steps = 0
-    for update in range(start_step, num_updates + 1):
-        policy_step += n_envs
 
-        if update >= learning_starts and player_actor_type == "exploration":
-            player_actor_type = "task"
+    # Burst acting (tier b, howto/rollout_engine.md): K env steps per device
+    # dispatch, K = env.act_burst; 1 reproduces the per-step path exactly.
+    # The RSSM player state rides the burst carry next to the observation.
+    # The finetuning wrinkle is the actor switch: the player acts with the
+    # frozen exploration actor until ``learning_starts``, then with the task
+    # actor — the switch is re-checked once per burst and the burst plan is
+    # clamped so no burst ever spans it.
+    act_burst = max(int(cfg.env.get("act_burst", 1) or 1), 1)
+    n_sub = len(actions_dim)
+    state_box = {
+        "carry": {
+            "obs": obs,
+            "player": {k: np.asarray(v) for k, v in player_state.items()},
+        },
+        "policy_step": policy_step,
+    }
 
+    def _host_step_core(actions, real_actions, player_np):
+        state_box["policy_step"] += n_envs
+        # The next row's is_first mirrors the previous dones
+        step_data["is_first"] = step_data["dones"].copy()
         with span("Time/env_interaction_time", SumMetric(sync_on_compute=False), phase="env"):
-            norm_obs = normalize_obs_jnp(obs, cnn_keys)
-            root_key, act_key = jax.random.split(root_key)
-            actions_j, player_state = player_fns["exploration_action"](
-                play_wm,
-                player_actor_params(),
-                player_state,
-                norm_obs,
-                act_key,
-                jnp.float32(expl_amount),
-            )
-            actions = np.concatenate([np.asarray(a) for a in actions_j], -1)
-            if is_continuous:
-                real_actions = actions
-            else:
-                real_actions = np.stack(
-                    [np.argmax(np.asarray(a), axis=-1) for a in actions_j], axis=-1
-                )
-
-            step_data["is_first"] = step_data["dones"].copy()
             o, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
-            dones = np.logical_or(terminated, truncated).astype(np.float32)
+        dones = np.logical_or(terminated, truncated).astype(np.float32)
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             fi = infos["final_info"]
@@ -278,7 +277,9 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                         aggregator.update("Rewards/rew_avg", ep_rew)
                     if aggregator and "Game/ep_len_avg" in aggregator:
                         aggregator.update("Game/ep_len_avg", ep_len)
-                    fabric.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+                    fabric.print(
+                        f"Rank-0: policy_step={state_box['policy_step']}, reward_env_{i}={ep_rew}"
+                    )
 
         next_obs_np = {k: np.asarray(o[k]) for k in o}
         dones_idxes = np.nonzero(dones.reshape(-1))[0].tolist()
@@ -300,7 +301,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         step_data["rewards"] = clip_rewards_fn(rewards)[None]
         rb.add(step_data)
 
-        obs = prepare_obs(next_obs_np, cnn_keys, mlp_keys, n_envs)
+        new_obs = prepare_obs(next_obs_np, cnn_keys, mlp_keys, n_envs)
 
         if len(dones_idxes) > 0:
             reset_obs = prepare_obs(
@@ -317,16 +318,90 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             step_data["dones"][:, dones_idxes] = 0.0
             reset_mask = np.zeros((n_envs, 1), np.float32)
             reset_mask[dones_idxes] = 1.0
-            player_state = player_fns["reset_states"](
-                play_wm, player_state, jnp.asarray(reset_mask)
+            # same arithmetic as player_fns["reset_states"], applied host-side
+            keep = np.float32(1.0) - reset_mask
+            player_np = {k: keep * v for k, v in player_np.items()}
+
+        carry = {"obs": new_obs, "player": player_np}
+        state_box["carry"] = carry
+        return carry
+
+    def _host_env_step(*args):
+        actions_j = [np.asarray(a) for a in args[:n_sub]]
+        player_np = {
+            "actions": np.asarray(args[n_sub]),
+            "recurrent": np.asarray(args[n_sub + 1]),
+            "stochastic": np.asarray(args[n_sub + 2]),
+        }
+        actions = np.concatenate(actions_j, -1)
+        if is_continuous:
+            real_actions = actions
+        else:
+            real_actions = np.stack([np.argmax(a, axis=-1) for a in actions_j], axis=-1)
+        return _host_step_core(actions, real_actions, player_np)
+
+    def _act_fn(p, carry, key):
+        # the key advances inside the jitted burst with the same split order
+        # the per-step loop used (carried key first, act key second), so the
+        # K=1 key stream is bitwise the per-step stream
+        key, act_key = jax.random.split(key)
+        norm_obs = normalize_obs_jnp(carry["obs"], cnn_keys)
+        actions_j, new_player = player_fns["exploration_action"](
+            p["wm"], p["actor"], carry["player"], norm_obs, act_key, p["expl"]
+        )
+        cb_args = tuple(actions_j) + (
+            new_player["actions"],
+            new_player["recurrent"],
+            new_player["stochastic"],
+        )
+        return cb_args, key
+
+    burst_actor = BurstActor(_act_fn, _host_env_step, state_box["carry"])
+
+    update = start_step
+    while update <= num_updates:
+        # no random prefill here (resuming=True mirrors the per-step loop,
+        # which acts with the frozen exploration actor from step one)
+        n_act, _ = train_gated_burst_plan(
+            update,
+            act_burst,
+            learning_starts,
+            num_updates,
+            updates_before_training,
+            resuming=True,
+        )
+        if update < learning_starts:
+            # the acting actor flips exploration → task at learning_starts;
+            # clamp so the burst never spans the switch
+            n_act = max(min(n_act, learning_starts - update), 1)
+        if update >= learning_starts and player_actor_type == "exploration":
+            player_actor_type = "task"
+
+        with span("Time/rollout_time", SumMetric(sync_on_compute=False), phase="rollout"):
+            _, root_key = burst_actor.rollout(
+                {
+                    "wm": play_wm,
+                    "actor": player_actor_params(),
+                    "expl": jnp.float32(expl_amount),
+                },
+                state_box["carry"],
+                root_key,
+                n_act,
             )
+        # the burst program commits its inputs to the player's device;
+        # pull the carried key back to host numpy (uncommitted) so the
+        # possibly multi-device train program keeps accepting it
+        root_key = np.asarray(root_key)
+        policy_step = state_box["policy_step"]
 
-        updates_before_training -= 1
+        update += n_act
+        last = update - 1
+        updates_before_training -= n_act
 
-        if update >= learning_starts and updates_before_training <= 0:
+        if last >= learning_starts and updates_before_training <= 0:
             n_samples = (
                 cfg.algo.per_rank_pretrain_steps
-                if update == learning_starts
+                if last == learning_starts
                 else cfg.algo.per_rank_gradient_steps
             )
             metrics = None
@@ -351,7 +426,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                     policy_step=policy_step,
                     last_log=last_log,
                     train_step=train_step,
-                    update=update,
+                    update=last,
                     num_updates=num_updates,
                     policy_steps_per_update=policy_steps_per_update,
                     world_size=world_size,
@@ -392,7 +467,7 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                     aggregator.update("Params/exploration_amount", expl_amount)
 
         if cfg.metric.log_level > 0 and (
-            policy_step - last_log >= cfg.metric.log_every or update == num_updates
+            policy_step - last_log >= cfg.metric.log_every or last == num_updates
         ):
             if aggregator and not aggregator.disabled:
                 metrics_dict = aggregator.compute()
@@ -412,13 +487,13 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
-        if should_checkpoint(cfg, policy_step, last_checkpoint, update, num_updates):
+        if should_checkpoint(cfg, policy_step, last_checkpoint, last, num_updates):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": jax.device_get(agent_state),
                 "actor_exploration": jax.device_get(actor_expl_params),
                 "expl_decay_steps": expl_decay_steps,
-                "update": update * world_size,
+                "update": last * world_size,
                 "batch_size": cfg.per_rank_batch_size * world_size,
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,

@@ -202,32 +202,18 @@ def check_configs(cfg) -> None:
             UserWarning,
         )
 
-    # burst acting (env.act_burst, envs/rollout) is consumed by every
-    # entrypoint except the two grandfathered P2E-DV2 per-step loops; there
-    # a >1 value would silently act per-step — the exact silent-ignore trap
-    # the resume-override accounting closes, so warn
-    if int(cfg.env.get("act_burst", 1) or 1) > 1 and algo_name in (
-        "p2e_dv2_exploration",
-        "p2e_dv2_finetuning",
-    ):
-        warnings.warn(
-            f"env.act_burst={cfg.env.act_burst} is not consumed by "
-            f"'{algo_name}' — the P2E-DV2 loops are the last per-step acting "
-            "entrypoints (tools/lint_rollout.py grandfather list, "
-            "howto/rollout_engine.md)",
-            UserWarning,
-        )
-
     # in-run eval (eval.every_n_steps, sheeprl_tpu/evals/inrun) is wired into
-    # the coupled SAC loop; elsewhere the knob would silently do nothing —
-    # the same silent-ignore trap as env.act_burst above
+    # the coupled SAC and Dreamer loops; elsewhere the knob would silently do
+    # nothing — the silent-ignore trap the resume-override accounting closes
     if int((cfg.get("eval", {}) or {}).get("every_n_steps", 0) or 0) > 0 and algo_name not in (
         "sac",
+        "dreamer_v1",
+        "dreamer_v2",
         "dreamer_v3",
     ):
         warnings.warn(
             f"eval.every_n_steps={cfg.eval.every_n_steps} is only consumed by "
-            f"the coupled SAC and dreamer_v3 entrypoints for now; "
+            f"the coupled SAC and Dreamer (v1/v2/v3) entrypoints for now; "
             f"'{algo_name}' runs without in-run eval (howto/evaluation.md)",
             UserWarning,
         )
@@ -521,30 +507,19 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
     ckpt_path = eval_cfg.get("checkpoint_path")
     if not ckpt_path or ckpt_path == "???":
         raise ValueError("You must specify the checkpoint path: checkpoint_path=/path/to/ckpt")
-    if str(ckpt_path).startswith("registry:"):
-        # `registry:best:<algo>:<env id>` → the model registry's best record
-        # (evals/registry.py; deterministic mean/n/append-order resolution)
-        from sheeprl_tpu.evals.registry import ModelRegistry
+    # `registry:best:<algo>:<env id>` → the model registry's best record
+    # (evals/registry.py; deterministic mean/n/append-order resolution).
+    # Same resolver the serving gateway uses (sheeprl_tpu/serve).
+    from sheeprl_tpu.evals.registry import resolve_checkpoint_ref
 
-        parts = str(ckpt_path).split(":")
-        if len(parts) != 4 or parts[1] != "best":
-            raise ValueError(
-                "registry checkpoint refs look like registry:best:<algo>:<env id>, "
-                f"got {ckpt_path!r}"
-            )
-        registry = ModelRegistry(
-            str((eval_cfg.get("eval", {}) or {}).get("registry_dir", "logs/registry"))
-        )
-        record = registry.best(env=parts[3], algo=parts[2])
-        if record is None:
-            raise ValueError(
-                f"no registry record for algo={parts[2]!r} env={parts[3]!r} "
-                f"in {registry.path}"
-            )
-        ckpt_path = record["checkpoint"]
+    ckpt_path, record = resolve_checkpoint_ref(
+        ckpt_path,
+        str((eval_cfg.get("eval", {}) or {}).get("registry_dir", "logs/registry")),
+    )
+    if record is not None:
         print(
-            f"[registry] best {parts[2]} on {parts[3]}: {ckpt_path} "
-            f"(mean {record.get('metrics', {}).get('mean')})"
+            f"[registry] best {record.get('algo')} on {record.get('env')}: "
+            f"{ckpt_path} (mean {record.get('metrics', {}).get('mean')})"
         )
     cfg, log_dir = _load_run_config(ckpt_path)
     # eval-time service knobs come from the eval CLI's composed `eval` group
@@ -576,6 +551,27 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
     )
     cfg.checkpoint_path = ckpt_path
     eval_algorithm(cfg)
+
+
+def serve(args: Optional[Sequence[str]] = None) -> None:
+    """Serving entrypoint (sheeprl_tpu/serve, howto/serving.md): load a
+    checkpoint (or ``registry:best:`` ref) through the eval-builder registry
+    and serve batched ``act(obs)`` inference with request coalescing,
+    hot-swap, and a SIGTERM drain."""
+    enable_persistent_compilation_cache()
+    sheeprl_tpu.register_algorithms()
+    overrides = list(args) if args is not None else sys.argv[1:]
+    serve_cfg = compose(
+        "serve_config",
+        overrides=overrides,
+        allow_missing=("checkpoint_path",),
+    )
+    ckpt_path = serve_cfg.get("checkpoint_path")
+    if not ckpt_path or ckpt_path == "???":
+        raise ValueError("You must specify the checkpoint path: checkpoint_path=/path/to/ckpt")
+    from sheeprl_tpu.serve.gateway import run_serve_entrypoint
+
+    run_serve_entrypoint(serve_cfg)
 
 
 def registration(args: Optional[Sequence[str]] = None) -> None:

@@ -26,9 +26,15 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["ModelRegistry", "RegistryError", "REGISTRY_SCHEMA", "registry_config_hash"]
+__all__ = [
+    "ModelRegistry",
+    "RegistryError",
+    "REGISTRY_SCHEMA",
+    "registry_config_hash",
+    "resolve_checkpoint_ref",
+]
 
 #: schema tag stamped on every record (bump on breaking layout changes)
 REGISTRY_SCHEMA = "sheeprl_tpu/registry/v1"
@@ -65,6 +71,38 @@ def _manifest_config_hash(checkpoint: str) -> Optional[str]:
         return None
     value = manifest.get("config_hash") if isinstance(manifest, dict) else None
     return str(value) if value else None
+
+
+def resolve_checkpoint_ref(
+    ref: str, registry_dir: str = "logs/registry"
+) -> Tuple[str, Optional[Dict[str, Any]]]:
+    """Resolve a checkpoint reference to a concrete path.
+
+    ``registry:best:<algo>:<env id>`` resolves through :meth:`ModelRegistry.
+    best` (deterministic mean/n/append-order ranking) against
+    ``registry_dir``; anything else is already a path. Returns
+    ``(checkpoint_path, registry_record_or_None)`` so callers can surface
+    the resolved record (the eval CLI prints it, the serving gateway stamps
+    it into its status). Shared by ``cli.evaluation`` and
+    ``sheeprl_tpu.serve`` — the one place the ref grammar lives.
+    """
+    ref = str(ref)
+    if not ref.startswith("registry:"):
+        return ref, None
+    parts = ref.split(":")
+    if len(parts) != 4 or parts[1] != "best":
+        raise ValueError(
+            "registry checkpoint refs look like registry:best:<algo>:<env id>, "
+            f"got {ref!r}"
+        )
+    registry = ModelRegistry(str(registry_dir))
+    record = registry.best(env=parts[3], algo=parts[2])
+    if record is None:
+        raise ValueError(
+            f"no registry record for algo={parts[2]!r} env={parts[3]!r} "
+            f"in {registry.path}"
+        )
+    return str(record["checkpoint"]), record
 
 
 class ModelRegistry:

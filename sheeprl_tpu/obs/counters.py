@@ -47,6 +47,10 @@ __all__ = [
     "add_prefetch",
     "add_ring_gather",
     "add_rollout_burst",
+    "add_serve_batch",
+    "add_serve_failed",
+    "add_serve_requests",
+    "add_serve_swap",
     "add_train_burst",
     "note_plane_policy_version",
     "device_memory_stats",
@@ -159,6 +163,19 @@ class Counters:
         self.eval_rounds = 0
         self.eval_episodes = 0
         self.inrun_eval_publishes = 0
+        # policy-serving gateway (sheeprl_tpu/serve): act() requests accepted,
+        # coalesced batch dispatches paid for them (requests/batches is the
+        # coalescing factor), the rows those batches carried (rows/batches is
+        # mean batch occupancy), batches the dispatcher could not launch by
+        # their latency deadline (the device was still busy — the flight-
+        # recorder trigger), in-place model hot-swaps, and requests that
+        # failed (errored or abandoned at drain)
+        self.serve_requests = 0
+        self.serve_batches = 0
+        self.serve_batch_rows = 0
+        self.serve_deadline_misses = 0
+        self.serve_swaps = 0
+        self.serve_failed_requests = 0
         # learning-health plane (sheeprl_tpu/obs/learn): graded sentinel
         # events plus the extra device→host probe pulls actually paid (the
         # "uninstrumented runs pay nothing" invariant is asserted on
@@ -233,6 +250,12 @@ class Counters:
                 "eval_rounds": self.eval_rounds,
                 "eval_episodes": self.eval_episodes,
                 "inrun_eval_publishes": self.inrun_eval_publishes,
+                "serve_requests": self.serve_requests,
+                "serve_batches": self.serve_batches,
+                "serve_batch_rows": self.serve_batch_rows,
+                "serve_deadline_misses": self.serve_deadline_misses,
+                "serve_swaps": self.serve_swaps,
+                "serve_failed_requests": self.serve_failed_requests,
                 "learn_warnings": self.learn_warnings,
                 "learn_criticals": self.learn_criticals,
                 "learn_probe_fetches": self.learn_probe_fetches,
@@ -522,6 +545,46 @@ def add_inrun_eval_publishes(n: int = 1) -> None:
     if c is not None:
         with c._lock:
             c.inrun_eval_publishes += int(n)
+
+
+# -- policy-serving gateway accounting ----------------------------------------
+
+
+def add_serve_requests(n: int = 1) -> None:
+    """Record ``n`` act() requests accepted by the gateway (serve/batcher)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.serve_requests += int(n)
+
+
+def add_serve_batch(rows: int, deadline_miss: bool = False) -> None:
+    """Record one coalesced batch dispatch carrying ``rows`` requests;
+    ``deadline_miss`` marks a batch the dispatcher launched *after* its
+    latency deadline had already expired (device busy, not a partial fill)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.serve_batches += 1
+            c.serve_batch_rows += int(rows)
+            if deadline_miss:
+                c.serve_deadline_misses += 1
+
+
+def add_serve_swap(n: int = 1) -> None:
+    """Record ``n`` in-place gateway model hot-swaps (serve/model)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.serve_swaps += int(n)
+
+
+def add_serve_failed(n: int = 1) -> None:
+    """Record ``n`` failed serve requests (dispatch error or drain abandon)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.serve_failed_requests += int(n)
 
 
 # -- recompile accounting ---------------------------------------------------

@@ -1,0 +1,45 @@
+"""Policy-serving gateway (howto/serving.md).
+
+Batched-inference serving over the evaluation stack: checkpoints (or
+``registry:best:<algo>:<env id>`` refs) load through the eval-builder
+registry into a :class:`~sheeprl_tpu.serve.model.GatewayModel`; concurrent
+client ``act(obs)`` requests coalesce into one device dispatch per batch
+window (:class:`~sheeprl_tpu.serve.batcher.RequestBatcher`, fill-or-
+deadline); models hot-swap in place when a policy publication channel moves
+(:class:`~sheeprl_tpu.serve.model.PolicySwapper`); clients ride threads
+(:class:`~sheeprl_tpu.serve.client.LocalServeClient`) or processes over
+shared-memory slabs (:class:`~sheeprl_tpu.serve.rings.ActSlabRing`,
+:class:`~sheeprl_tpu.serve.client.RingServeClient`).
+
+Client code touches ONLY the client classes and :class:`ServeGateway` —
+never checkpoint loads or agent builders (``tools/lint_serve.py``).
+"""
+
+from sheeprl_tpu.serve.batcher import RequestBatcher, ServeClosed, ServeRequestError
+from sheeprl_tpu.serve.client import LocalServeClient, RingServeClient
+from sheeprl_tpu.serve.gateway import (
+    ServeContext,
+    ServeGateway,
+    rescore_through_gateway,
+    run_serve_entrypoint,
+    serve_settings,
+)
+from sheeprl_tpu.serve.model import GatewayModel, PolicySwapper, load_gateway_model
+from sheeprl_tpu.serve.rings import ActSlabRing
+
+__all__ = [
+    "ActSlabRing",
+    "GatewayModel",
+    "LocalServeClient",
+    "PolicySwapper",
+    "RequestBatcher",
+    "RingServeClient",
+    "ServeClosed",
+    "ServeContext",
+    "ServeGateway",
+    "ServeRequestError",
+    "load_gateway_model",
+    "rescore_through_gateway",
+    "run_serve_entrypoint",
+    "serve_settings",
+]

@@ -1,0 +1,389 @@
+"""Request coalescing: N concurrent ``act(obs)`` requests → one device dispatch.
+
+The gateway's clients each hold *one observation row*; the model wants a
+batch. :class:`RequestBatcher` sits between them: a dispatcher thread
+collects pending requests and launches **one** ``policy.act`` per batch
+window — when the batch fills (``max_batch`` rows) or the oldest pending
+request's latency deadline (``deadline_s``) expires, whichever comes first.
+That is the SEED-RL inference-server shape (PAPERS.md: Espeholt et al.
+2019): inference cost amortizes across clients instead of paying one device
+program per caller.
+
+Determinism contract (the gateway-path parity check rides on it): rows are
+stacked in submission order, and the PRNG stream is ``key, act_key =
+jax.random.split(key)`` once per dispatch from ``PRNGKey(seed)`` — exactly
+the key schedule of :func:`sheeprl_tpu.evals.service.run_parallel_episodes`.
+A driver that routes every episode row of an eval pool through its own
+client (one full batch per pool step) therefore reproduces the eval
+service's returns bitwise at matched seeds.
+
+Recurrent families: the batcher keeps each client's recurrent state
+server-side (keyed by ``client_id``), concatenates the rows for a dispatch,
+and splits the new state back afterwards — clients stay stateless wire
+protocols. ``reset=True`` on a request replaces that client's state with a
+fresh ``init_state`` row before the dispatch (episode boundary).
+
+Hot-swap contract: :meth:`swap` atomically replaces the model reference
+*between* dispatches. A batch in flight finishes on the params it started
+with; the next batch rides the new ones; every response carries the version
+of the model that actually produced it (the monotone version telemetry the
+load harness asserts on). Recurrent client states survive a swap — the
+gateway only swaps within one run's publication channel, where carrying
+state across a params update is the actor-learner plane's normal mode.
+
+Failure isolation: a cancelled request (client disconnected mid-wait) is
+dropped at dispatch time without wedging the batch; a dispatch error fails
+only the requests in that batch (each waiter gets the exception), the
+dispatcher survives. ``drain()`` is the SIGTERM path: stop accepting,
+finish everything queued, then stop the thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["RequestBatcher", "ServeClosed", "ServeRequestError"]
+
+
+class ServeClosed(RuntimeError):
+    """The gateway is draining/closed and accepts no new requests."""
+
+
+class ServeRequestError(RuntimeError):
+    """A request failed (its batch's dispatch raised, or it was abandoned)."""
+
+
+class _Pending:
+    """One queued request: a ticket the client waits on."""
+
+    __slots__ = (
+        "client_id",
+        "obs",
+        "reset",
+        "t_submit",
+        "event",
+        "action",
+        "version",
+        "error",
+        "cancelled",
+    )
+
+    def __init__(self, client_id: str, obs: Dict[str, np.ndarray], reset: bool):
+        self.client_id = client_id
+        self.obs = obs
+        self.reset = bool(reset)
+        self.t_submit = time.monotonic()
+        self.event = threading.Event()
+        self.action: Optional[np.ndarray] = None
+        self.version: Optional[int] = None
+        self.error: Optional[BaseException] = None
+        self.cancelled = False
+
+
+def _stack_rows(rows: List[Any]):
+    """Stack per-client pytree rows along a new leading batch axis."""
+    import jax
+
+    return jax.tree.map(lambda *leaves: np.stack([np.asarray(l) for l in leaves]), *rows)
+
+
+def _concat_state_rows(rows: List[Any]):
+    """Concatenate per-client state slices (leading axis 1 each) to a batch."""
+    import jax
+
+    return jax.tree.map(
+        lambda *leaves: np.concatenate([np.asarray(l) for l in leaves], axis=0), *rows
+    )
+
+
+def _split_state_rows(state: Any, n: int) -> List[Any]:
+    """Split a batched state back into n single-row slices (leading axis)."""
+    import jax
+
+    return [jax.tree.map(lambda leaf: np.asarray(leaf)[i : i + 1], state) for i in range(n)]
+
+
+class RequestBatcher:
+    """Fill-or-deadline request coalescer around one servable model.
+
+    ``model`` must expose ``act(obs, state, key) -> (actions, new_state)``
+    (the :class:`~sheeprl_tpu.evals.service.EvalPolicy` contract, batched on
+    axis 0), ``init_state_rows(n)`` (fresh recurrent state for n rows, or
+    None for stateless families), and ``version`` (int, stamped on every
+    response) — :class:`sheeprl_tpu.serve.model.GatewayModel`.
+    """
+
+    def __init__(
+        self,
+        model,
+        max_batch: int = 64,
+        deadline_s: float = 0.010,
+        seed: int = 42,
+    ):
+        if int(max_batch) < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self.deadline_s = max(float(deadline_s), 0.0)
+        self._model = model
+        self._seed = int(seed)
+        self._key = None  # lazily PRNGKey(seed): no jax import before first use
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List[_Pending] = []
+        self._states: Dict[str, Any] = {}
+        self._draining = False
+        self._stopped = False
+        # standalone stats (live without telemetry installed — the load
+        # harness and the tests read these; the obs counters mirror them)
+        from sheeprl_tpu.obs.hist import StreamingHist
+
+        self._latency = StreamingHist()
+        self._stats_lock = threading.Lock()
+        self._requests = 0
+        self._batches = 0
+        self._batch_rows = 0
+        self._deadline_misses = 0
+        self._failed = 0
+        self._swaps = 0
+        self._versions_served: List[int] = []
+        self._thread = threading.Thread(
+            target=self._run, name="serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- client API
+
+    def submit(
+        self, client_id: str, obs: Dict[str, np.ndarray], reset: bool = False
+    ) -> _Pending:
+        """Queue one observation row; returns the ticket to :meth:`wait` on."""
+        pending = _Pending(str(client_id), obs, reset)
+        with self._cv:
+            if self._draining or self._stopped:
+                raise ServeClosed("gateway is draining: no new requests accepted")
+            self._queue.append(pending)
+            self._cv.notify_all()
+        from sheeprl_tpu.obs.counters import add_serve_requests
+
+        add_serve_requests(1)
+        with self._stats_lock:
+            self._requests += 1
+        return pending
+
+    def wait(self, pending: _Pending, timeout: Optional[float] = None):
+        """Block until the ticket's batch dispatched; returns
+        ``(action_row, version)`` or raises :class:`ServeRequestError`."""
+        if not pending.event.wait(timeout):
+            raise TimeoutError("serve request timed out waiting for its batch")
+        if pending.error is not None:
+            raise ServeRequestError(str(pending.error)) from pending.error
+        return pending.action, pending.version
+
+    def cancel(self, pending: _Pending) -> None:
+        """Client disconnect: the request is dropped at dispatch time; a
+        response already in flight is simply never read. Never wedges the
+        batch the request rode in."""
+        pending.cancelled = True
+        with self._cv:
+            self._cv.notify_all()
+
+    def forget_client(self, client_id: str) -> None:
+        """Drop a disconnected client's server-side recurrent state."""
+        with self._cv:
+            self._states.pop(str(client_id), None)
+
+    # ------------------------------------------------------------ gateway API
+
+    @property
+    def model(self):
+        return self._model
+
+    def swap(self, model) -> int:
+        """Atomically install ``model`` for all *subsequent* dispatches;
+        in-flight batches finish on the old reference. Returns the new
+        version."""
+        from sheeprl_tpu.obs.counters import add_serve_swap
+
+        self._model = model  # atomic reference assignment
+        add_serve_swap(1)
+        with self._stats_lock:
+            self._swaps += 1
+        return int(model.version)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """SIGTERM path: refuse new requests, finish every queued one, stop
+        the dispatcher. Returns True when the queue fully drained in time."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._queue:
+                    break
+            time.sleep(0.005)
+        self.close()
+        with self._cv:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        for p in leftovers:  # only on timeout: fail loud, never hang clients
+            p.error = ServeClosed("gateway stopped before this request dispatched")
+            p.event.set()
+        if leftovers:
+            from sheeprl_tpu.obs.counters import add_serve_failed
+
+            add_serve_failed(len(leftovers))
+            with self._stats_lock:
+                self._failed += len(leftovers)
+        return not leftovers
+
+    def close(self) -> None:
+        with self._cv:
+            self._draining = True
+            self._stopped = True
+            self._cv.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(timeout=30.0)
+
+    def stats(self) -> Dict[str, Any]:
+        """Snapshot for the load harness / live status."""
+        with self._stats_lock:
+            batches = self._batches
+            occupancy = (self._batch_rows / batches) if batches else 0.0
+            return {
+                "requests": self._requests,
+                "batches": batches,
+                "mean_batch_occupancy": round(occupancy, 3),
+                "deadline_misses": self._deadline_misses,
+                "failed_requests": self._failed,
+                "swaps": self._swaps,
+                "versions_served": list(self._versions_served),
+                "act_latency": self._latency.percentiles(),
+            }
+
+    # ------------------------------------------------------------- dispatcher
+
+    def _collect(self) -> List[_Pending]:
+        """Block until a batch is ready: full, or deadline-expired non-empty,
+        or stopping. Returns [] only when stopped with an empty queue."""
+        with self._cv:
+            while True:
+                while not self._queue:
+                    if self._stopped or (self._draining and not self._queue):
+                        return []
+                    self._cv.wait(timeout=0.05)
+                t_first = self._queue[0].t_submit
+                if self._draining:
+                    # finish queued work as fast as possible: no deadline wait
+                    batch = self._queue[: self.max_batch]
+                    del self._queue[: len(batch)]
+                    return batch
+                remaining = self.deadline_s - (time.monotonic() - t_first)
+                if len(self._queue) >= self.max_batch or remaining <= 0:
+                    batch = self._queue[: self.max_batch]
+                    del self._queue[: len(batch)]
+                    return batch
+                self._cv.wait(timeout=remaining)
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if not batch:
+                return
+            self._dispatch(batch)
+
+    def _batch_states(self, batch: List[_Pending], model) -> Optional[Any]:
+        """Per-client recurrent state rows for this batch, fresh where the
+        client is new or asked for a reset; None for stateless families."""
+        rows = []
+        stateless = True
+        for p in batch:
+            state = None if p.reset else self._states.get(p.client_id)
+            if state is None:
+                fresh = model.init_state_rows(1)
+                if fresh is None:
+                    rows.append(None)
+                    continue
+                state = fresh
+            stateless = False
+            rows.append(state)
+        if stateless:
+            return None
+        return _concat_state_rows(rows)
+
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        import jax
+
+        from sheeprl_tpu.obs import hist as _obs_hist
+        from sheeprl_tpu.obs.counters import add_serve_batch, add_serve_failed
+
+        t_start = time.monotonic()
+        # a miss is the dispatcher launching late (previous batch still on the
+        # device), not a deadline-expired partial fill — that one is by design
+        lateness = t_start - (batch[0].t_submit + self.deadline_s)
+        deadline_miss = self.deadline_s > 0 and lateness > 0.5 * self.deadline_s
+        live = [p for p in batch if not p.cancelled]
+        if not live:
+            return
+        model = self._model  # one atomic read: the whole batch rides one model
+        try:
+            obs = _stack_rows([p.obs for p in live])
+            state = self._batch_states(live, model)
+            if self._key is None:
+                self._key = jax.random.PRNGKey(self._seed)
+            self._key, act_key = jax.random.split(self._key)
+            actions, new_state = model.act(obs, state, act_key)
+            actions = np.asarray(actions)
+        except BaseException as exc:  # fail this batch's waiters, survive
+            for p in live:
+                p.error = exc
+                p.event.set()
+            add_serve_failed(len(live))
+            with self._stats_lock:
+                self._failed += len(live)
+            return
+        if new_state is not None:
+            with self._cv:
+                for p, row in zip(live, _split_state_rows(new_state, len(live))):
+                    self._states[p.client_id] = row
+        version = int(model.version)
+        now = time.monotonic()
+        for i, p in enumerate(live):
+            p.action = actions[i]
+            p.version = version
+            p.event.set()
+            latency = now - p.t_submit
+            self._latency.record(latency)
+            _obs_hist.observe("Time/serve_act_latency", latency)
+        add_serve_batch(len(live), deadline_miss=deadline_miss)
+        with self._stats_lock:
+            self._batches += 1
+            self._batch_rows += len(live)
+            if deadline_miss:
+                self._deadline_misses += 1
+            if not self._versions_served or self._versions_served[-1] != version:
+                self._versions_served.append(version)
+        if deadline_miss:
+            self._flag_deadline_miss(len(live), lateness)
+
+    def _flag_deadline_miss(self, rows: int, lateness_s: float) -> None:
+        """Arm the flight recorder on a late launch (telemetry runs only)."""
+        try:
+            from sheeprl_tpu.obs.telemetry import get_telemetry
+
+            tel = get_telemetry()
+            if tel is not None and tel.flight is not None:
+                tel.flight.trigger(
+                    "serve_deadline_miss",
+                    {
+                        "rows": int(rows),
+                        "lateness_ms": round(lateness_s * 1e3, 3),
+                        "deadline_ms": round(self.deadline_s * 1e3, 3),
+                    },
+                )
+        except Exception:
+            pass  # observability must never take the gateway down

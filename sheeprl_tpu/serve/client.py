@@ -1,0 +1,107 @@
+"""The serve client API — the only sanctioned way to get actions out of a
+gateway.
+
+Clients never load checkpoints, never build agents, never see params: they
+hand an observation row to the gateway and get ``(action_row, version)``
+back (``tools/lint_serve.py`` enforces exactly that — a file using the
+serve client API may not also reach for checkpoint loads or agent builds).
+
+Two transports, one contract:
+
+- :class:`LocalServeClient` — in-process (threads): submits straight into
+  the gateway's :class:`~sheeprl_tpu.serve.batcher.RequestBatcher`. What the
+  tests and the 1k-thread load harness drive.
+- :class:`RingServeClient` — cross-process over an
+  :class:`~sheeprl_tpu.serve.rings.ActSlabRing` slot (shared-memory slabs,
+  tiny commit queues). Picklable into a spawned client process.
+
+``act(obs_row, reset=False)`` returns ``(action_row, version)``; ``version``
+is the model version that actually produced the action — under a hot-swap
+it moves monotonically, and a client comparing versions across calls can
+see the swap happen mid-episode. ``reset=True`` marks an episode boundary
+(the gateway re-initializes that client's server-side recurrent state).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["LocalServeClient", "RingServeClient"]
+
+_client_counter = itertools.count()
+_counter_lock = threading.Lock()
+
+
+def _auto_id(prefix: str) -> str:
+    with _counter_lock:
+        return f"{prefix}{next(_client_counter)}"
+
+
+class LocalServeClient:
+    """In-process client: one logical actor, one recurrent-state key."""
+
+    def __init__(self, batcher, client_id: Optional[str] = None):
+        self._batcher = batcher
+        self.client_id = str(client_id) if client_id is not None else _auto_id("local")
+        self._pending = None
+        self._closed = False
+
+    def act(
+        self,
+        obs_row: Dict[str, np.ndarray],
+        reset: bool = False,
+        timeout: Optional[float] = 30.0,
+    ) -> Tuple[np.ndarray, int]:
+        """One request → one action row plus the serving model version."""
+        if self._closed:
+            raise RuntimeError(f"client {self.client_id} is closed")
+        pending = self._batcher.submit(self.client_id, obs_row, reset=reset)
+        self._pending = pending
+        try:
+            return self._batcher.wait(pending, timeout=timeout)
+        except TimeoutError:
+            self._batcher.cancel(pending)
+            raise
+        finally:
+            self._pending = None
+
+    def close(self) -> None:
+        """Disconnect: cancel anything in flight, drop server-side state."""
+        self._closed = True
+        pending = self._pending
+        if pending is not None:
+            self._batcher.cancel(pending)
+        self._batcher.forget_client(self.client_id)
+
+
+class RingServeClient:
+    """Cross-process client bound to one :class:`ActSlabRing` slot.
+
+    Construct in the parent with ``(ring, slot)`` and ship it to the child
+    (the ring is spawn-picklable); or construct in the child from the ring
+    it received. At most one request in flight — the client owns its slot.
+    """
+
+    def __init__(self, ring, slot: int):
+        self._ring = ring
+        self.slot = int(slot)
+        self.client_id = f"ring{self.slot}"
+        self._seq = 0
+
+    def act(
+        self,
+        obs_row: Dict[str, np.ndarray],
+        reset: bool = False,
+        timeout: float = 30.0,
+    ) -> Tuple[np.ndarray, int]:
+        self._seq += 1
+        self._ring.request(self.slot, obs_row, self._seq, reset)
+        return self._ring.wait_response(self.slot, self._seq, timeout=timeout)
+
+    def close(self) -> None:
+        """Nothing to release: the slot is owned for the ring's lifetime and
+        an unread response is discarded by the next act()'s seq check."""

@@ -1,0 +1,201 @@
+"""Servable models: manifest-validated checkpoint loads and hot-swap sources.
+
+:class:`GatewayModel` wraps the eval-builder registry's
+:class:`~sheeprl_tpu.evals.service.EvalPolicy` (one batched jitted act per
+family — the only algorithm-specific code the gateway ever touches) with the
+two things serving adds: a **version** stamped on every response and a
+**stable per-row state contract** (``init_state_rows``) for the batcher's
+server-side recurrent state.
+
+Two load paths, same builder:
+
+- :func:`load_gateway_model` — cold start from a checkpoint path or a
+  ``registry:best:<algo>:<env id>`` ref. The run's persisted config supplies
+  the architecture; ``fabric.load`` verifies the manifest's per-array
+  checksums (a torn or tampered checkpoint refuses to serve); the version is
+  the manifest's training step.
+- :class:`PolicySwapper` — live updates from a
+  :class:`~sheeprl_tpu.plane.publish.PolicyPoller` channel (the same
+  publication directory the in-run evaluator reads). A watcher thread polls
+  for new versions, rebuilds the policy via the same builder, and swaps it
+  into the batcher. A torn publication loads as None and is skipped — the
+  gateway keeps serving what it has (inherited from the ckpt layer's
+  torn-write resilience, never re-implemented here).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["GatewayModel", "PolicySwapper", "load_gateway_model"]
+
+
+class GatewayModel:
+    """One servable policy: ``act`` + ``init_state_rows`` + ``version``."""
+
+    def __init__(
+        self,
+        policy,
+        version: int,
+        algo: str,
+        env_id: str,
+        checkpoint: Optional[str] = None,
+    ):
+        self.policy = policy
+        self.version = int(version)
+        self.algo = str(algo)
+        self.env_id = str(env_id)
+        self.checkpoint = checkpoint
+
+    def act(self, obs, state, key):
+        """The EvalPolicy contract: batched obs/state in, actions/state out."""
+        return self.policy.act(obs, state, key)
+
+    def init_state_rows(self, n: int):
+        """Fresh recurrent state for ``n`` rows (None: stateless family)."""
+        if self.policy.init_state is None:
+            return None
+        return self.policy.init_state(int(n))
+
+
+def _forced_single_device_fabric(cfg):
+    """The eval CLI's single-device fabric override (cli.evaluation /
+    evals.service.evaluate_checkpoint): serving shares the eval stack's
+    1-device placement and keeps the run's PRNG implementation."""
+    from sheeprl_tpu.utils.utils import dotdict
+
+    run_fabric = cfg.get("fabric", {}) or {}
+    return dotdict(
+        {
+            "_target_": "sheeprl_tpu.fabric.Fabric",
+            "devices": 1,
+            "num_nodes": 1,
+            "strategy": "auto",
+            "accelerator": "auto",
+            "precision": run_fabric.get("precision", "32-true"),
+            "prng_impl": run_fabric.get("prng_impl", "rbg"),
+            "callbacks": [],
+        }
+    )
+
+
+def _builder_for(cfg) -> Callable:
+    from sheeprl_tpu.evals.service import find_eval_builder, registered_eval_builders
+
+    builder = find_eval_builder(cfg.algo.name)
+    if builder is None:
+        raise RuntimeError(
+            f"No eval-policy builder registered for '{cfg.algo.name}'. "
+            f"Registered: {registered_eval_builders()}"
+        )
+    return builder
+
+
+def load_gateway_model(
+    checkpoint_ref: str, registry_dir: str = "logs/registry"
+) -> "tuple[GatewayModel, Any, Any, Any]":
+    """Build a servable model from a checkpoint path or registry ref.
+
+    Returns ``(model, cfg, observation_space, action_space)`` — the extras
+    are what a swap source needs to rebuild policies against the same
+    architecture and spaces (one probe env per gateway, not per swap).
+    """
+    import sheeprl_tpu
+    from sheeprl_tpu.cli import _load_run_config
+    from sheeprl_tpu.config.instantiate import instantiate
+    from sheeprl_tpu.evals.registry import resolve_checkpoint_ref
+    from sheeprl_tpu.evals.service import _policy_version_of, _probe_spaces
+
+    sheeprl_tpu.register_algorithms()
+    checkpoint_path, _record = resolve_checkpoint_ref(checkpoint_ref, registry_dir)
+    cfg, _log_dir = _load_run_config(checkpoint_path)
+    cfg.env.capture_video = False
+    cfg.fabric = _forced_single_device_fabric(cfg)
+    fabric = instantiate(cfg.fabric)
+    state = fabric.load(checkpoint_path)  # manifest-validated (per-array checksums)
+    builder = _builder_for(cfg)
+    observation_space, action_space = _probe_spaces(cfg)
+    policy = builder(fabric, cfg, state, observation_space, action_space)
+    version = _policy_version_of(checkpoint_path) or 0
+    model = GatewayModel(
+        policy,
+        version=version,
+        algo=str(cfg.algo.name),
+        env_id=str(cfg.env.id),
+        checkpoint=os.path.abspath(checkpoint_path),
+    )
+    return model, cfg, observation_space, action_space
+
+
+class PolicySwapper:
+    """Watcher thread: new published policy versions → in-place swaps.
+
+    Polls a :class:`~sheeprl_tpu.plane.publish.PolicyPoller` channel for
+    versions newer than the serving model's, rebuilds the frozen policy with
+    the family's eval builder (``builder(None, cfg, published_state, ...)``
+    — the in-run evaluator's exact rebuild path), and calls ``swap_fn(new_
+    model)``. Rebuild + swap run entirely off the dispatch path; the batcher
+    picks the new reference up at its next batch.
+    """
+
+    def __init__(
+        self,
+        policy_root: str,
+        cfg,
+        observation_space,
+        action_space,
+        swap_fn: Callable[[GatewayModel], Any],
+        base_model: GatewayModel,
+        poll_interval_s: float = 0.2,
+    ):
+        from sheeprl_tpu.plane.publish import PolicyPoller
+
+        self._poller = PolicyPoller(str(policy_root), poll_interval_s=poll_interval_s)
+        self._cfg = cfg
+        self._obs_space = observation_space
+        self._act_space = action_space
+        self._swap_fn = swap_fn
+        self._builder = _builder_for(cfg)
+        self._algo = base_model.algo
+        self._env_id = base_model.env_id
+        self._last_version = int(base_model.version)
+        self._stop = threading.Event()
+        self.swaps = 0
+        self._thread = threading.Thread(
+            target=self._run, name="serve-policy-swapper", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        interval = self._poller.poll_interval_s
+        while not self._stop.wait(interval):
+            self.poll_once()
+
+    def poll_once(self) -> bool:
+        """One poll step (also the test hook): swap if a newer valid version
+        is published. Returns True on swap."""
+        try:
+            latest = self._poller.latest_version()
+            if latest is None or latest <= self._last_version:
+                return False
+            state = self._poller.load(latest)
+            if state is None:  # torn publication: keep serving what we have
+                return False
+            policy = self._builder(
+                None, self._cfg, state, self._obs_space, self._act_space
+            )
+        except Exception:
+            return False  # a bad publication must never take serving down
+        model = GatewayModel(
+            policy, version=latest, algo=self._algo, env_id=self._env_id
+        )
+        self._swap_fn(model)
+        self._last_version = int(latest)
+        self.swaps += 1
+        return True
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout=timeout)

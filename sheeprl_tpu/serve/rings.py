@@ -1,0 +1,198 @@
+"""Shared-memory act-request transport: client processes ↔ gateway server.
+
+The PR-7 trajectory plane moves whole rollout slabs learner-ward through
+preallocated shared memory with tiny queue records (plane/slabs.py); this
+ring is the same idea pointed the other way and sized for *serving*: each
+client owns exactly one slot of a preallocated observation slab and one slot
+of an action slab, so a request is
+
+  client: write obs row into its slot → enqueue ``(slot, seq, reset)``
+  server: batch whatever is queued → write action rows back into the same
+          slots → post ``(seq, version)`` on that client's response queue
+
+No observation or action ever crosses a pickling queue — only the tiny
+commit records do. One-slot-per-client is the credit protocol collapsed to
+its serving form: a client has at most one request in flight (it owns its
+slot), so there is no free-list to manage and a crashed client can never
+corrupt another client's rows.
+
+The ring is ``spawn``-picklable like the trajectory slabs: cached numpy
+views are dropped in ``__getstate__`` and rebuilt lazily on the other side.
+``close()`` sets the shared stop event — blocked clients raise
+:class:`~sheeprl_tpu.plane.slabs.PlaneClosed` instead of hanging.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ActSlabRing"]
+
+
+def _nbytes(shape: Tuple[int, ...], dtype: np.dtype) -> int:
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+
+
+class ActSlabRing:
+    """Preallocated obs/action slabs with one slot per client."""
+
+    def __init__(
+        self,
+        obs_spec: Dict[str, Tuple[Tuple[int, ...], Any]],
+        act_shape: Tuple[int, ...],
+        act_dtype: Any,
+        n_clients: int,
+        ctx=None,
+    ):
+        if int(n_clients) < 1:
+            raise ValueError(f"n_clients must be >= 1, got {n_clients}")
+        if ctx is None:
+            import multiprocessing as mp
+
+            ctx = mp.get_context("spawn")
+        self.n_clients = int(n_clients)
+        self.obs_spec = {
+            str(k): (tuple(shape), np.dtype(dtype)) for k, (shape, dtype) in obs_spec.items()
+        }
+        self.act_shape = tuple(act_shape)
+        self.act_dtype = np.dtype(act_dtype)
+        self._obs_blocks = {
+            k: ctx.RawArray("b", self.n_clients * _nbytes(shape, dtype))
+            for k, (shape, dtype) in self.obs_spec.items()
+        }
+        self._act_block = ctx.RawArray(
+            "b", self.n_clients * _nbytes(self.act_shape, self.act_dtype)
+        )
+        self._requests = ctx.Queue()
+        self._responses = [ctx.Queue() for _ in range(self.n_clients)]
+        self._stop = ctx.Event()
+        self._views: Optional[Dict[str, np.ndarray]] = None
+        self._act_view: Optional[np.ndarray] = None
+
+    @classmethod
+    def from_example(
+        cls, obs_row: Dict[str, np.ndarray], act_row: np.ndarray, n_clients: int, ctx=None
+    ) -> "ActSlabRing":
+        """Size the slabs from one example request/response row."""
+        spec = {
+            k: (tuple(np.asarray(v).shape), np.asarray(v).dtype)
+            for k, v in obs_row.items()
+        }
+        act = np.asarray(act_row)
+        return cls(spec, act.shape, act.dtype, n_clients, ctx=ctx)
+
+    # ------------------------------------------------------------------ views
+
+    def _obs_views(self) -> Dict[str, np.ndarray]:
+        if self._views is None:
+            self._views = {
+                k: np.frombuffer(self._obs_blocks[k], dtype=dtype).reshape(
+                    (self.n_clients,) + shape
+                )
+                for k, (shape, dtype) in self.obs_spec.items()
+            }
+        return self._views
+
+    def _act_views(self) -> np.ndarray:
+        if self._act_view is None:
+            self._act_view = np.frombuffer(self._act_block, dtype=self.act_dtype).reshape(
+                (self.n_clients,) + self.act_shape
+            )
+        return self._act_view
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_views"] = None  # numpy views don't cross process boundaries;
+        state["_act_view"] = None  # rebuilt lazily from the RawArrays
+        return state
+
+    # ------------------------------------------------------------ client side
+
+    def request(self, slot: int, obs_row: Dict[str, np.ndarray], seq: int, reset: bool) -> None:
+        """Write the obs row into this client's slot and commit the request."""
+        views = self._obs_views()
+        for k, (shape, dtype) in self.obs_spec.items():
+            views[k][slot] = np.asarray(obs_row[k], dtype=dtype).reshape(shape)
+        self._requests.put((int(slot), int(seq), bool(reset)))
+
+    def wait_response(self, slot: int, seq: int, timeout: float = 30.0) -> Tuple[np.ndarray, int]:
+        """Block for this client's response; returns ``(action_row, version)``.
+
+        Responses with a stale ``seq`` (from a request this client abandoned)
+        are discarded. Raises PlaneClosed when the ring stops mid-wait.
+        """
+        from sheeprl_tpu.plane.slabs import PlaneClosed
+
+        deadline = time.monotonic() + float(timeout)
+        q = self._responses[int(slot)]
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"serve ring response timed out (slot {slot})")
+            try:
+                got_seq, version, error = q.get(timeout=min(remaining, 0.1))
+            except Exception:
+                if self._stop.is_set():
+                    raise PlaneClosed("serve ring closed while waiting for a response")
+                continue
+            if got_seq != int(seq):
+                continue  # stale response from an abandoned request
+            if error is not None:
+                raise RuntimeError(f"serve request failed on the gateway: {error}")
+            return self._act_views()[int(slot)].copy(), int(version)
+
+    # ------------------------------------------------------------ server side
+
+    def next_requests(self, timeout: float = 0.05) -> List[Tuple[int, int, bool]]:
+        """Drain queued requests: block up to ``timeout`` for the first, then
+        take everything immediately available (the coalescing window proper
+        lives in the batcher — this just empties the wire)."""
+        import queue as _queue
+
+        out: List[Tuple[int, int, bool]] = []
+        try:
+            out.append(self._requests.get(timeout=timeout))
+        except _queue.Empty:
+            return out
+        while True:
+            try:
+                out.append(self._requests.get_nowait())
+            except _queue.Empty:
+                return out
+
+    def read_obs_row(self, slot: int) -> Dict[str, np.ndarray]:
+        """Copy one client's observation row out of the slab (the batcher
+        holds requests across the dispatch window; the client may not rewrite
+        its slot until it gets a response, but copies keep that invariant
+        local to the transport)."""
+        views = self._obs_views()
+        return {k: views[k][int(slot)].copy() for k in self.obs_spec}
+
+    def respond(
+        self, slot: int, seq: int, action_row: Optional[np.ndarray], version: int,
+        error: Optional[str] = None,
+    ) -> None:
+        if action_row is not None:
+            self._act_views()[int(slot)] = np.asarray(
+                action_row, dtype=self.act_dtype
+            ).reshape(self.act_shape)
+        self._responses[int(slot)].put((int(seq), int(version), error))
+
+    # -------------------------------------------------------------- lifecycle
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
+    def close(self) -> None:
+        self._stop.set()
+        # cancel queue feeder threads so interpreter shutdown never blocks on
+        # unflushed queue buffers (same discipline as plane/slabs.py)
+        for q in [self._requests, *self._responses]:
+            try:
+                q.cancel_join_thread()
+            except (AttributeError, OSError):
+                pass

@@ -639,6 +639,65 @@ def test_p2e_dv3_finetuning_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch
     _assert_ckpt_bitwise(tmp_path, "f3k1", "f3k4", written=8)
 
 
+@pytest.mark.slow
+def test_p2e_dv2_exploration_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """P2E-DV2 exploration equivalence (the last grandfathered conversion):
+    DV2 carry layout (zero reset states host-side, is_first row bookkeeping
+    in the burst callback) plus the dual-actor P2E params pytree and the
+    pretrain-at-learning-starts gate — act_burst=4 reproduces the per-step
+    run bitwise end-to-end. Slow-marked: two full ensemble-training e2e
+    runs."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    extras = [
+        "algo.world_model.discrete_size=4",
+        "algo.per_rank_pretrain_steps=1",
+        "algo.ensembles.n=2",
+    ]
+    cli.run(_dreamer_burst_args(tmp_path, "p2e_dv2_exploration", "e2k1", extras))
+    cli.run(
+        _dreamer_burst_args(
+            tmp_path, "p2e_dv2_exploration", "e2k4", extras + ["env.act_burst=4"]
+        )
+    )
+    _assert_ckpt_bitwise(tmp_path, "e2k1", "e2k4", written=8)
+
+
+@pytest.mark.slow
+def test_p2e_dv2_finetuning_burst_acting_k4_bitwise_k1_e2e(tmp_path, monkeypatch):
+    """P2E-DV2 finetuning equivalence: the converted loop clamps every burst
+    to the exploration→task actor switch at ``learning_starts``, never enters
+    the random phase (resuming plan), and keeps the DV2 is_first/pretrain
+    wrinkles — act_burst=4 from the same exploration checkpoint reproduces
+    the per-step finetuning run bitwise end-to-end. Slow-marked: three e2e
+    runs (exploration seed + two finetunings)."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu import cli
+
+    extras = [
+        "algo.world_model.discrete_size=4",
+        "algo.per_rank_pretrain_steps=1",
+        "algo.ensembles.n=2",
+    ]
+    cli.run(_dreamer_burst_args(tmp_path, "p2e_dv2_exploration", "f2e", extras))
+    expl = sorted(
+        glob.glob(f"{tmp_path}/logs/**/f2e/**/checkpoint/ckpt_*_0", recursive=True)
+    )
+    assert expl, "no exploration checkpoint written"
+    fine = [
+        f"checkpoint.exploration_ckpt_path={os.path.abspath(expl[-1])}",
+        "algo.per_rank_pretrain_steps=1",
+    ]
+    cli.run(_dreamer_burst_args(tmp_path, "p2e_dv2_finetuning", "f2k1", fine))
+    cli.run(
+        _dreamer_burst_args(
+            tmp_path, "p2e_dv2_finetuning", "f2k4", fine + ["env.act_burst=4"]
+        )
+    )
+    _assert_ckpt_bitwise(tmp_path, "f2k1", "f2k4", written=8)
+
+
 def test_dreamer_v2_fused_xla_bitwise_off_e2e(tmp_path, monkeypatch):
     """The fused-kernel knob (ISSUE 13) must not change a single bit of a
     DV2 run on CPU: ``algo.fused_kernels=xla`` resolves to ``pad_to=1``

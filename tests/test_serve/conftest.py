@@ -1,0 +1,72 @@
+"""Shared fixtures for the policy-serving gateway tests: one tiny trained
+SAC checkpoint (the test_evals recipe) reused by every module in this
+directory, plus a session gateway over it so the load/jit cost is paid once."""
+
+import glob
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def sac_checkpoint(tmp_path_factory):
+    """One tiny SAC Pendulum run shared by every serving test."""
+    workdir = tmp_path_factory.mktemp("servesac")
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    # cli.run flips class-level kill switches off metric.log_level=0; restore
+    # them or every later timer/aggregator test sees an empty registry
+    from sheeprl_tpu.utils.metric import MetricAggregator
+    from sheeprl_tpu.utils.timer import timer
+
+    saved = (MetricAggregator.disabled, timer.disabled)
+    try:
+        from sheeprl_tpu import cli
+
+        cli.run(
+            [
+                "exp=sac",
+                "env=gym",
+                "env.id=Pendulum-v1",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "env.num_envs=2",
+                "total_steps=64",
+                "algo.learning_starts=32",
+                "algo.hidden_size=8",
+                "per_rank_batch_size=4",
+                "buffer.size=64",
+                "buffer.memmap=False",
+                "checkpoint.every=0",
+                "checkpoint.save_last=True",
+                "metric.log_level=0",
+                "algo.run_test=False",
+                "fabric.devices=1",
+                "fabric.accelerator=cpu",
+                f"root_dir={workdir}/logs",
+                "run_name=servesac",
+                "seed=3",
+            ]
+        )
+    finally:
+        os.chdir(cwd)
+        MetricAggregator.disabled, timer.disabled = saved
+    ckpts = sorted(
+        glob.glob(f"{workdir}/logs/**/checkpoint/ckpt_*_0", recursive=True)
+    )
+    assert ckpts, "no checkpoint written by the fixture run"
+    return ckpts[-1]
+
+
+@pytest.fixture(scope="session")
+def sac_gateway(sac_checkpoint):
+    """A live gateway over the fixture checkpoint (default coalescing knobs).
+
+    Session-scoped so the checkpoint load + first jit compile is paid once;
+    tests that need their own drain/swap lifecycle build their own gateway.
+    """
+    from sheeprl_tpu.serve import ServeGateway
+
+    gateway = ServeGateway.from_checkpoint(sac_checkpoint, max_batch=8, deadline_s=0.02)
+    yield gateway
+    gateway.close()

@@ -1,0 +1,23 @@
+"""Spawned-client entry point for the cross-process ring transport test
+(tests/test_serve/test_rings.py): acts a few steps through the serve client
+it is handed and reports what it saw. Importable by the child interpreter via
+``ServeContext(entry="serve_ring_child:run")`` — the test puts this directory
+on the child's PYTHONPATH."""
+
+import json
+
+
+def run(client, spec):
+    import numpy as np
+
+    # size a zero observation row from the ring's own slab spec — the child
+    # never sees an env, a checkpoint, or an agent (tools/lint_serve.py)
+    obs_spec = client._ring.obs_spec
+    obs = {k: np.zeros(shape, dtype=dtype) for k, (shape, dtype) in obs_spec.items()}
+    versions, shapes = [], []
+    for step in range(int(spec.get("steps", 3))):
+        action, version = client.act(obs, reset=(step == 0), timeout=60.0)
+        versions.append(int(version))
+        shapes.append(list(np.asarray(action).shape))
+    with open(spec["out"], "w") as fh:
+        json.dump({"versions": versions, "shapes": shapes}, fh)

@@ -1,0 +1,336 @@
+"""RequestBatcher unit tests against a fake model: coalescing correctness
+(one dispatch serves k clients, responses routed bitwise vs a direct
+``policy.act`` replay), deadline-triggered partial batches, disconnect/cancel
+isolation, hot-swap under load, SIGTERM drain, deadline-miss accounting, and
+the per-client server-side recurrent-state contract
+(sheeprl_tpu/serve/batcher.py)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+class FakeModel:
+    """EvalPolicy-shaped stand-in: pure act = f(obs, key), records calls."""
+
+    def __init__(self, version=1, sleep_s=0.0, fail_times=0):
+        self.version = version
+        self.algo = "fake"
+        self.env_id = "FakeEnv-v0"
+        self.checkpoint = None
+        self.sleep_s = sleep_s
+        self.fail_times = fail_times
+        self.calls = []  # (obs_batch, key) per dispatch
+
+    def init_state_rows(self, n):
+        return None
+
+    def act(self, obs, state, key):
+        self.calls.append((obs, key))
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError("injected dispatch failure")
+        import jax
+
+        bias = np.float64(jax.random.uniform(key, ()))
+        actions = np.asarray(obs["obs"], dtype=np.float64) * 2.0 + bias
+        return actions, None
+
+    def replay(self, obs, key):
+        """Pure direct call with a recorded (obs, key) — the parity oracle."""
+        import jax
+
+        bias = np.float64(jax.random.uniform(key, ()))
+        return np.asarray(obs["obs"], dtype=np.float64) * 2.0 + bias
+
+
+class StatefulFakeModel(FakeModel):
+    """Recurrent stand-in: the action IS the client's step counter."""
+
+    def init_state_rows(self, n):
+        return np.zeros((n, 1), dtype=np.float64)
+
+    def act(self, obs, state, key):
+        self.calls.append((obs, key))
+        return np.asarray(state, dtype=np.float64).copy(), state + 1.0
+
+
+def _row(value):
+    return {"obs": np.asarray([float(value)], dtype=np.float64)}
+
+
+def _batcher(model, **kw):
+    from sheeprl_tpu.serve.batcher import RequestBatcher
+
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("deadline_s", 0.02)
+    kw.setdefault("seed", 123)
+    return RequestBatcher(model, **kw)
+
+
+def test_coalesces_k_clients_into_one_dispatch_routed_bitwise():
+    """8 concurrent act() calls → exactly one model.act; each client's row
+    comes back bitwise-equal to a direct policy.act replay of the batch."""
+    from sheeprl_tpu.serve.client import LocalServeClient
+
+    model = FakeModel(version=7)
+    batcher = _batcher(model, max_batch=8, deadline_s=5.0)
+    try:
+        results = {}
+        barrier = threading.Barrier(8)
+
+        def run(i):
+            client = LocalServeClient(batcher, client_id=f"c{i}")
+            barrier.wait()
+            results[i] = client.act(_row(i))
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+        [t.start() for t in threads]
+        [t.join(timeout=30) for t in threads]
+        assert len(results) == 8
+        assert len(model.calls) == 1, "8 requests must coalesce into ONE dispatch"
+        obs_batch, key = model.calls[0]
+        expected = model.replay(obs_batch, key)
+        # route check: client i sent obs value i; find its row in the batch
+        # the model actually saw and demand the bitwise-identical action back
+        sent = np.asarray(obs_batch["obs"]).reshape(8)
+        for i, (action, version) in results.items():
+            (row,) = np.nonzero(sent == float(i))[0:1]
+            assert row.size == 1
+            np.testing.assert_array_equal(action, expected[row[0]])
+            assert version == 7
+        stats = batcher.stats()
+        assert stats["requests"] == 8
+        assert stats["batches"] == 1
+        assert stats["mean_batch_occupancy"] == 8.0
+        assert stats["failed_requests"] == 0
+        assert stats["versions_served"] == [7]
+        assert stats["act_latency"]["count"] == 8
+    finally:
+        batcher.close()
+
+
+def test_deadline_expiry_dispatches_partial_batch():
+    """3 requests against max_batch=64: the deadline, not the fill, launches."""
+    from sheeprl_tpu.serve.client import LocalServeClient
+
+    model = FakeModel()
+    batcher = _batcher(model, max_batch=64, deadline_s=0.03)
+    try:
+        results = {}
+        barrier = threading.Barrier(3)
+
+        def run(i):
+            client = LocalServeClient(batcher, client_id=f"c{i}")
+            barrier.wait()
+            results[i] = client.act(_row(i))
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+        [t.start() for t in threads]
+        [t.join(timeout=30) for t in threads]
+        assert len(results) == 3 and all(v is not None for v in results.values())
+        stats = batcher.stats()
+        assert stats["batches"] == 1, "one deadline-expired partial batch"
+        assert stats["mean_batch_occupancy"] == 3.0
+    finally:
+        batcher.close()
+
+
+def test_cancelled_request_dropped_without_wedging_batch():
+    """A disconnects mid-wait; B (same batch) is served normally after."""
+    from sheeprl_tpu.serve.client import LocalServeClient
+
+    model = FakeModel()
+    batcher = _batcher(model, max_batch=2, deadline_s=10.0)
+    try:
+        ticket = batcher.submit("a", _row(0))
+        batcher.cancel(ticket)  # client a disconnects before the batch fills
+        client_b = LocalServeClient(batcher, client_id="b")
+        action, _version = client_b.act(_row(5))  # fills the batch → dispatch
+        np.testing.assert_array_equal(
+            action, model.replay(*model.calls[0])[0]
+        )
+        stats = batcher.stats()
+        assert stats["batches"] == 1
+        assert stats["mean_batch_occupancy"] == 1.0, "cancelled row filtered out"
+        # the batcher is still alive for later traffic
+        batcher.submit("b", _row(6))
+        batcher.submit("c", _row(7))
+        assert batcher.stats()["requests"] == 4
+    finally:
+        batcher.close()
+
+
+def test_client_timeout_cancels_and_batcher_survives():
+    """LocalServeClient.act timeout → TimeoutError + cancel; next act works."""
+    from sheeprl_tpu.serve.client import LocalServeClient
+
+    model = FakeModel(sleep_s=0.25)
+    batcher = _batcher(model, max_batch=1, deadline_s=0.0)
+    try:
+        client = LocalServeClient(batcher, client_id="slowpoke")
+        with pytest.raises(TimeoutError):
+            client.act(_row(1), timeout=0.01)
+        model.sleep_s = 0.0
+        action, _ = client.act(_row(2), timeout=30.0)
+        assert action is not None
+    finally:
+        batcher.close()
+
+
+def test_dispatch_error_fails_only_that_batch():
+    """A raising model fails its waiters with ServeRequestError; the
+    dispatcher thread survives and serves the next batch."""
+    from sheeprl_tpu.serve.batcher import ServeRequestError
+    from sheeprl_tpu.serve.client import LocalServeClient
+
+    model = FakeModel(fail_times=1)
+    batcher = _batcher(model, max_batch=1, deadline_s=0.0)
+    try:
+        client = LocalServeClient(batcher, client_id="c")
+        with pytest.raises(ServeRequestError, match="injected dispatch failure"):
+            client.act(_row(1))
+        action, _ = client.act(_row(2))
+        assert action is not None
+        stats = batcher.stats()
+        assert stats["failed_requests"] == 1
+        assert stats["batches"] == 1, "only the successful dispatch counts"
+    finally:
+        batcher.close()
+
+
+def test_hot_swap_under_load_zero_failures_monotone_versions():
+    """Clients hammer act() across a v1→v2 swap: zero failed requests, every
+    client's version telemetry is monotone, and versions_served records
+    exactly the [1, 2] transition."""
+    from sheeprl_tpu.serve.client import LocalServeClient
+
+    batcher = _batcher(FakeModel(version=1), max_batch=6, deadline_s=0.002)
+    try:
+        errors, seen = [], {}
+        # clients pause at the rendezvous mid-loop; the main thread swaps
+        # there, so phase 1 is guaranteed v1 traffic and phase 2 v2 traffic
+        before_swap = threading.Barrier(7)
+        after_swap = threading.Barrier(7)
+
+        def run(i):
+            client = LocalServeClient(batcher, client_id=f"c{i}")
+            versions = []
+            try:
+                for step in range(30):
+                    _action, version = client.act(_row(step))
+                    versions.append(version)
+                before_swap.wait(timeout=60)
+                after_swap.wait(timeout=60)
+                for step in range(30, 60):
+                    _action, version = client.act(_row(step))
+                    versions.append(version)
+            except Exception as exc:  # noqa: BLE001 - recorded for the assert
+                errors.append(exc)
+            seen[i] = versions
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+        [t.start() for t in threads]
+        before_swap.wait(timeout=60)
+        batcher.swap(FakeModel(version=2))
+        after_swap.wait(timeout=60)
+        [t.join(timeout=60) for t in threads]
+        assert not errors
+        stats = batcher.stats()
+        assert stats["failed_requests"] == 0
+        assert stats["swaps"] == 1
+        assert stats["versions_served"] == [1, 2], "both versions served, in order"
+        for versions in seen.values():
+            assert versions == sorted(versions), "per-client versions monotone"
+        assert any(2 in v for v in seen.values()), "swap visible mid-run"
+    finally:
+        batcher.close()
+
+
+def test_drain_finishes_inflight_then_rejects_new_requests():
+    """The SIGTERM contract: everything queued before drain() completes with
+    a real action; submits after drain raise ServeClosed."""
+    from sheeprl_tpu.serve.batcher import ServeClosed
+
+    model = FakeModel(sleep_s=0.05)
+    batcher = _batcher(model, max_batch=2, deadline_s=0.0)
+    try:
+        tickets = [batcher.submit(f"c{i}", _row(i)) for i in range(6)]
+        assert batcher.drain(timeout=30.0) is True
+        for ticket in tickets:
+            action, version = batcher.wait(ticket, timeout=1.0)
+            assert action is not None and version == 1
+        with pytest.raises(ServeClosed):
+            batcher.submit("late", _row(99))
+        assert batcher.stats()["failed_requests"] == 0
+    finally:
+        batcher.close()
+
+
+def test_deadline_miss_counted_when_dispatcher_launches_late():
+    """A request arriving while the device is busy past its deadline is a
+    recorded miss (late launch) — distinct from a by-design partial fill."""
+    model = FakeModel(sleep_s=0.1)
+    batcher = _batcher(model, max_batch=4, deadline_s=0.01)
+    try:
+        first = batcher.submit("a", _row(1))  # dispatches, holds device 100ms
+        time.sleep(0.03)
+        second = batcher.submit("b", _row(2))  # can't launch until ~100ms: late
+        batcher.wait(first, timeout=10)
+        batcher.wait(second, timeout=10)
+        assert batcher.stats()["deadline_misses"] >= 1
+    finally:
+        batcher.close()
+
+
+def test_recurrent_state_kept_per_client_and_reset_on_episode_boundary():
+    """Server-side state: each client gets its own counter stream; reset=True
+    re-initializes only that client; forget_client drops the slot."""
+    from sheeprl_tpu.serve.client import LocalServeClient
+
+    model = StatefulFakeModel()
+    batcher = _batcher(model, max_batch=1, deadline_s=0.0)
+    try:
+        a = LocalServeClient(batcher, client_id="a")
+        b = LocalServeClient(batcher, client_id="b")
+        assert [float(a.act(_row(0))[0][0]) for _ in range(3)] == [0.0, 1.0, 2.0]
+        assert float(b.act(_row(0))[0][0]) == 0.0, "b has its own state stream"
+        assert float(a.act(_row(0), reset=True)[0][0]) == 0.0, "episode boundary"
+        assert float(a.act(_row(0))[0][0]) == 1.0
+        a.close()  # disconnect drops the server-side slot
+        a2 = LocalServeClient(batcher, client_id="a")
+        assert float(a2.act(_row(0))[0][0]) == 0.0
+    finally:
+        batcher.close()
+
+
+def test_serve_counters_mirror_gateway_accounting():
+    """The obs counters see requests/batches/swaps/misses when installed."""
+    from sheeprl_tpu.obs import counters as C
+    from sheeprl_tpu.serve.client import LocalServeClient
+
+    saved = C.installed()
+    C.install(C.Counters())
+    try:
+        model = FakeModel(version=1)
+        batcher = _batcher(model, max_batch=1, deadline_s=0.0)
+        try:
+            client = LocalServeClient(batcher, client_id="c")
+            client.act(_row(1))
+            client.act(_row(2))
+            batcher.swap(FakeModel(version=2))
+            client.act(_row(3))
+            snap = C.installed().as_dict()
+            assert snap["serve_requests"] == 3
+            assert snap["serve_batches"] == 3
+            assert snap["serve_batch_rows"] == 3
+            assert snap["serve_swaps"] == 1
+            assert snap["serve_failed_requests"] == 0
+        finally:
+            batcher.close()
+    finally:
+        C.install(saved)

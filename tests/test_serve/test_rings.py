@@ -1,0 +1,128 @@
+"""Shared-memory ring transport tests: the raw slab protocol (request /
+respond roundtrips, stale-seq discard, close semantics), thread-mode ring
+clients against a live gateway, and a real spawned client process driving the
+gateway through ``ServeContext`` (sheeprl_tpu/serve/rings.py)."""
+
+import json
+import multiprocessing as mp
+import os
+import threading
+
+import numpy as np
+import pytest
+
+
+def _zero_obs(spec):
+    return {k: np.zeros(shape, dtype=dtype) for k, (shape, dtype) in spec.items()}
+
+
+# ---------------------------------------------------------------- raw slabs
+
+
+def test_ring_roundtrip_and_stale_seq_discard():
+    from sheeprl_tpu.serve.rings import ActSlabRing
+
+    ring = ActSlabRing.from_example(
+        {"obs": np.zeros(3, dtype=np.float32)}, np.zeros(1, dtype=np.float32), 2
+    )
+    try:
+        ring.request(0, {"obs": np.asarray([1, 2, 3], np.float32)}, seq=1, reset=True)
+        requests = ring.next_requests(timeout=1.0)
+        assert requests == [(0, 1, True)]
+        row = ring.read_obs_row(0)
+        np.testing.assert_array_equal(row["obs"], [1.0, 2.0, 3.0])
+        # a stale response (abandoned seq 0) must be skipped, not returned
+        ring.respond(0, 0, np.asarray([9.0], np.float32), version=1)
+        ring.respond(0, 1, np.asarray([4.5], np.float32), version=7)
+        action, version = ring.wait_response(0, 1, timeout=5.0)
+        np.testing.assert_array_equal(action, [4.5])
+        assert version == 7
+    finally:
+        ring.close()
+
+
+def test_closed_ring_raises_instead_of_hanging():
+    from sheeprl_tpu.plane.slabs import PlaneClosed
+    from sheeprl_tpu.serve.rings import ActSlabRing
+
+    ring = ActSlabRing.from_example(
+        {"obs": np.zeros(1, dtype=np.float32)}, np.zeros(1, dtype=np.float32), 1
+    )
+    ring.close()
+    with pytest.raises(PlaneClosed):
+        ring.wait_response(0, 1, timeout=5.0)
+
+
+# ----------------------------------------------------- against a live gateway
+
+
+@pytest.fixture(scope="module")
+def ring_gateway(sac_gateway):
+    """The session gateway serving a 4-slot ring (started once per module;
+    the gateway's session teardown closes it)."""
+    ring = sac_gateway.start_ring(4)
+    return sac_gateway, ring
+
+
+def test_thread_mode_ring_clients_get_versioned_actions(ring_gateway):
+    from sheeprl_tpu.serve.client import RingServeClient
+
+    gateway, ring = ring_gateway
+    expect_version = gateway.status()["model_version"]
+    act_shape = tuple(np.asarray(gateway.action_space.sample()).shape)
+    results = {}
+
+    def run(slot):
+        client = RingServeClient(ring, slot)
+        out = []
+        for step in range(3):
+            action, version = client.act(
+                _zero_obs(ring.obs_spec), reset=(step == 0), timeout=60.0
+            )
+            out.append((np.asarray(action).shape, version))
+        results[slot] = out
+
+    threads = [threading.Thread(target=run, args=(slot,)) for slot in range(3)]
+    [t.start() for t in threads]
+    [t.join(timeout=60) for t in threads]
+    assert sorted(results) == [0, 1, 2]
+    for out in results.values():
+        assert all(shape == act_shape for shape, _v in out)
+        assert all(version == expect_version for _s, version in out)
+
+
+def test_spawned_client_process_acts_through_the_ring(
+    ring_gateway, tmp_path, monkeypatch
+):
+    """A real spawned process (the PlayerContext shape, client side): the
+    child gets only the picklable ServeContext, acts over shared memory, and
+    reports the versions it saw."""
+    from sheeprl_tpu.serve.gateway import ServeContext, child_main
+
+    gateway, ring = ring_gateway
+    # the child interpreter must import serve_ring_child and sheeprl_tpu
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    extra = os.environ.get("PYTHONPATH", "")
+    monkeypatch.setenv(
+        "PYTHONPATH", os.pathsep.join(p for p in (here, repo, extra) if p)
+    )
+    out = tmp_path / "child.json"
+    ctx = mp.get_context("spawn")
+    proc = ctx.Process(
+        target=child_main,
+        args=(
+            ServeContext(
+                ring, slot=3, entry="serve_ring_child:run",
+                spec={"out": str(out), "steps": 3},
+            ),
+        ),
+    )
+    proc.start()
+    proc.join(timeout=240)
+    assert proc.exitcode == 0, "spawned serve client must exit cleanly"
+    report = json.loads(out.read_text())
+    expect_version = gateway.status()["model_version"]
+    assert report["versions"] == [expect_version] * 3
+    act_shape = list(np.asarray(gateway.action_space.sample()).shape)
+    assert report["shapes"] == [act_shape] * 3

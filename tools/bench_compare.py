@@ -31,7 +31,7 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 #: units where a larger value is a better result
-HIGHER_BETTER_UNITS = ("steps/s", "it/s", "fps", "return")
+HIGHER_BETTER_UNITS = ("steps/s", "env_steps/s", "it/s", "fps", "return")
 
 
 def find_rounds(repo: str, prefix: str = "BENCH") -> List[str]:

@@ -1,0 +1,381 @@
+#!/usr/bin/env python
+"""Policy-serving load harness: 1k concurrent clients against one gateway.
+
+Three modes, one evidence format (the BENCH_r round-doc shape, prefix
+``BENCH_SERVE`` — ``tools/bench_compare.py --prefix BENCH_SERVE`` diffs
+rounds):
+
+- **load** (default): train a tiny SAC/Pendulum checkpoint in a subprocess
+  (the bench_matrix cell machinery), stand up one
+  :class:`~sheeprl_tpu.serve.ServeGateway`, drive ``--clients`` concurrent
+  ``LocalServeClient`` threads for ``--duration`` seconds, publish a
+  hot-swap HALFWAY through, and record requests/s, act-latency
+  p50/p95/p99, mean batch occupancy, swap count, and failed-request count.
+  The run FAILS (non-zero exit) unless failed_requests == 0, the swap
+  happened mid-run, and every client saw monotone version telemetry.
+- ``--quick``: the same end-to-end path at CI scale (32 clients, ~3 s) —
+  the smoke step in .github/workflows/tests.yml.
+- ``--matrix-parity`` (rides along with the load phase; ``--skip-load``
+  for parity only): retrain >=2 MATRIX_r01.json cells at the matrix
+  protocol (4096 steps, train seed 5) and rescore each through the gateway
+  path (:func:`~sheeprl_tpu.serve.rescore_through_gateway`): the returns
+  must reproduce :func:`~sheeprl_tpu.evals.service.evaluate_checkpoint`
+  BITWISE at matched seeds (episodes=10, seed0=1000) — the evidence that
+  serving adds transport, not math.
+
+This file is allowlisted in tools/lint_serve.py: the harness plays both
+roles on purpose — it owns the gateway (the server side owns checkpoint
+loads and the publish channel) while simulating the client fleet.
+
+Latency caveat, disclosed in every line's ``protocol``: the gateway
+dispatches whatever coalesced in the window, and each distinct batch size
+compiles a distinct XLA program on first sight, so a load run's early
+seconds (and its p99) include compile stalls; ``deadline_misses`` counts
+the late launches they cause.
+
+Usage::
+
+    python tools/bench_serve.py [--clients 1000] [--duration 20]
+    python tools/bench_serve.py --quick
+    python tools/bench_serve.py --matrix-parity          # load + parity lines
+    python tools/bench_serve.py --matrix-parity --skip-load
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SCHEMA = "sheeprl_tpu/serve_bench/v1"
+
+#: tiny-but-real SAC training cell for the load modes (the eval-service test
+#: fixture's recipe: seconds to train, real actor, real checkpoint manifest)
+TINY_SAC_EXTRA = [
+    "env=gym",
+    "env.num_envs=2",
+    "algo.learning_starts=32",
+    "algo.hidden_size=8",
+    "per_rank_batch_size=4",
+    "buffer.size=64",
+    "buffer.memmap=False",
+    "fabric.devices=1",
+    "fabric.accelerator=cpu",
+]
+
+#: MATRIX_r01.json cells re-scored through the gateway path (matrix protocol:
+#: 4096 train steps at seed 5, 10 frozen-greedy episodes from seed0=1000)
+PARITY_CELLS = [
+    ("sac", "Pendulum-v1"),
+    ("ppo", "CartPole-v1"),
+]
+
+
+def _train(algo: str, env_id: str, workdir: str, total_steps: int, seed: int) -> str:
+    from tools.bench_matrix import last_checkpoint, train_cell
+
+    extra = TINY_SAC_EXTRA if total_steps <= 256 else []
+    run_dir, wall, rc = train_cell(
+        algo, env_id, workdir, total_steps, seed, extra=extra
+    )
+    ckpt = last_checkpoint(run_dir) if run_dir else None
+    if rc != 0 or not ckpt:
+        raise RuntimeError(
+            f"training {algo}/{env_id} failed (rc={rc}, run_dir={run_dir!r})"
+        )
+    print(f"[bench-serve] trained {algo}/{env_id} in {wall:.1f}s -> {ckpt}", flush=True)
+    return ckpt
+
+
+# ---------------------------------------------------------------------------
+# load mode
+# ---------------------------------------------------------------------------
+
+
+def run_load(args, workdir: str) -> Dict[str, Any]:
+    """Drive the client fleet; returns the evidence line (raises on failure
+    of the zero-failed-requests / mid-run-swap acceptance contract)."""
+    from sheeprl_tpu.ckpt.resume import read_checkpoint
+    from sheeprl_tpu.plane.publish import PolicyPublisher
+    from sheeprl_tpu.serve import ServeGateway
+
+    ckpt = args.checkpoint or _train(
+        "sac", "Pendulum-v1", workdir, total_steps=64, seed=3
+    )
+    gateway = ServeGateway.from_checkpoint(
+        ckpt,
+        max_batch=args.max_batch,
+        deadline_s=args.deadline_ms / 1e3,
+        seed=args.seed,
+    )
+    n_clients = int(args.clients)
+    base_version = gateway.status()["model_version"]
+
+    # the trainer's side of the swap: publish the checkpoint's own actor
+    # under a newer version (sac's in-run publish payload shape); a huge
+    # poll interval makes poll_once() below the only poll, so the swap
+    # point in the run is exactly where we put it
+    state = read_checkpoint(ckpt, verify=True)
+    poll_root = os.path.join(workdir, "published_policies")
+    publisher = PolicyPublisher(poll_root, algo="sac")
+    swapper = gateway.watch(poll_root, poll_interval_s=3600.0)
+
+    stop = threading.Event()
+    counts = [0] * n_clients
+    monotone = [True] * n_clients
+    saw_new_version = [False] * n_clients
+    failures: List[BaseException] = []
+
+    def client_loop(i: int) -> None:
+        client = gateway.client(f"load{i}")
+        obs = {
+            k: space.sample() for k, space in gateway.observation_space.spaces.items()
+        }
+        prev = -1
+        try:
+            while not stop.is_set():
+                _action, version = client.act(obs, timeout=120.0)
+                counts[i] += 1
+                if version < prev:
+                    monotone[i] = False
+                if version > base_version:
+                    saw_new_version[i] = True
+                prev = version
+        except BaseException as exc:  # noqa: BLE001 - the run asserts on this
+            failures.append(exc)
+
+    threads = [
+        threading.Thread(target=client_loop, args=(i,), daemon=True)
+        for i in range(n_clients)
+    ]
+    print(
+        f"[bench-serve] {n_clients} clients x {args.duration}s against "
+        f"{os.path.basename(ckpt)} (max_batch={args.max_batch}, "
+        f"deadline={args.deadline_ms}ms)",
+        flush=True,
+    )
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+
+    # hot-swap halfway through the run, under full load
+    time.sleep(args.duration / 2.0)
+    publisher.publish(base_version + 1000, {"agent": {"actor": state["agent"]["actor"]}})
+    swapped = swapper.poll_once()
+    swap_at_s = round(time.monotonic() - t0, 3)
+    time.sleep(max(args.duration - swap_at_s, 0.5))
+
+    # join the fleet BEFORE draining: in-flight requests finish normally,
+    # and nothing races a submit against the drain gate
+    stop.set()
+    for t in threads:
+        t.join(timeout=180.0)
+    wall = time.monotonic() - t0
+    drained = gateway.drain(timeout=60.0)
+    stats = gateway.batcher.stats()
+
+    requests = int(stats["requests"])
+    line = {
+        "metric": f"serve_load_{n_clients}_clients",
+        "value": round(requests / wall, 1),
+        "unit": "it/s",
+        "n_clients": n_clients,
+        "duration_s": round(wall, 2),
+        "requests": requests,
+        "batches": stats["batches"],
+        "mean_batch_occupancy": stats["mean_batch_occupancy"],
+        "p50_ms": stats["act_latency"].get("p50_ms"),
+        "p95_ms": stats["act_latency"].get("p95_ms"),
+        "p99_ms": stats["act_latency"].get("p99_ms"),
+        "deadline_misses": stats["deadline_misses"],
+        "swaps": stats["swaps"],
+        "swap_at_s": swap_at_s,
+        "versions_served": stats["versions_served"],
+        "failed_requests": stats["failed_requests"] + len(failures),
+        "clients_past_swap": int(sum(saw_new_version)),
+        "drained_clean": bool(drained),
+        "checkpoint": os.path.basename(ckpt),
+        "protocol": (
+            "tiny SAC/Pendulum actor served on CPU; LocalServeClient threads in "
+            "closed loops; one PolicyPublisher hot-swap at duration/2 under full "
+            "load; p99 includes first-sight compiles of new coalesced batch sizes"
+        ),
+    }
+
+    problems = []
+    if line["failed_requests"]:
+        problems.append(f"{line['failed_requests']} failed requests (must be 0)")
+    if not swapped or stats["swaps"] != 1:
+        problems.append(f"hot-swap did not land (swapped={swapped}, swaps={stats['swaps']})")
+    if stats["versions_served"] != [base_version, base_version + 1000]:
+        problems.append(
+            f"version telemetry {stats['versions_served']} != "
+            f"[{base_version}, {base_version + 1000}]"
+        )
+    if not all(monotone):
+        problems.append(f"{monotone.count(False)} clients saw non-monotone versions")
+    if not any(saw_new_version):
+        problems.append("no client ever saw the swapped-in version")
+    if not drained:
+        problems.append("drain timed out with requests still queued")
+    line["problems"] = problems
+    return line
+
+
+# ---------------------------------------------------------------------------
+# matrix-parity mode
+# ---------------------------------------------------------------------------
+
+
+def run_parity(args, workdir: str) -> List[Dict[str, Any]]:
+    """Retrain matrix cells and demand gateway-path rescores reproduce the
+    eval service bitwise at matched seeds."""
+    from sheeprl_tpu.evals.service import evaluate_checkpoint
+    from sheeprl_tpu.serve import rescore_through_gateway
+
+    committed = _committed_matrix_lines()
+    lines: List[Dict[str, Any]] = []
+    for algo, env_id in PARITY_CELLS:
+        ckpt = _train(algo, env_id, workdir, total_steps=4096, seed=5)
+        direct = evaluate_checkpoint(
+            ckpt, episodes=10, seed0=1000, write_json=False, write_registry=False
+        )
+        gated = rescore_through_gateway(ckpt, episodes=10, seed0=1000)
+        bitwise = (
+            list(gated["returns"]) == list(direct["returns"])
+            and list(gated["lengths"]) == list(direct["lengths"])
+            and gated["seeds"] == direct["seeds"]
+        )
+        matrix_line = committed.get(f"matrix.{algo}.{env_id}", {})
+        line = {
+            "metric": f"serve.parity.{algo}.{env_id}",
+            "value": gated["mean"],
+            "unit": "return",
+            "bitwise": bitwise,
+            "n": gated["n"],
+            "seed0": gated["seed0"],
+            "returns": gated["returns"],
+            "eval_service_returns": direct["returns"],
+            "mean_batch_occupancy": gated["mean_batch_occupancy"],
+            "batches": gated["batches"],
+            "failed_requests": gated["failed_requests"],
+            "versions_served": gated["versions_served"],
+            "train_steps": 4096,
+            "train_seed": 5,
+            "matrix_metric": f"matrix.{algo}.{env_id}",
+            "matrix_r_value": matrix_line.get("value"),
+            "protocol": (
+                "matrix cell retrained at the MATRIX protocol, then scored twice "
+                "on the fresh checkpoint: evaluate_checkpoint vs "
+                "rescore_through_gateway (every episode row behind its own serve "
+                "client, one coalesced dispatch per pool step); bitwise=true is "
+                "the acceptance bar"
+            ),
+        }
+        print(
+            f"[bench-serve] parity {algo}/{env_id}: bitwise={bitwise} "
+            f"mean={gated['mean']:.2f} occupancy={gated['mean_batch_occupancy']}",
+            flush=True,
+        )
+        lines.append(line)
+    return lines
+
+
+def _committed_matrix_lines() -> Dict[str, Dict[str, Any]]:
+    """Newest committed MATRIX_r*.json, for the informational cross-reference."""
+    try:
+        from tools.bench_compare import find_rounds, parse_round
+
+        rounds = find_rounds(REPO, "MATRIX")
+        return parse_round(rounds[-1]) if rounds else {}
+    except Exception:
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# round doc
+# ---------------------------------------------------------------------------
+
+
+def write_round(out_dir: str, lines: List[Dict[str, Any]], rc: int, wall_s: float) -> str:
+    from tools.bench_matrix import next_round
+
+    k = next_round(out_dir, "BENCH_SERVE")
+    tail = "".join(json.dumps(line) + "\n" for line in lines)
+    doc = {
+        "n": k,
+        "cmd": " ".join([os.path.basename(sys.executable)] + sys.argv),
+        "rc": rc,
+        "schema": SCHEMA,
+        "wall_s": round(wall_s, 1),
+        "tail": tail,
+    }
+    path = os.path.join(out_dir, f"BENCH_SERVE_r{k:02d}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--clients", type=int, default=1000, help="concurrent client threads")
+    parser.add_argument("--duration", type=float, default=20.0, help="load phase seconds")
+    parser.add_argument("--max-batch", type=int, default=256, help="gateway coalescing cap")
+    parser.add_argument("--deadline-ms", type=float, default=10.0, help="batch window deadline")
+    parser.add_argument("--seed", type=int, default=42, help="gateway act-key seed")
+    parser.add_argument("--checkpoint", default=None, help="serve this checkpoint instead of training one")
+    parser.add_argument("--quick", action="store_true", help="CI smoke: 32 clients, ~3s")
+    parser.add_argument("--matrix-parity", action="store_true",
+                        help="also retrain MATRIX cells and verify gateway-path bitwise parity")
+    parser.add_argument("--skip-load", action="store_true",
+                        help="with --matrix-parity: parity cells only, no load phase")
+    parser.add_argument("--out-dir", default=REPO, help="where BENCH_SERVE_r<k>.json lands")
+    parser.add_argument("--workdir", default="/tmp/bench_serve", help="training scratch dir")
+    args = parser.parse_args(argv)
+    if args.quick:
+        args.clients, args.duration = min(args.clients, 32), min(args.duration, 3.0)
+
+    os.makedirs(args.workdir, exist_ok=True)
+    t0 = time.monotonic()
+    lines: List[Dict[str, Any]] = []
+    problems: List[str] = []
+    if not (args.matrix_parity and args.skip_load):
+        line = run_load(args, args.workdir)
+        problems.extend(line.pop("problems"))
+        lines.append(line)
+    if args.matrix_parity:
+        parity = run_parity(args, args.workdir)
+        problems.extend(
+            f"{line['metric']}: gateway rescore NOT bitwise vs the eval service"
+            for line in parity
+            if not line.get("bitwise")
+        )
+        lines.extend(parity)
+
+    rc = 1 if problems else 0
+    path = write_round(args.out_dir, lines, rc, time.monotonic() - t0)
+    for line in lines:
+        print(json.dumps(line), flush=True)
+    print(f"[bench-serve] round doc: {path}", flush=True)
+    if problems:
+        print("[bench-serve] ACCEPTANCE FAILURES:", flush=True)
+        for p in problems:
+            print(f"  - {p}", flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,12 +52,11 @@ ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
 #: finetuning followed (same carry layout as dreamer_v1; finetuning clamps
 #: each burst to the exploration→task actor switch at learning_starts so no
 #: burst spans the swap); p2e_dv3_finetuning followed (DV3 fresh-state
-#: reset cache + the same learning_starts burst clamp). Keep in sync with
+#: reset cache + the same learning_starts burst clamp); p2e_dv2 exploration
+#: and finetuning were the last two (DV2 carry layout + the finetuning
+#: learning_starts clamp), emptying the list. Keep in sync with
 #: howto/rollout_engine.md's support matrix.
-GRANDFATHERED = {
-    "p2e_dv2/p2e_dv2_exploration.py",
-    "p2e_dv2/p2e_dv2_finetuning.py",
-}
+GRANDFATHERED = set()
 
 #: helper files that legitimately step envs per-step (single eval episodes)
 SKIP_BASENAMES = {"evaluate.py", "utils.py", "agent.py", "loss.py"}

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Serving-boundary lint: serve clients never load checkpoints or build agents.
+
+The policy-serving gateway (``sheeprl_tpu/serve``, howto/serving.md) exists
+so actors get actions from a *served* policy: one manifest-validated
+checkpoint load and one jitted act program on the gateway, N clients riding
+``act(obs) -> (action, version)`` over the client API. The anti-pattern it
+replaces is every actor loading the checkpoint and building the agent
+itself — N copies of the params, N compiles, and no single place to hot-swap
+or measure. That boundary is mechanical and recognizable: client code holds
+a ``LocalServeClient`` / ``RingServeClient`` / ``ServeContext`` and therefore
+has no business also reaching for checkpoint-loading or agent-building
+primitives.
+
+This lint flags any file outside ``sheeprl_tpu/serve/`` that BOTH uses the
+serve client API AND references a loading/building primitive
+(``find_eval_builder`` / ``build_agent`` / ``read_checkpoint`` /
+``load_gateway_model`` / ``GatewayModel`` / ``fabric.load``). Files that only
+*serve* (the gateway side owns checkpoints by design) or only *load* (the
+training/eval stacks) never trip.
+
+Files that legitimately play both roles are allowlisted EXPLICITLY below;
+the list is checked both ways (a file that stops tripping must be
+delisted), so a new boundary violation — or a cleanup — is always a visible
+diff here. ``tests/`` is out of scope: the serve tests exercise both sides
+of the wire on purpose.
+
+AST-based; comments/docstrings/strings are fine. Usage: ``python
+tools/lint_serve.py`` — non-zero exit with findings on violation. Wired
+into the CI tier-1 lane (.github/workflows/tests.yml).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: files that hold a serve client AND loading/building primitives on purpose.
+#: tools/bench_serve.py is the load harness: it owns the gateway end to end
+#: (trains the checkpoint, publishes the hot-swap payload — the server
+#: operator's side) while also simulating the 1k client fleet.
+ALLOWLIST = {
+    os.path.join("tools", "bench_serve.py"),
+}
+
+#: holding one of these names marks a file as serve-client code (plus any
+#: ``<gateway>.client(...)`` call, detected structurally below)
+CLIENT_NAMES = {
+    "LocalServeClient",
+    "RingServeClient",
+    "ServeContext",
+}
+
+#: checkpoint-loading / agent-building primitives clients may not touch
+BANNED_NAMES = {
+    "find_eval_builder",
+    "build_agent",
+    "read_checkpoint",
+    "load_gateway_model",
+    "GatewayModel",
+}
+
+
+def _names_used(tree: ast.AST) -> set:
+    """Every bare name, attribute tail, and from-import alias in the file,
+    plus the synthetic token ``fabric.load`` for that exact attribute call."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.add(node.attr)
+            if node.attr == "load" and isinstance(node.value, ast.Name) and (
+                node.value.id == "fabric"
+            ):
+                names.add("fabric.load")
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                names.add(alias.name)
+        elif isinstance(node, ast.Call):
+            # <gateway>.client(...) — the in-process client factory
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "client":
+                names.add(".client()")
+    return names
+
+
+def scan_file(path: str):
+    """Returns (uses_client_api, banned_hits) for one source file."""
+    with open(path, encoding="utf-8") as fh:
+        try:
+            tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return False, set()
+    names = _names_used(tree)
+    uses_client = bool(names & (CLIENT_NAMES | {".client()"}))
+    banned = names & (BANNED_NAMES | {"fabric.load"})
+    return uses_client, banned
+
+
+def iter_sources():
+    skip_dirs = {
+        os.path.join(REPO, "tests"),  # serve tests exercise both sides
+        os.path.join(REPO, "sheeprl_tpu", "serve"),  # the gateway IS the loader
+    }
+    for root_dir in (os.path.join(REPO, "sheeprl_tpu"), os.path.join(REPO, "tools"), REPO):
+        for dirpath, dirnames, filenames in os.walk(root_dir):
+            if any(dirpath.startswith(s) for s in skip_dirs):
+                continue
+            dirnames[:] = [d for d in dirnames if not d.startswith(".") and d != "__pycache__"]
+            if root_dir == REPO:
+                dirnames[:] = []  # repo root: top-level scripts only, no re-walk
+            for name in filenames:
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def main() -> int:
+    violations, clean_allowlisted = [], []
+    seen = set()
+    for path in iter_sources():
+        if path in seen:
+            continue
+        seen.add(path)
+        rel = os.path.relpath(path, REPO)
+        if rel == os.path.join("tools", "lint_serve.py"):
+            continue  # this file spells the banned names by definition
+        uses_client, banned = scan_file(path)
+        trips = uses_client and banned
+        if rel in ALLOWLIST:
+            if not trips:
+                clean_allowlisted.append(rel)
+            continue
+        if trips:
+            violations.append((rel, sorted(banned)))
+
+    rc = 0
+    if violations:
+        print("lint_serve: serve-client code reaching for loading/building primitives:")
+        for rel, banned in violations:
+            print(f"  {rel}: uses the serve client API AND {', '.join(banned)}")
+        print(
+            "\nClients get actions from the gateway (ServeGateway.client() /"
+            " RingServeClient) — never from their own checkpoint loads or"
+            " agent builds (howto/serving.md)."
+        )
+        rc = 1
+    if clean_allowlisted:
+        print("lint_serve: allowlisted files that no longer trip — delist them:")
+        for rel in clean_allowlisted:
+            print(f"  {rel}")
+        rc = 1
+    if rc == 0:
+        print(f"lint_serve: OK ({len(seen)} files scanned, boundary holds)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
