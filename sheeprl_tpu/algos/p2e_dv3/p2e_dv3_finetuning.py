@@ -401,6 +401,14 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
 
     burst_actor = BurstActor(_act_fn, _host_env_step, state_box["carry"])
 
+    # in-run eval (howto/evaluation.md): rank 0 publishes the frozen params
+    # through the policy channel every eval.every_n_steps; a separate process
+    # scores the task actor, so nothing below touches the train-step
+    # critical path
+    from sheeprl_tpu.evals.inrun import maybe_start_inrun_eval
+
+    inrun = maybe_start_inrun_eval(fabric, cfg, log_dir)
+
     update = start_step
     while update <= num_updates:
         # no random prefill here (resuming=True mirrors the per-step loop,
@@ -512,6 +520,14 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 if "Params/exploration_amount" in aggregator:
                     aggregator.update("Params/exploration_amount", expl_amount)
 
+        if inrun is not None and last >= learning_starts and inrun.due(policy_step):
+            # versioned by policy_step; the npz write runs on the publisher's
+            # writer thread, so the cost here is one params-sized device_get
+            inrun.maybe_publish(
+                policy_step,
+                {"agent": {"params": jax.device_get(agent_state["params"])}},
+            )
+
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or last == num_updates
         ):
@@ -556,6 +572,8 @@ def main(fabric, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 # drains the in-flight write) — leave the train loop cleanly
                 break
 
+    if inrun is not None:
+        inrun.close()
     staging.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.get("run_test", True) and not preemption_requested():

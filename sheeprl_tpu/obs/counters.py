@@ -51,6 +51,8 @@ __all__ = [
     "add_serve_failed",
     "add_serve_requests",
     "add_serve_swap",
+    "add_serve_traced",
+    "add_slo_alert",
     "add_train_burst",
     "note_plane_policy_version",
     "device_memory_stats",
@@ -176,6 +178,11 @@ class Counters:
         self.serve_deadline_misses = 0
         self.serve_swaps = 0
         self.serve_failed_requests = 0
+        # request-path observability (obs/reqtrace + obs/slo): requests whose
+        # six-stage span chain landed in the trace plane, and SLO burn-rate
+        # alert firings (fast + slow pairs; clears are not counted)
+        self.serve_traced_requests = 0
+        self.slo_alerts_fired = 0
         # learning-health plane (sheeprl_tpu/obs/learn): graded sentinel
         # events plus the extra device→host probe pulls actually paid (the
         # "uninstrumented runs pay nothing" invariant is asserted on
@@ -256,6 +263,8 @@ class Counters:
                 "serve_deadline_misses": self.serve_deadline_misses,
                 "serve_swaps": self.serve_swaps,
                 "serve_failed_requests": self.serve_failed_requests,
+                "serve_traced_requests": self.serve_traced_requests,
+                "slo_alerts_fired": self.slo_alerts_fired,
                 "learn_warnings": self.learn_warnings,
                 "learn_criticals": self.learn_criticals,
                 "learn_probe_fetches": self.learn_probe_fetches,
@@ -585,6 +594,22 @@ def add_serve_failed(n: int = 1) -> None:
     if c is not None:
         with c._lock:
             c.serve_failed_requests += int(n)
+
+
+def add_serve_traced(n: int = 1) -> None:
+    """Record ``n`` requests whose span chain landed in the trace plane."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.serve_traced_requests += int(n)
+
+
+def add_slo_alert(n: int = 1) -> None:
+    """Record ``n`` SLO burn-rate alert firings (obs/slo)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.slo_alerts_fired += int(n)
 
 
 # -- recompile accounting ---------------------------------------------------

@@ -244,6 +244,8 @@ def prometheus_text(snap: Dict[str, Any], prefix: str = "sheeprl") -> str:
         "staleness",
         "sources",
         "learn",
+        "serve_versions",
+        "slo",
     )
     for key, value in sorted(snap.items()):
         if key in skip:
@@ -296,6 +298,27 @@ def prometheus_text(snap: Dict[str, Any], prefix: str = "sheeprl") -> str:
         lbl = '{source="%s"}' % source
         for key, value in sorted(src_snap.items()):
             emit(_prom_name(key), value, lbl)
+    # serving-tier sections (serve/ops.py snapshots): per-version request /
+    # latency breakdown and the SLO engine's burn rates + alert states
+    for ver, rec in sorted((snap.get("serve_versions") or {}).items()):
+        lbl = '{version="%s"}' % ver
+        emit("serve_version_requests", rec.get("requests"), lbl)
+        for q_key, q in (("p50_ms", "0.5"), ("p95_ms", "0.95"), ("p99_ms", "0.99")):
+            emit(
+                "serve_version_latency_ms",
+                rec.get(q_key),
+                '{version="%s",quantile="%s"}' % (ver, q),
+            )
+    slo = snap.get("slo") or {}
+    emit("slo_cancelled_tickets", slo.get("cancelled_tickets"))
+    emit("slo_alerts_fired", slo.get("alerts_fired"))
+    for name, rec in sorted((slo.get("objectives") or {}).items()):
+        lbl = '{objective="%s"}' % name
+        emit("slo_burn_rate_fast", rec.get("burn_fast"), lbl)
+        emit("slo_burn_rate_slow", rec.get("burn_slow"), lbl)
+        emit("slo_alert_active", int(bool(rec.get("fast_active"))), '{objective="%s",alert="fast_burn"}' % name)
+        emit("slo_alert_active", int(bool(rec.get("slow_active"))), '{objective="%s",alert="slow_burn"}' % name)
+        emit("slo_objective_ok", int(rec.get("verdict") == "PASS"), lbl)
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,189 @@
+"""Per-request distributed tracing for the serving tier (Dapper-style).
+
+A sampled ``act()`` request carries one trace id from the client call site
+through the slab ring / batcher queue to the device dispatch, and lands as a
+six-stage span chain in the run's Chrome-trace plane::
+
+    client_enqueue -> ring_transit -> queue_wait -> batch_assembly
+                   -> device_dispatch -> respond
+
+The first two stages live on a **client lane** (their own Perfetto pid) and
+the last four on a **gateway lane**, both written as ``trace_serve_*.jsonl``
+so ``tools/trace_view.py`` merges them with the learner's trace onto one
+clock. All stamps are ``time.perf_counter()`` — CLOCK_MONOTONIC on Linux is
+system-wide, so stamps a ring client wrote in another process compare
+directly against the gateway's.
+
+Sampling is deterministic (every k-th request for ``serve.trace_sample_rate
+= 1/k``) so a seeded run always traces the same requests. With no tracer
+installed — or ``trace_sample_rate: 0`` — :func:`sample` is one global read
+returning None, and the request path does no extra work (the PR-4 span
+contract: instrumented code costs nothing in un-instrumented runs).
+
+This module is also the **sanctioned clock chokepoint** for ``serve/``:
+``tools/lint_telemetry.py`` rejects ad-hoc ``time.time()`` /
+``time.monotonic()`` / ``time.perf_counter()`` reads in the serving tier so
+every request timestamp flows through :func:`now` / :func:`unix_now` and
+stays comparable across the trace, latency-histogram, and SLO planes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "CLIENT_PID",
+    "GATEWAY_PID",
+    "STAGES",
+    "RequestTrace",
+    "ServeTracer",
+    "install",
+    "installed",
+    "now",
+    "sample",
+    "unix_now",
+]
+
+#: the six per-request stages, in causal order
+STAGES = (
+    "client_enqueue",
+    "ring_transit",
+    "queue_wait",
+    "batch_assembly",
+    "device_dispatch",
+    "respond",
+)
+
+#: fixed Perfetto track ids for the two serve lanes (learner is pid 0, plane
+#: players/env workers use small offsets — keep the serve lanes far away)
+GATEWAY_PID = 9000
+CLIENT_PID = 9100
+
+
+def now() -> float:
+    """Monotonic seconds — the one clock every serve/ timestamp comes from."""
+    return time.perf_counter()
+
+
+def unix_now() -> float:
+    """Wall-clock seconds for human-facing records (access log, alerts)."""
+    return time.time()
+
+
+class RequestTrace:
+    """The per-request baton: a trace id plus the two client-side stamps.
+
+    Rides ``_Pending`` through the batcher (local clients) or the slab
+    ring's slot-metadata block (process clients); span emission happens
+    once, gateway-side, when the dispatch that served the request retires.
+    """
+
+    __slots__ = ("trace_id", "t_start", "t_enqueue")
+
+    def __init__(self, trace_id: int, t_start: float, t_enqueue: float = 0.0):
+        self.trace_id = int(trace_id)
+        self.t_start = float(t_start)
+        self.t_enqueue = float(t_enqueue)
+
+
+class ServeTracer:
+    """Two-lane trace writer + deterministic sampler for the serving tier."""
+
+    def __init__(self, out_dir: str, sample_rate: float, flight_ring=None):
+        from sheeprl_tpu.obs.spans import TraceWriter
+
+        rate = float(sample_rate)
+        self.sample_rate = max(0.0, min(rate, 1.0))
+        #: sample every k-th request (k=1 when rate>=1; rate<=0 disables)
+        self._every = 1 if self.sample_rate >= 1.0 else (
+            max(1, round(1.0 / self.sample_rate)) if self.sample_rate > 0 else 0
+        )
+        self._lock = threading.Lock()
+        self._seen = 0
+        self.sampled = 0
+        os.makedirs(out_dir, exist_ok=True)
+        self.client = TraceWriter(
+            path=os.path.join(out_dir, "trace_serve_client.jsonl"),
+            xla_annotations=False,
+            ring=flight_ring,
+            pid=CLIENT_PID,
+            process_name="serve_client",
+        )
+        self.gateway = TraceWriter(
+            path=os.path.join(out_dir, "trace_serve_gateway.jsonl"),
+            xla_annotations=False,
+            ring=flight_ring,
+            pid=GATEWAY_PID,
+            process_name="serve_gateway",
+            # one shared origin: spans on the two lanes order correctly
+            # without relying on the clock_sync anchors' ms precision
+            origin=self.client._origin,
+        )
+
+    def sample(self) -> Optional[RequestTrace]:
+        """A fresh :class:`RequestTrace` for every k-th request, else None."""
+        if self._every <= 0:
+            return None
+        with self._lock:
+            self._seen += 1
+            if (self._seen - 1) % self._every:
+                return None
+            trace_id = self._seen
+        return RequestTrace(trace_id, t_start=now())
+
+    def emit_request(
+        self,
+        trace: RequestTrace,
+        t_submit: float,
+        t_collect: float,
+        t_model: float,
+        t_done: float,
+        t_end: float,
+        client_id: str = "",
+        version: int = 0,
+    ) -> None:
+        """Write the full six-stage chain for one retired request."""
+        args: Dict[str, Any] = {"trace_id": trace.trace_id}
+        if client_id:
+            args["client"] = str(client_id)
+        if version:
+            args["version"] = int(version)
+        t_enqueue = trace.t_enqueue or trace.t_start
+        self.client.complete("serve/client_enqueue", "serve", trace.t_start, t_enqueue, args=args)
+        self.client.complete("serve/ring_transit", "serve", t_enqueue, t_submit, args=args)
+        self.gateway.complete("serve/queue_wait", "serve", t_submit, t_collect, args=args)
+        self.gateway.complete("serve/batch_assembly", "serve", t_collect, t_model, args=args)
+        self.gateway.complete("serve/device_dispatch", "serve", t_model, t_done, args=args)
+        self.gateway.complete("serve/respond", "serve", t_done, t_end, args=args)
+        with self._lock:
+            self.sampled += 1
+        from sheeprl_tpu.obs.counters import add_serve_traced
+
+        add_serve_traced(1)
+
+    def close(self) -> None:
+        self.client.close()
+        self.gateway.close()
+
+
+_TRACER: Optional[ServeTracer] = None
+
+
+def install(tracer: Optional[ServeTracer]) -> None:
+    """Activate (or with ``None`` deactivate) the serve request tracer."""
+    global _TRACER
+    _TRACER = tracer
+
+
+def installed() -> Optional[ServeTracer]:
+    return _TRACER
+
+
+def sample() -> Optional[RequestTrace]:
+    """Client-side entry: a trace baton for this request, or None (the
+    common case — one global read when tracing is off)."""
+    tracer = _TRACER
+    return None if tracer is None else tracer.sample()

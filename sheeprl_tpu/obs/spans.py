@@ -73,6 +73,7 @@ class TraceWriter:
         ring=None,
         pid: Optional[int] = None,
         process_name: Optional[str] = None,
+        origin: Optional[float] = None,
     ):
         self.path = path
         self.xla_annotations = bool(xla_annotations)
@@ -84,7 +85,9 @@ class TraceWriter:
             self._file = None
         self._lock = threading.Lock()
         self._buffer: list[str] = []
-        self._origin = time.perf_counter()
+        # writers sharing one process can share one origin so their ts
+        # values compare directly (the serve tracer's two lanes do)
+        self._origin = float(origin) if origin is not None else time.perf_counter()
         self._named_threads: set[int] = set()
         if pid is not None:
             # explicit track id: plane players and env workers must not
@@ -155,8 +158,16 @@ class TraceWriter:
             }
         )
 
-    def complete(self, name: str, cat: Optional[str], t0: float, t1: Optional[float] = None) -> None:
-        """One completed span ``[t0, t1]`` (``ph: X``)."""
+    def complete(
+        self,
+        name: str,
+        cat: Optional[str],
+        t0: float,
+        t1: Optional[float] = None,
+        args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """One completed span ``[t0, t1]`` (``ph: X``). ``args`` attaches
+        correlation payload (e.g. a serve trace id) to the event."""
         t1 = time.perf_counter() if t1 is None else t1
         tid = threading.get_ident()
         self._thread_meta(tid)
@@ -169,6 +180,7 @@ class TraceWriter:
                 "dur": round((t1 - t0) * 1e6, 1),
                 "pid": self._pid,
                 "tid": tid,
+                **({"args": args} if args else {}),
             }
         )
 

@@ -41,12 +41,19 @@ finish everything queued, then stop the thread.
 from __future__ import annotations
 
 import threading
-import time
+import time  # sleep only — clock reads go through obs.reqtrace (lint_telemetry)
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from sheeprl_tpu.obs.reqtrace import now as _now
+
 __all__ = ["RequestBatcher", "ServeClosed", "ServeRequestError"]
+
+#: the four gateway-side stages of a request's life (the client-side two,
+#: ``client_enqueue``/``ring_transit``, are stamped by the client and only
+#: *emitted* here); per-stage StreamingHists ride stats() -> status()
+STAGE_NAMES = ("queue_wait", "batch_assembly", "device_dispatch", "respond")
 
 
 class ServeClosed(RuntimeError):
@@ -70,18 +77,21 @@ class _Pending:
         "version",
         "error",
         "cancelled",
+        "trace",
     )
 
-    def __init__(self, client_id: str, obs: Dict[str, np.ndarray], reset: bool):
+    def __init__(self, client_id: str, obs: Dict[str, np.ndarray], reset: bool, trace=None):
         self.client_id = client_id
         self.obs = obs
         self.reset = bool(reset)
-        self.t_submit = time.monotonic()
+        self.t_submit = _now()
         self.event = threading.Event()
         self.action: Optional[np.ndarray] = None
         self.version: Optional[int] = None
         self.error: Optional[BaseException] = None
         self.cancelled = False
+        #: optional RequestTrace baton (obs/reqtrace) — None when unsampled
+        self.trace = trace
 
 
 def _stack_rows(rows: List[Any]):
@@ -142,6 +152,15 @@ class RequestBatcher:
         from sheeprl_tpu.obs.hist import StreamingHist
 
         self._latency = StreamingHist()
+        # per-stage decomposition + batch occupancy + per-version breakdown
+        # (always-on: a handful of clock reads and hist records per batch —
+        # the ops surface reads these through gateway.status())
+        self._stage_hists = {name: StreamingHist() for name in STAGE_NAMES}
+        self._occupancy = StreamingHist()
+        self._per_version: Dict[int, Dict[str, Any]] = {}
+        #: optional ServeOps sink (serve/ops.py): tracing, access log, SLO
+        #: feed, fault injection — None keeps the request path pre-PR-19
+        self._ops = None
         self._stats_lock = threading.Lock()
         self._requests = 0
         self._batches = 0
@@ -158,10 +177,11 @@ class RequestBatcher:
     # ------------------------------------------------------------- client API
 
     def submit(
-        self, client_id: str, obs: Dict[str, np.ndarray], reset: bool = False
+        self, client_id: str, obs: Dict[str, np.ndarray], reset: bool = False, trace=None
     ) -> _Pending:
-        """Queue one observation row; returns the ticket to :meth:`wait` on."""
-        pending = _Pending(str(client_id), obs, reset)
+        """Queue one observation row; returns the ticket to :meth:`wait` on.
+        ``trace`` is the request's sampled trace baton (or None)."""
+        pending = _Pending(str(client_id), obs, reset, trace=trace)
         with self._cv:
             if self._draining or self._stopped:
                 raise ServeClosed("gateway is draining: no new requests accepted")
@@ -202,6 +222,12 @@ class RequestBatcher:
     def model(self):
         return self._model
 
+    def attach_ops(self, ops) -> None:
+        """Install (or with ``None`` remove) the request-path observability
+        sink — a :class:`sheeprl_tpu.serve.ops.ServeOps`. Atomic reference
+        assignment; the dispatcher reads it once per batch."""
+        self._ops = ops
+
     def swap(self, model) -> int:
         """Atomically install ``model`` for all *subsequent* dispatches;
         in-flight batches finish on the old reference. Returns the new
@@ -220,8 +246,8 @@ class RequestBatcher:
         with self._cv:
             self._draining = True
             self._cv.notify_all()
-        deadline = time.monotonic() + float(timeout)
-        while time.monotonic() < deadline:
+        deadline = _now() + float(timeout)
+        while _now() < deadline:
             with self._cv:
                 if not self._queue:
                     break
@@ -239,6 +265,10 @@ class RequestBatcher:
             add_serve_failed(len(leftovers))
             with self._stats_lock:
                 self._failed += len(leftovers)
+            ops = self._ops
+            if ops is not None:
+                for p in leftovers:
+                    ops.on_request(p.client_id, None, 0, ok=False)
         return not leftovers
 
     def close(self) -> None:
@@ -251,9 +281,24 @@ class RequestBatcher:
 
     def stats(self) -> Dict[str, Any]:
         """Snapshot for the load harness / live status."""
+        occ = self._occupancy
+        occupancy_dist = {
+            "count": occ.n,
+            "p50": round(occ.quantile(0.5) or 0.0, 3),
+            "p95": round(occ.quantile(0.95) or 0.0, 3),
+            "p99": round(occ.quantile(0.99) or 0.0, 3),
+            "max": round(occ.max, 3),
+        }
+        stage_latency = {
+            name: hist.percentiles() for name, hist in self._stage_hists.items()
+        }
         with self._stats_lock:
             batches = self._batches
             occupancy = (self._batch_rows / batches) if batches else 0.0
+            versions = {
+                str(v): {"requests": rec["requests"], **rec["latency"].percentiles()}
+                for v, rec in sorted(self._per_version.items())
+            }
             return {
                 "requests": self._requests,
                 "batches": batches,
@@ -263,6 +308,9 @@ class RequestBatcher:
                 "swaps": self._swaps,
                 "versions_served": list(self._versions_served),
                 "act_latency": self._latency.percentiles(),
+                "stage_latency": stage_latency,
+                "batch_occupancy": occupancy_dist,
+                "versions": versions,
             }
 
     # ------------------------------------------------------------- dispatcher
@@ -282,7 +330,7 @@ class RequestBatcher:
                     batch = self._queue[: self.max_batch]
                     del self._queue[: len(batch)]
                     return batch
-                remaining = self.deadline_s - (time.monotonic() - t_first)
+                remaining = self.deadline_s - (_now() - t_first)
                 if len(self._queue) >= self.max_batch or remaining <= 0:
                     batch = self._queue[: self.max_batch]
                     del self._queue[: len(batch)]
@@ -321,12 +369,15 @@ class RequestBatcher:
         from sheeprl_tpu.obs import hist as _obs_hist
         from sheeprl_tpu.obs.counters import add_serve_batch, add_serve_failed
 
-        t_start = time.monotonic()
+        ops = self._ops  # one atomic read per batch, same as the model
+        t_collect = _now()
         # a miss is the dispatcher launching late (previous batch still on the
         # device), not a deadline-expired partial fill — that one is by design
-        lateness = t_start - (batch[0].t_submit + self.deadline_s)
+        lateness = t_collect - (batch[0].t_submit + self.deadline_s)
         deadline_miss = self.deadline_s > 0 and lateness > 0.5 * self.deadline_s
         live = [p for p in batch if not p.cancelled]
+        if ops is not None and len(live) < len(batch):
+            ops.on_cancelled(len(batch) - len(live))
         if not live:
             return
         model = self._model  # one atomic read: the whole batch rides one model
@@ -336,6 +387,12 @@ class RequestBatcher:
             if self._key is None:
                 self._key = jax.random.PRNGKey(self._seed)
             self._key, act_key = jax.random.split(self._key)
+            t_model = _now()
+            if ops is not None and ops.inject_dispatch_delay_s > 0:
+                # fault injection (serve.inject_dispatch_delay_s): a slow
+                # device, charged to the device_dispatch stage — the SLO
+                # e2e test trips the fast-burn alert with this
+                time.sleep(ops.inject_dispatch_delay_s)
             actions, new_state = model.act(obs, state, act_key)
             actions = np.asarray(actions)
         except BaseException as exc:  # fail this batch's waiters, survive
@@ -345,20 +402,58 @@ class RequestBatcher:
             add_serve_failed(len(live))
             with self._stats_lock:
                 self._failed += len(live)
+            if ops is not None:
+                for p in live:
+                    ops.on_request(p.client_id, None, int(model.version), ok=False)
             return
+        t_done = _now()
         if new_state is not None:
             with self._cv:
                 for p, row in zip(live, _split_state_rows(new_state, len(live))):
                     self._states[p.client_id] = row
         version = int(model.version)
-        now = time.monotonic()
         for i, p in enumerate(live):
             p.action = actions[i]
             p.version = version
             p.event.set()
-            latency = now - p.t_submit
+        t_end = _now()
+        # stage decomposition: every live request experienced this batch's
+        # assembly/dispatch/respond windows plus its own queue wait, so the
+        # per-request stage sums reconstruct the end-to-end act latency
+        assembly_s = t_model - t_collect
+        dispatch_s = t_done - t_model
+        respond_s = t_end - t_done
+        stage = self._stage_hists
+        with self._stats_lock:
+            ver_rec = self._per_version.get(version)
+            if ver_rec is None:
+                from sheeprl_tpu.obs.hist import StreamingHist
+
+                ver_rec = self._per_version[version] = {
+                    "requests": 0,
+                    "latency": StreamingHist(),
+                }
+            ver_rec["requests"] += len(live)
+        for p in live:
+            latency = t_end - p.t_submit
             self._latency.record(latency)
+            ver_rec["latency"].record(latency)
             _obs_hist.observe("Time/serve_act_latency", latency)
+            stage["queue_wait"].record(t_collect - p.t_submit)
+            stage["batch_assembly"].record(assembly_s)
+            stage["device_dispatch"].record(dispatch_s)
+            stage["respond"].record(respond_s)
+            if ops is not None:
+                ops.on_request(
+                    p.client_id,
+                    latency,
+                    version,
+                    ok=True,
+                    trace=p.trace,
+                    stamps=(p.t_submit, t_collect, t_model, t_done, t_end),
+                    rows=len(live),
+                )
+        self._occupancy.record(len(live))
         add_serve_batch(len(live), deadline_miss=deadline_miss)
         with self._stats_lock:
             self._batches += 1

@@ -30,6 +30,10 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from sheeprl_tpu.obs import reqtrace
+from sheeprl_tpu.obs.reqtrace import RequestTrace
+from sheeprl_tpu.obs.reqtrace import now as _now
+
 __all__ = ["LocalServeClient", "RingServeClient"]
 
 _client_counter = itertools.count()
@@ -59,7 +63,12 @@ class LocalServeClient:
         """One request → one action row plus the serving model version."""
         if self._closed:
             raise RuntimeError(f"client {self.client_id} is closed")
-        pending = self._batcher.submit(self.client_id, obs_row, reset=reset)
+        # one global read when tracing is off; a sampled request carries its
+        # trace baton through the batcher and is emitted gateway-side
+        trace = reqtrace.sample()
+        if trace is not None:
+            trace.t_enqueue = _now()
+        pending = self._batcher.submit(self.client_id, obs_row, reset=reset, trace=trace)
         self._pending = pending
         try:
             return self._batcher.wait(pending, timeout=timeout)
@@ -99,7 +108,14 @@ class RingServeClient:
         timeout: float = 30.0,
     ) -> Tuple[np.ndarray, int]:
         self._seq += 1
-        self._ring.request(self.slot, obs_row, self._seq, reset)
+        # deterministic per-slot sampling (the child process has no tracer
+        # installed — the ring carries the sampling knob and the stamps, the
+        # gateway's tracer does the emitting)
+        every = int(getattr(self._ring, "trace_every", 0) or 0)
+        trace = None
+        if every > 0 and (self._seq - 1) % every == 0:
+            trace = RequestTrace((self.slot + 1) * 1_000_000 + self._seq, t_start=_now())
+        self._ring.request(self.slot, obs_row, self._seq, reset, trace=trace)
         return self._ring.wait_response(self.slot, self._seq, timeout=timeout)
 
     def close(self) -> None:

@@ -28,11 +28,12 @@ from __future__ import annotations
 
 import os
 import threading
-import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from sheeprl_tpu.obs.reqtrace import now as _now
+from sheeprl_tpu.obs.reqtrace import unix_now as _unix_now
 from sheeprl_tpu.utils.utils import dotdict
 
 __all__ = [
@@ -54,6 +55,21 @@ _SERVE_DEFAULTS: Dict[str, Any] = {
     "poll_interval_s": 0.2,
     "drain_timeout_s": 30.0,
     "duration_s": 0.0,  # 0 → serve until signaled
+    # ---- request-path observability (all off by default: ops stays None
+    # ---- and the request path is byte-identical to the pre-ops gateway)
+    "trace_sample_rate": 0.0,  # fraction of requests emitting span chains
+    "access_log_sample_rate": 0.0,  # fraction of requests logged to access.jsonl
+    "obs_dir": None,  # where traces/alerts/access/serve_live.json land
+    "metrics_port": None,  # /metrics endpoint port (0 → ephemeral)
+    "inject_dispatch_delay_s": 0.0,  # fault injection: stall device_dispatch
+    "slo": {  # burn-rate objectives (obs/slo.py fills the rest)
+        "enabled": False,
+        "objectives": {
+            "act_latency_p99_ms": 250.0,
+            "availability": 0.999,
+            "swap_staleness_s": 30.0,
+        },
+    },
 }
 
 
@@ -94,6 +110,7 @@ class ServeGateway:
         self._ring = None
         self._ring_stop = threading.Event()
         self._ring_thread: Optional[threading.Thread] = None
+        self.ops = None
 
     @classmethod
     def from_checkpoint(
@@ -127,6 +144,48 @@ class ServeGateway:
         from sheeprl_tpu.serve.client import LocalServeClient
 
         return LocalServeClient(self.batcher, client_id=client_id)
+
+    # ------------------------------------------------------------ ops surface
+
+    def enable_ops(self, settings: Dict[str, Any], out_dir: Optional[str] = None):
+        """Attach the request-path observability planes (tracing, SLO engine,
+        access log, ``/metrics``) per the ``serve.*`` knobs. Returns the
+        :class:`~sheeprl_tpu.serve.ops.ServeOps` — or None when every knob is
+        off, in which case the request path is untouched."""
+        from sheeprl_tpu.serve.ops import ServeOps
+
+        if self.ops is not None:
+            raise RuntimeError("gateway ops surface is already enabled")
+        out = out_dir or settings.get("obs_dir") or "logs/serve_obs"
+        self.ops = ServeOps.build(
+            settings,
+            str(out),
+            status_fn=self.status,
+            staleness_fn=self._swap_staleness,
+        )
+        if self.ops is not None:
+            self.batcher.attach_ops(self.ops)
+            if self._ring is not None and self.ops.tracer is not None:
+                self._ring.trace_every = int(self.ops.tracer._every)
+        return self.ops
+
+    def _swap_staleness(self) -> float:
+        """Seconds the serving model has lagged the newest published policy:
+        0 when no swapper is attached or serving is current; otherwise the
+        age of the newest unpicked-up publication."""
+        swapper = self._swapper
+        if swapper is None:
+            return 0.0
+        try:
+            latest = swapper._poller.latest_version()
+            if latest is None or int(latest) <= int(swapper._last_version):
+                return 0.0
+            from sheeprl_tpu.plane.publish import policy_path
+
+            mtime = os.path.getmtime(policy_path(swapper._poller.root, int(latest)))
+            return max(0.0, _unix_now() - mtime)
+        except Exception:
+            return 0.0
 
     # --------------------------------------------------------------- hot-swap
 
@@ -164,6 +223,10 @@ class ServeGateway:
         }
         act_row = np.asarray(self.action_space.sample())
         self._ring = ActSlabRing.from_example(obs_row, act_row, n_clients, ctx=ctx)
+        if self.ops is not None and self.ops.tracer is not None:
+            # the ring carries the sampling knob: child-process clients have
+            # no tracer installed, they stamp every trace_every-th request
+            self._ring.trace_every = int(self.ops.tracer._every)
         self._ring_thread = threading.Thread(
             target=self._serve_ring, name="serve-ring", daemon=True
         )
@@ -181,8 +244,9 @@ class ServeGateway:
             tickets = []
             for slot, seq, reset in requests:
                 obs = ring.read_obs_row(slot)
+                trace = ring.read_meta(slot)
                 try:
-                    ticket = self.batcher.submit(f"ring{slot}", obs, reset=reset)
+                    ticket = self.batcher.submit(f"ring{slot}", obs, reset=reset, trace=trace)
                 except ServeClosed as exc:
                     ring.respond(slot, seq, None, -1, error=str(exc))
                     continue
@@ -202,7 +266,7 @@ class ServeGateway:
 
     def status(self) -> Dict[str, Any]:
         model = self.batcher.model
-        return {
+        status = {
             "algo": model.algo,
             "env": model.env_id,
             "model_version": int(model.version),
@@ -210,6 +274,16 @@ class ServeGateway:
             "swapper": self._swapper is not None,
             **self.batcher.stats(),
         }
+        ops = self.ops
+        if ops is not None:
+            if ops.tracer is not None:
+                status["trace"] = {
+                    "sample_rate": float(ops.tracer.sample_rate),
+                    "sampled_requests": int(ops.tracer.sampled),
+                }
+            if ops.slo is not None:
+                status["slo"] = ops.slo.status()
+        return status
 
     def drain(self, timeout: float = 30.0) -> bool:
         """SIGTERM path: finish in-flight requests, then stop everything."""
@@ -222,6 +296,9 @@ class ServeGateway:
         self._shutdown_aux()
 
     def _shutdown_aux(self) -> None:
+        if self.ops is not None:
+            self.ops.close()
+            self.ops = None
         if self._swapper is not None:
             self._swapper.close()
             self._swapper = None
@@ -377,6 +454,7 @@ def run_serve_entrypoint(serve_cfg) -> None:
     )
     if settings.poll_root:
         gateway.watch(str(settings.poll_root), poll_interval_s=float(settings.poll_interval_s))
+    ops = gateway.enable_ops(settings)
     gateway.start_ring(int(settings.max_clients))
     status = gateway.status()
     print(
@@ -385,6 +463,15 @@ def run_serve_entrypoint(serve_cfg) -> None:
         f"deadline={settings.deadline_ms}ms, max_clients={settings.max_clients})",
         flush=True,
     )
+    if ops is not None:
+        port = ops.prom.port if ops.prom is not None else None
+        print(
+            f"[serve] ops surface on: dir={ops.out_dir} "
+            f"trace_rate={settings.trace_sample_rate} "
+            f"slo={'on' if ops.slo is not None else 'off'} "
+            f"metrics_port={port}",
+            flush=True,
+        )
 
     stop = threading.Event()
 
@@ -393,11 +480,9 @@ def run_serve_entrypoint(serve_cfg) -> None:
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
-    deadline = (
-        time.monotonic() + float(settings.duration_s) if settings.duration_s else None
-    )
+    deadline = _now() + float(settings.duration_s) if settings.duration_s else None
     while not stop.is_set():
-        if deadline is not None and time.monotonic() >= deadline:
+        if deadline is not None and _now() >= deadline:
             break
         stop.wait(timeout=5.0)
         s = gateway.status()

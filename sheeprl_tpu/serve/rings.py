@@ -20,16 +20,28 @@ The ring is ``spawn``-picklable like the trajectory slabs: cached numpy
 views are dropped in ``__getstate__`` and rebuilt lazily on the other side.
 ``close()`` sets the shared stop event — blocked clients raise
 :class:`~sheeprl_tpu.plane.slabs.PlaneClosed` instead of hanging.
+
+Slab layout v2 adds a per-slot **metadata block** (three float64s: the
+client's act()-entry stamp, its enqueue stamp, and a trace id — 0 when the
+request is unsampled) so the request-path tracer can reconstruct
+``client_enqueue``/``ring_transit`` spans for requests that crossed a
+process boundary. The layout is **versioned**: ``__setstate__`` refuses to
+unpickle a ring whose layout tag differs from this build's, so a stale peer
+gets one clear error instead of silently misreading slab bytes.
 """
 
 from __future__ import annotations
 
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["ActSlabRing"]
+from sheeprl_tpu.obs.reqtrace import now as _now
+
+__all__ = ["ActSlabRing", "RING_LAYOUT_VERSION"]
+
+#: bump on ANY slab/queue-record layout change (fields, dtypes, ordering)
+RING_LAYOUT_VERSION = 2
 
 
 def _nbytes(shape: Tuple[int, ...], dtype: np.dtype) -> int:
@@ -66,11 +78,18 @@ class ActSlabRing:
         self._act_block = ctx.RawArray(
             "b", self.n_clients * _nbytes(self.act_shape, self.act_dtype)
         )
+        # layout v2: per-slot (t_start, t_enqueue, trace_id) request metadata
+        self._meta_block = ctx.RawArray("d", self.n_clients * 3)
+        self._layout = RING_LAYOUT_VERSION
+        #: deterministic client-side sampling: trace every k-th request per
+        #: slot (0 = tracing off); set by the gateway from serve settings
+        self.trace_every = 0
         self._requests = ctx.Queue()
         self._responses = [ctx.Queue() for _ in range(self.n_clients)]
         self._stop = ctx.Event()
         self._views: Optional[Dict[str, np.ndarray]] = None
         self._act_view: Optional[np.ndarray] = None
+        self._meta_view: Optional[np.ndarray] = None
 
     @classmethod
     def from_example(
@@ -103,19 +122,55 @@ class ActSlabRing:
             )
         return self._act_view
 
+    def _meta_views(self) -> np.ndarray:
+        if self._meta_view is None:
+            self._meta_view = np.frombuffer(self._meta_block, dtype=np.float64).reshape(
+                (self.n_clients, 3)
+            )
+        return self._meta_view
+
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_views"] = None  # numpy views don't cross process boundaries;
         state["_act_view"] = None  # rebuilt lazily from the RawArrays
+        state["_meta_view"] = None
         return state
+
+    def __setstate__(self, state):
+        got = state.get("_layout")
+        if got != RING_LAYOUT_VERSION:
+            raise RuntimeError(
+                f"ActSlabRing slab-layout mismatch: the pickled ring speaks "
+                f"layout {got!r}, this build speaks {RING_LAYOUT_VERSION}. "
+                f"Client and gateway must run the same sheeprl_tpu build — "
+                f"refusing to attach rather than misread slab bytes."
+            )
+        self.__dict__.update(state)
 
     # ------------------------------------------------------------ client side
 
-    def request(self, slot: int, obs_row: Dict[str, np.ndarray], seq: int, reset: bool) -> None:
-        """Write the obs row into this client's slot and commit the request."""
+    def request(
+        self,
+        slot: int,
+        obs_row: Dict[str, np.ndarray],
+        seq: int,
+        reset: bool,
+        trace=None,
+    ) -> None:
+        """Write the obs row (and the request metadata) into this client's
+        slot and commit the request. ``trace`` is an optional
+        :class:`~sheeprl_tpu.obs.reqtrace.RequestTrace` baton; its stamps ride
+        the slot-metadata block so the gateway can emit the client-side spans
+        (CLOCK_MONOTONIC is system-wide — the stamps compare directly)."""
         views = self._obs_views()
         for k, (shape, dtype) in self.obs_spec.items():
             views[k][slot] = np.asarray(obs_row[k], dtype=dtype).reshape(shape)
+        meta = self._meta_views()
+        if trace is not None:
+            trace.t_enqueue = _now()
+            meta[slot] = (trace.t_start, trace.t_enqueue, float(trace.trace_id))
+        else:
+            meta[slot] = (0.0, 0.0, 0.0)
         self._requests.put((int(slot), int(seq), bool(reset)))
 
     def wait_response(self, slot: int, seq: int, timeout: float = 30.0) -> Tuple[np.ndarray, int]:
@@ -126,10 +181,10 @@ class ActSlabRing:
         """
         from sheeprl_tpu.plane.slabs import PlaneClosed
 
-        deadline = time.monotonic() + float(timeout)
+        deadline = _now() + float(timeout)
         q = self._responses[int(slot)]
         while True:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - _now()
             if remaining <= 0:
                 raise TimeoutError(f"serve ring response timed out (slot {slot})")
             try:
@@ -162,6 +217,17 @@ class ActSlabRing:
                 out.append(self._requests.get_nowait())
             except _queue.Empty:
                 return out
+
+    def read_meta(self, slot: int):
+        """The slot's request metadata, or None when the request was not
+        sampled: a :class:`~sheeprl_tpu.obs.reqtrace.RequestTrace` rebuilt
+        from the client's stamps."""
+        t_start, t_enqueue, trace_id = self._meta_views()[int(slot)]
+        if trace_id <= 0:
+            return None
+        from sheeprl_tpu.obs.reqtrace import RequestTrace
+
+        return RequestTrace(int(trace_id), float(t_start), float(t_enqueue))
 
     def read_obs_row(self, slot: int) -> Dict[str, np.ndarray]:
         """Copy one client's observation row out of the slab (the batcher

@@ -1,0 +1,178 @@
+"""The SLO burn-rate engine (obs/slo.py): hand-computed windows, fire/clear
+hysteresis, cancelled-ticket accounting, and the alert log — all on an
+injected clock (no sleeps)."""
+
+import json
+
+import pytest
+
+from sheeprl_tpu.obs.slo import SloEngine, slo_settings
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def make_engine(tmp_path=None, clock=None, **overrides):
+    cfg = {"enabled": True}
+    cfg.update(overrides)
+    return SloEngine(
+        cfg,
+        alerts_path=str(tmp_path / "alerts.jsonl") if tmp_path is not None else None,
+        clock=clock or FakeClock(),
+    )
+
+
+def test_slo_settings_merge_defaults():
+    s = slo_settings({"fast_burn": 10.0, "objectives": {"availability": 0.95}})
+    assert s["fast_burn"] == 10.0
+    assert s["slow_burn"] == 6.0  # untouched default
+    assert s["objectives"]["availability"] == 0.95
+    assert s["objectives"]["act_latency_p99_ms"] == 250.0  # merged, not replaced
+
+
+def test_burn_rate_hand_computed():
+    """availability target 0.99 -> budget 0.01; 5 bad of 100 in-window
+    events is a bad fraction of 0.05 -> burn rate exactly 5.0."""
+    clock = FakeClock()
+    eng = make_engine(clock=clock, objectives={"availability": 0.99})
+    for i in range(100):
+        eng.record_request(0.001, failed=(i < 5))
+    obj = eng.objectives["availability"]
+    burn, good, bad = obj.burn(clock.t, 60.0)
+    assert (good, bad) == (95, 5)
+    assert burn == pytest.approx(5.0)
+    # the same events fall out of a window that ends before they happened
+    assert obj.burn(clock.t - 120.0, 60.0)[0] == 0.0
+
+
+def test_latency_objective_counts_slow_requests():
+    clock = FakeClock()
+    eng = make_engine(clock=clock, objectives={"act_latency_p99_ms": 100.0})
+    for _ in range(98):
+        eng.record_request(0.010)  # inside the 100ms bound
+    eng.record_request(0.500)
+    eng.record_request(0.500)
+    obj = eng.objectives["act_latency_p99"]
+    # budget 1%: 2 bad of 100 -> bad fraction 0.02 -> burn 2.0
+    burn, good, bad = obj.burn(clock.t, 60.0)
+    assert (good, bad) == (98, 2)
+    assert burn == pytest.approx(2.0)
+    assert obj.verdict() == "FAIL"  # cumulative 2% > 1% budget
+
+
+def test_fire_and_clear_hysteresis(tmp_path):
+    """The fast alert fires above threshold, holds between clear_ratio x
+    threshold and threshold (no flapping), and clears below."""
+    clock = FakeClock()
+    eng = make_engine(tmp_path, clock=clock, objectives={"availability": 0.99})
+    # burn = 20x budget (bad fraction 0.2) in the fast window -> above 14.4
+    for i in range(100):
+        eng.record_request(0.001, failed=(i % 5 == 0))
+    transitions = eng.evaluate()
+    fired = [r for r in transitions if r["event"] == "fire"]
+    assert {r["alert"] for r in fired} == {"fast_burn", "slow_burn"}
+    assert eng.objectives["availability"].fast.active
+
+    # burn decays into the hysteresis band (over clear_below=7.2): 10 minutes
+    # of clean traffic dilutes nothing inside a window that moved on, so
+    # instead land mid-band with fresh traffic at bad fraction 0.1 -> burn 10
+    clock.advance(120.0)  # the old events age out of both windows
+    for i in range(100):
+        eng.record_request(0.001, failed=(i % 10 == 0))
+    transitions = eng.evaluate()
+    assert transitions == []  # 10.0 is between 7.2 and 14.4: still active
+    assert eng.objectives["availability"].fast.active
+
+    # clean traffic only -> burn below clear_ratio x threshold -> clear
+    clock.advance(120.0)
+    for _ in range(100):
+        eng.record_request(0.001)
+    transitions = eng.evaluate()
+    cleared = [r for r in transitions if r["event"] == "clear"]
+    assert {r["alert"] for r in cleared} == {"fast_burn", "slow_burn"}
+    assert not eng.objectives["availability"].fast.active
+    # a second clean tick produces no new transitions
+    assert eng.evaluate() == []
+
+    eng.close()
+    records = [json.loads(line) for line in (tmp_path / "alerts.jsonl").open()]
+    assert [r["event"] for r in records].count("fire") == 2
+    assert [r["event"] for r in records].count("clear") == 2
+    assert all(r["objective"] == "availability" for r in records)
+
+
+def test_cancelled_tickets_excluded_from_availability():
+    clock = FakeClock()
+    eng = make_engine(clock=clock, objectives={"availability": 0.99})
+    for _ in range(10):
+        eng.record_request(0.001)
+    for _ in range(50):
+        eng.record_request(None, cancelled=True)
+    obj = eng.objectives["availability"]
+    # cancelled tickets neither spend nor earn budget
+    assert (obj.events.total_good, obj.events.total_bad) == (10, 0)
+    assert eng.status()["cancelled_tickets"] == 50
+    assert obj.verdict() == "PASS"
+
+
+def test_staleness_is_a_hard_bound():
+    """swap_staleness has zero budget: one stale sample burns hot enough to
+    fire both alerts on the next tick and the verdict is FAIL forever."""
+    clock = FakeClock()
+    eng = make_engine(clock=clock, objectives={"swap_staleness_s": 30.0})
+    eng.record_staleness(1.0)
+    assert eng.evaluate() == []
+    assert eng.verdicts()["swap_staleness"] == "PASS"
+    eng.record_staleness(45.0)  # beyond the 30s bound
+    fired = [r for r in eng.evaluate() if r["event"] == "fire"]
+    assert {r["alert"] for r in fired} == {"fast_burn", "slow_burn"}
+    assert eng.verdicts()["swap_staleness"] == "FAIL"
+
+
+def test_on_alert_hook_fires_only_on_fire_and_swallows_errors():
+    clock = FakeClock()
+    seen = []
+
+    def hook(rec):
+        seen.append(rec)
+        raise RuntimeError("sink exploded")  # must not propagate
+
+    eng = SloEngine(
+        # budget 0.05: all-failed traffic burns at 20x, over both thresholds
+        {"enabled": True, "objectives": {"availability": 0.95}},
+        on_alert=hook,
+        clock=clock,
+    )
+    for _ in range(10):
+        eng.record_request(0.001, failed=True)
+    eng.evaluate()
+    assert len(seen) == 2  # fast + slow fire, clear never calls the hook
+    clock.advance(120.0)
+    for _ in range(100):
+        eng.record_request(0.001)
+    eng.evaluate()
+    assert len(seen) == 2
+
+
+def test_status_shape():
+    clock = FakeClock()
+    eng = make_engine(clock=clock)
+    eng.record_request(0.001)
+    status = eng.status()
+    assert status["enabled"] is True
+    assert set(status["objectives"]) == {
+        "act_latency_p99",
+        "availability",
+        "swap_staleness",
+    }
+    for obj in status["objectives"].values():
+        assert obj["verdict"] in ("PASS", "FAIL")
+        assert "burn_fast" in obj and "burn_slow" in obj

@@ -1,0 +1,224 @@
+"""The gateway ops surface (serve/ops.py + gateway.enable_ops): the off
+state leaves the request path untouched, the full surface traces / logs /
+scrapes / verdicts end-to-end, and an injected dispatch delay trips the
+fast-burn alert and dumps a flight recording."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+
+
+def _obs_row(gateway):
+    return {
+        k: np.asarray(space.sample())
+        for k, space in gateway.observation_space.spaces.items()
+    }
+
+
+def _own_gateway(sac_checkpoint, **kw):
+    from sheeprl_tpu.serve import ServeGateway
+
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("deadline_s", 0.01)
+    return ServeGateway.from_checkpoint(sac_checkpoint, **kw)
+
+
+def _serve_report(out_dir):
+    """Run tools/serve_report.py against an ops dir, return its exit code."""
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_report.py"), str(out_dir)],
+        capture_output=True,
+        timeout=120,
+    ).returncode
+
+
+# ------------------------------------------------------------- the off state
+
+
+def test_ops_off_request_path_is_untouched(sac_gateway):
+    """No ops knob on: no sink attached, no tracer installed, the new
+    counters never move — the pre-observability gateway, byte for byte."""
+    from sheeprl_tpu.obs import counters as obs_counters
+    from sheeprl_tpu.obs import reqtrace
+    from sheeprl_tpu.obs.counters import Counters
+
+    assert sac_gateway.ops is None
+    assert sac_gateway.batcher._ops is None
+    assert reqtrace.installed() is None
+    assert reqtrace.sample() is None  # the one global read, and it is None
+
+    counters = Counters()
+    obs_counters.install(counters)
+    try:
+        client = sac_gateway.client("offstate")
+        for _ in range(5):
+            client.act(_obs_row(sac_gateway))
+    finally:
+        obs_counters.install(None)
+    assert counters.serve_traced_requests == 0
+    assert counters.slo_alerts_fired == 0
+
+    # every knob off -> enable_ops is a no-op returning None
+    assert sac_gateway.enable_ops({"trace_sample_rate": 0.0}) is None
+    assert sac_gateway.batcher._ops is None
+
+    # the stage decomposition itself is always-on (a handful of clock reads)
+    from sheeprl_tpu.serve.batcher import STAGE_NAMES
+
+    assert set(sac_gateway.batcher.stats()["stage_latency"]) == set(STAGE_NAMES)
+
+
+# ------------------------------------------------------- the full ops surface
+
+
+def test_full_surface_traces_logs_scrapes_and_verdicts(sac_checkpoint, tmp_path):
+    """trace_sample_rate=1 + access log + SLO + /metrics, end to end: every
+    request lands a six-stage chain across the two Perfetto lanes whose
+    gateway-stage durations sum to the logged end-to-end latency."""
+    from sheeprl_tpu.obs import reqtrace
+    from sheeprl_tpu.obs.reqtrace import CLIENT_PID, GATEWAY_PID, STAGES
+
+    out = tmp_path / "serve_obs"
+    gateway = _own_gateway(sac_checkpoint)
+    try:
+        ops = gateway.enable_ops(
+            {
+                "trace_sample_rate": 1.0,
+                "access_log_sample_rate": 1.0,
+                "metrics_port": 0,  # ephemeral
+                "slo": {"enabled": True, "eval_interval_s": 30.0},
+            },
+            out_dir=str(out),
+        )
+        assert ops is not None and gateway.ops is ops
+        assert reqtrace.installed() is ops.tracer
+        assert gateway.batcher._ops is ops
+
+        client = gateway.client("probe")
+        for step in range(6):
+            _action, version = client.act(_obs_row(gateway), reset=(step == 0))
+            assert version > 0
+        assert ops.tracer.sampled == 6
+        assert ops.access.written == 6
+
+        # a live scrape exposes the per-stage percentiles and SLO verdicts
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{ops.prom.port}/metrics", timeout=10
+        ) as resp:
+            body = resp.read().decode()
+        assert 'phase_duration_ms{phase="serve/queue_wait"' in body
+        assert "slo_objective_ok" in body
+        assert "serve_version_requests" in body
+
+        status = gateway.status()
+        assert status["trace"]["sampled_requests"] == 6
+        assert set(status["slo"]["objectives"]) == {
+            "act_latency_p99",
+            "availability",
+            "swap_staleness",
+        }
+    finally:
+        gateway.close()
+    assert reqtrace.installed() is None  # drain uninstalls the tracer
+
+    # ---- the trace plane: six stages, one trace id, two lanes -------------
+    def spans(path):
+        out = {}
+        for line in open(path):
+            ev = json.loads(line)
+            if ev.get("ph") == "X":
+                out.setdefault(ev["args"]["trace_id"], []).append(ev)
+        return out
+
+    client_spans = spans(out / "trace_serve_client.jsonl")
+    gateway_spans = spans(out / "trace_serve_gateway.jsonl")
+    assert set(client_spans) == set(gateway_spans) and len(client_spans) == 6
+    latency_by_trace = {
+        rec["trace_id"]: rec["latency_ms"]
+        for rec in map(json.loads, open(out / "access.jsonl"))
+    }
+    for trace_id in client_spans:
+        chain = client_spans[trace_id] + gateway_spans[trace_id]
+        assert [ev["name"] for ev in chain] == [f"serve/{s}" for s in STAGES]
+        assert {ev["pid"] for ev in client_spans[trace_id]} == {CLIENT_PID}
+        assert {ev["pid"] for ev in gateway_spans[trace_id]} == {GATEWAY_PID}
+        assert all(ev["args"]["client"] == "probe" for ev in chain)
+        # the chain is causally ordered on the shared origin: each stage
+        # starts where the previous one ended (ts in us, 0.1us rounding)
+        for prev, cur in zip(chain, chain[1:]):
+            assert cur["ts"] >= prev["ts"] + prev["dur"] - 0.2
+        # the four gateway stages tile [submit, end]: their durations sum
+        # to the end-to-end latency the access log recorded
+        gw_ms = sum(ev["dur"] for ev in gateway_spans[trace_id]) / 1e3
+        assert gw_ms == pytest.approx(latency_by_trace[trace_id], abs=0.05)
+
+    # ---- drain artefacts: final snapshot + a PASS report ------------------
+    live = json.loads((out / "serve_live.json").read_text())
+    assert live["trace_sampled_requests"] == 6
+    assert all(
+        obj["verdict"] == "PASS" for obj in live["slo"]["objectives"].values()
+    )
+    assert _serve_report(out) == 0
+    assert "**Overall: PASS**" in (out / "serve_report.md").read_text()
+
+
+# --------------------------------------------------------------- fault drill
+
+
+def test_injected_dispatch_delay_trips_fast_burn(sac_checkpoint, tmp_path):
+    """serve.inject_dispatch_delay_s against a tight p99 objective: every
+    request overruns, the fast-burn alert fires on the next tick, the
+    flight recorder dumps, and serve_report exits non-zero."""
+    from sheeprl_tpu.obs import counters as obs_counters
+    from sheeprl_tpu.obs.counters import Counters
+
+    out = tmp_path / "serve_obs"
+    gateway = _own_gateway(sac_checkpoint, deadline_s=0.005)
+    counters = Counters()
+    obs_counters.install(counters)
+    try:
+        ops = gateway.enable_ops(
+            {
+                "inject_dispatch_delay_s": 0.12,
+                "slo": {
+                    "enabled": True,
+                    "eval_interval_s": 3600.0,  # ticks are driven by the test
+                    "objectives": {"act_latency_p99_ms": 20.0},
+                },
+            },
+            out_dir=str(out),
+        )
+        assert ops.inject_dispatch_delay_s == pytest.approx(0.12)
+        client = gateway.client("victim")
+        for _ in range(6):
+            client.act(_obs_row(gateway))
+        ops.slo_tick()
+        fired = [
+            rec
+            for rec in ops.slo.alert_log
+            if rec["event"] == "fire" and rec["objective"] == "act_latency_p99"
+        ]
+        assert {rec["alert"] for rec in fired} >= {"fast_burn"}
+        assert counters.slo_alerts_fired >= 1
+        assert ops.slo.verdicts()["act_latency_p99"] == "FAIL"
+        flights = glob.glob(str(out / "flight_slo_burn_*.json"))
+        assert flights, "an SLO burn must dump a flight recording"
+    finally:
+        obs_counters.install(None)
+        gateway.close()
+
+    records = [json.loads(line) for line in open(out / "alerts.jsonl")]
+    assert any(
+        r["event"] == "fire" and r["objective"] == "act_latency_p99" for r in records
+    )
+    assert _serve_report(out) == 1  # a violated objective is a FAIL report
+    assert "**Overall: FAIL**" in (out / "serve_report.md").read_text()

@@ -53,6 +53,58 @@ def test_closed_ring_raises_instead_of_hanging():
         ring.wait_response(0, 1, timeout=5.0)
 
 
+def test_ring_slot_meta_carries_the_trace_baton():
+    """A sampled request's stamps ride the slot-metadata block; an unsampled
+    request clears the slot so a stale baton never attaches to it."""
+    from sheeprl_tpu.obs.reqtrace import RequestTrace
+    from sheeprl_tpu.serve.rings import ActSlabRing
+
+    ring = ActSlabRing.from_example(
+        {"obs": np.zeros(2, dtype=np.float32)}, np.zeros(1, dtype=np.float32), 2
+    )
+    try:
+        assert ring.read_meta(0) is None  # fresh slot: no baton
+        trace = RequestTrace(42, t_start=1.5)
+        ring.request(0, {"obs": np.zeros(2, np.float32)}, seq=1, reset=False, trace=trace)
+        got = ring.read_meta(0)
+        assert got is not None
+        assert got.trace_id == 42
+        assert got.t_start == 1.5
+        assert got.t_enqueue == trace.t_enqueue > 0  # stamped at request()
+        ring.request(0, {"obs": np.zeros(2, np.float32)}, seq=2, reset=False)
+        assert ring.read_meta(0) is None  # unsampled request cleared it
+    finally:
+        ring.close()
+
+
+def test_ring_layout_version_guard_refuses_mismatched_builds():
+    """Attaching a ring pickled by a different slab layout must fail loud
+    (RuntimeError naming the mismatch), never misread slab bytes."""
+    from sheeprl_tpu.serve.rings import RING_LAYOUT_VERSION, ActSlabRing
+
+    ring = ActSlabRing.from_example(
+        {"obs": np.zeros(1, dtype=np.float32)}, np.zeros(1, dtype=np.float32), 1
+    )
+    try:
+        state = ring.__getstate__()
+        # the current layout attaches cleanly
+        clone = ActSlabRing.__new__(ActSlabRing)
+        clone.__setstate__(dict(state))
+        assert clone.n_clients == ring.n_clients
+        # an older build's pickle (pre-metadata layout) is refused
+        stale = dict(state)
+        stale["_layout"] = RING_LAYOUT_VERSION - 1
+        with pytest.raises(RuntimeError, match="slab-layout mismatch"):
+            ActSlabRing.__new__(ActSlabRing).__setstate__(stale)
+        # so is a pickle from before the layout stamp existed at all
+        unstamped = dict(state)
+        del unstamped["_layout"]
+        with pytest.raises(RuntimeError, match="slab-layout mismatch"):
+            ActSlabRing.__new__(ActSlabRing).__setstate__(unstamped)
+    finally:
+        ring.close()
+
+
 # ----------------------------------------------------- against a live gateway
 
 

@@ -130,6 +130,17 @@ def _sub_metrics(line: Dict[str, Any]) -> Dict[str, Tuple[float, bool]]:
     out: Dict[str, Tuple[float, bool]] = {}
     if isinstance(line.get("sps"), (int, float)):
         out["sps"] = (float(line["sps"]), True)
+    # serving-tier lines (bench_serve): sustained request rate is
+    # higher-better; the per-stage latency decomposition folded under
+    # ``serve`` (queue_wait/batch_assembly/device_dispatch/respond
+    # percentiles, all *_ms) is lower-better
+    if isinstance(line.get("req_s"), (int, float)) and line["req_s"] > 0:
+        out["req_s"] = (float(line["req_s"]), True)
+    srv = line.get("serve")
+    if isinstance(srv, dict):
+        for key, val in srv.items():
+            if isinstance(val, (int, float)) and val > 0 and key.endswith("_ms"):
+                out[f"serve.{key}"] = (float(val), False)
     # directional keys on the evidence line itself (bench_dreamer,
     # bench_comms rows)
     for key, higher in [(k, False) for k in _LOWER_KEYS] + [

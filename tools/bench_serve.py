@@ -46,6 +46,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import sys
 import threading
 import time
@@ -102,6 +103,22 @@ def _train(algo: str, env_id: str, workdir: str, total_steps: int, seed: int) ->
 # ---------------------------------------------------------------------------
 
 
+def _scrape_metrics(ops) -> bool:
+    """One GET against the gateway's /metrics; True iff the payload carries
+    the serve series (the per-stage percentiles and the SLO block)."""
+    if ops is None or ops.prom is None:
+        return False
+    try:
+        from urllib.request import urlopen
+
+        with urlopen(f"http://127.0.0.1:{ops.prom.port}/metrics", timeout=10) as resp:
+            body = resp.read().decode("utf-8", "replace")
+        return "phase_duration_ms" in body and "slo_objective_ok" in body
+    except Exception as exc:
+        print(f"[bench-serve] /metrics scrape failed: {exc}", flush=True)
+        return False
+
+
 def run_load(args, workdir: str) -> Dict[str, Any]:
     """Drive the client fleet; returns the evidence line (raises on failure
     of the zero-failed-requests / mid-run-swap acceptance contract)."""
@@ -118,6 +135,27 @@ def run_load(args, workdir: str) -> Dict[str, Any]:
         deadline_s=args.deadline_ms / 1e3,
         seed=args.seed,
     )
+    # the full ops surface rides every load run: per-request tracing, the
+    # burn-rate SLO engine, the sampled access log, and /metrics — so the
+    # evidence line carries the stage decomposition and an SLO verdict, and
+    # the CI smoke can assert the whole surface materializes
+    obs_dir = args.obs_dir or os.path.join(workdir, "serve_obs")
+    shutil.rmtree(obs_dir, ignore_errors=True)  # evidence from THIS run only
+    ops = gateway.enable_ops(
+        {
+            "trace_sample_rate": args.trace_rate,
+            "access_log_sample_rate": args.access_rate,
+            "metrics_port": args.metrics_port,
+            "inject_dispatch_delay_s": args.inject_dispatch_delay,
+            "slo": {
+                "enabled": True,
+                # generous p99 bound: a load run's tail includes first-sight
+                # XLA compiles of every new coalesced batch size
+                "objectives": {"act_latency_p99_ms": args.slo_p99_ms},
+            },
+        },
+        out_dir=obs_dir,
+    )
     n_clients = int(args.clients)
     base_version = gateway.status()["model_version"]
 
@@ -127,6 +165,9 @@ def run_load(args, workdir: str) -> Dict[str, Any]:
     # point in the run is exactly where we put it
     state = read_checkpoint(ckpt, verify=True)
     poll_root = os.path.join(workdir, "published_policies")
+    # a leftover channel from a previous round would read as a minute-stale
+    # unpicked-up policy and fail the swap_staleness SLO at t=0
+    shutil.rmtree(poll_root, ignore_errors=True)
     publisher = PolicyPublisher(poll_root, algo="sac")
     swapper = gateway.watch(poll_root, poll_interval_s=3600.0)
 
@@ -181,14 +222,28 @@ def run_load(args, workdir: str) -> Dict[str, Any]:
     for t in threads:
         t.join(timeout=180.0)
     wall = time.monotonic() - t0
+    metrics_ok = _scrape_metrics(ops)  # before drain stops the PromServer
     drained = gateway.drain(timeout=60.0)
     stats = gateway.batcher.stats()
+
+    # fold the per-stage decomposition flat (queue_wait_p95_ms, ...) so
+    # bench_compare diffs each stage tail lower-better round over round
+    serve_sub: Dict[str, Any] = {}
+    for stage, pct in (stats.get("stage_latency") or {}).items():
+        for q in ("p50_ms", "p95_ms", "p99_ms"):
+            if pct.get(q) is not None:
+                serve_sub[f"{stage}_{q}"] = pct[q]
+    slo_status = ops.slo.status() if ops is not None and ops.slo is not None else {}
+    slo_verdicts = (
+        {k: v.get("verdict") for k, v in (slo_status.get("objectives") or {}).items()}
+    )
 
     requests = int(stats["requests"])
     line = {
         "metric": f"serve_load_{n_clients}_clients",
         "value": round(requests / wall, 1),
         "unit": "it/s",
+        "req_s": round(requests / wall, 1),
         "n_clients": n_clients,
         "duration_s": round(wall, 2),
         "requests": requests,
@@ -197,6 +252,7 @@ def run_load(args, workdir: str) -> Dict[str, Any]:
         "p50_ms": stats["act_latency"].get("p50_ms"),
         "p95_ms": stats["act_latency"].get("p95_ms"),
         "p99_ms": stats["act_latency"].get("p99_ms"),
+        "serve": serve_sub,
         "deadline_misses": stats["deadline_misses"],
         "swaps": stats["swaps"],
         "swap_at_s": swap_at_s,
@@ -204,17 +260,32 @@ def run_load(args, workdir: str) -> Dict[str, Any]:
         "failed_requests": stats["failed_requests"] + len(failures),
         "clients_past_swap": int(sum(saw_new_version)),
         "drained_clean": bool(drained),
+        "slo_verdicts": slo_verdicts,
+        "slo_alerts_fired": int(slo_status.get("alerts_fired") or 0),
+        "trace_sampled": int(ops.tracer.sampled) if ops and ops.tracer else 0,
+        "access_log_lines": int(ops.access.written) if ops and ops.access else 0,
+        "metrics_scrape_ok": bool(metrics_ok),
+        "obs_dir": os.path.abspath(obs_dir),
         "checkpoint": os.path.basename(ckpt),
         "protocol": (
             "tiny SAC/Pendulum actor served on CPU; LocalServeClient threads in "
             "closed loops; one PolicyPublisher hot-swap at duration/2 under full "
-            "load; p99 includes first-sight compiles of new coalesced batch sizes"
+            "load; p99 includes first-sight compiles of new coalesced batch sizes; "
+            "full ops surface on (tracing, SLO engine, access log, /metrics)"
         ),
     }
 
     problems = []
     if line["failed_requests"]:
         problems.append(f"{line['failed_requests']} failed requests (must be 0)")
+    if args.inject_dispatch_delay <= 0:
+        for name, verdict in slo_verdicts.items():
+            if verdict != "PASS":
+                problems.append(f"SLO objective {name} verdict {verdict} (must be PASS)")
+    if not metrics_ok:
+        problems.append("/metrics scrape failed or missing serve series")
+    if args.trace_rate > 0 and line["trace_sampled"] == 0 and requests > 0:
+        problems.append("tracing on but no request was sampled")
     if not swapped or stats["swaps"] != 1:
         problems.append(f"hot-swap did not land (swapped={swapped}, swaps={stats['swaps']})")
     if stats["versions_served"] != [base_version, base_version + 1000]:
@@ -344,6 +415,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="with --matrix-parity: parity cells only, no load phase")
     parser.add_argument("--out-dir", default=REPO, help="where BENCH_SERVE_r<k>.json lands")
     parser.add_argument("--workdir", default="/tmp/bench_serve", help="training scratch dir")
+    parser.add_argument("--obs-dir", default=None,
+                        help="ops-surface artifact dir (default <workdir>/serve_obs)")
+    parser.add_argument("--trace-rate", type=float, default=0.01,
+                        help="serve.trace_sample_rate for the load run")
+    parser.add_argument("--access-rate", type=float, default=0.01,
+                        help="serve.access_log_sample_rate for the load run")
+    parser.add_argument("--metrics-port", type=int, default=0,
+                        help="/metrics port (0 = ephemeral)")
+    parser.add_argument("--slo-p99-ms", type=float, default=2000.0,
+                        help="act-latency p99 SLO bound (generous: the tail "
+                             "includes first-sight compiles)")
+    parser.add_argument("--inject-dispatch-delay", type=float, default=0.0,
+                        help="fault drill: stall every dispatch this many "
+                             "seconds (SLO verdicts then expected to FAIL)")
     args = parser.parse_args(argv)
     if args.quick:
         args.clients, args.duration = min(args.clients, 32), min(args.duration, 3.0)

@@ -37,6 +37,13 @@ lint fails when a file under ``sheeprl_tpu/algos/`` re-grows its own copy:
   is one edit), and host-level collectives through the fabric methods'
   measured spans only via shared infrastructure, never ad hoc in an algo.
 
+The serving tier gets the same clock discipline: files under
+``sheeprl_tpu/serve/`` may not read ``time.time()`` / ``time.monotonic()`` /
+``time.perf_counter()`` directly — every request timestamp must come from
+the sanctioned chokepoint ``sheeprl_tpu.obs.reqtrace.now`` / ``unix_now``,
+so trace spans, latency histograms, and SLO burn windows stay on one
+comparable clock (``time.sleep`` is fine — it is not a clock read).
+
 AST-based, so comments and docstrings mentioning the metric names are fine.
 
 Usage: ``python tools/lint_telemetry.py`` — exits non-zero with a findings
@@ -51,6 +58,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
+SERVE_DIR = os.path.join(REPO, "sheeprl_tpu", "serve")
 
 FORBIDDEN_LITERAL_PREFIXES = ("Time/sps_", "Perf/mfu")
 FORBIDDEN_TIMER_CALLS = ("compute", "reset")
@@ -231,6 +239,34 @@ def lint_file(path: str) -> list:
     return findings
 
 
+def lint_serve_file(path: str) -> list:
+    """The clock rule only, for the serving tier: ad-hoc wall-clock reads
+    fragment the one timeline the trace/histogram/SLO planes share."""
+    src = open(path).read()
+    tree = ast.parse(src, filename=path)
+    clock_modules, clock_names = _clock_aliases(tree)
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in clock_modules
+            and fn.attr in FORBIDDEN_CLOCK_ATTRS
+        ) or (isinstance(fn, ast.Name) and fn.id in clock_names):
+            clock = fn.attr if isinstance(fn, ast.Attribute) else fn.id
+            findings.append(
+                (node.lineno,
+                 f"ad-hoc {clock}() wall-clock read in the serving tier — "
+                 "use sheeprl_tpu.obs.reqtrace.now (monotonic) or "
+                 ".unix_now (wall) so request stamps stay comparable "
+                 "across the trace, latency, and SLO planes")
+            )
+    return findings
+
+
 def main() -> int:
     failures = []
     for root, _dirs, files in os.walk(ALGOS_DIR):
@@ -239,6 +275,13 @@ def main() -> int:
                 continue
             path = os.path.join(root, name)
             for lineno, message in lint_file(path):
+                failures.append(f"{os.path.relpath(path, REPO)}:{lineno}: {message}")
+    for root, _dirs, files in os.walk(SERVE_DIR):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            for lineno, message in lint_serve_file(path):
                 failures.append(f"{os.path.relpath(path, REPO)}:{lineno}: {message}")
     if failures:
         print("telemetry-uniformity lint FAILED:")
