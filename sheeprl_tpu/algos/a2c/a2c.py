@@ -37,7 +37,7 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
-from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.replay import make_replay_buffer
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -219,12 +219,15 @@ def main(fabric, cfg: Dict[str, Any]):
     to_host = HostParamMirror.from_cfg(params, fabric, cfg)
 
     rollout_steps = int(cfg.algo.rollout_steps)
-    rb = ReplayBuffer(
-        max(int(cfg.buffer.size), rollout_steps),
-        n_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
+    rb = make_replay_buffer(
+        cfg,
+        fabric,
+        log_dir,
+        n_envs=n_envs,
         obs_keys=obs_keys,
+        size=int(cfg.buffer.size),
+        min_size=rollout_steps,
+        sampled=False,
     )
 
     def _act_fn(params, obs, key):

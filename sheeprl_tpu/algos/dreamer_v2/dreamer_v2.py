@@ -47,12 +47,8 @@ from sheeprl_tpu.algos.dreamer_v2.utils import (
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
-from sheeprl_tpu.data.buffers import (
-    EnvIndependentReplayBuffer,
-    EpisodeBuffer,
-    SequentialReplayBuffer,
-)
 from sheeprl_tpu.data.staging import make_replay_staging
+from sheeprl_tpu.replay import make_replay_buffer
 from sheeprl_tpu.distributions import Bernoulli, Independent, Normal
 from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
@@ -526,31 +522,18 @@ def main(fabric, cfg: Dict[str, Any]):
 
     # Buffer: sequential (per-env sub-buffers) or whole-episode storage
     # (reference main :545-564)
-    buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 8
-    buffer_type = str(cfg.buffer.get("type", "sequential")).lower()
-    if buffer_type == "sequential":
-        rb = EnvIndependentReplayBuffer(
-            max(buffer_size, 8),
-            n_envs,
-            obs_keys=obs_keys,
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
-            buffer_cls=SequentialReplayBuffer,
-        )
-    elif buffer_type == "episode":
-        rb = EpisodeBuffer(
-            max(buffer_size, int(cfg.per_rank_sequence_length)),
-            sequence_length=int(cfg.per_rank_sequence_length),
-            n_envs=n_envs,
-            obs_keys=obs_keys,
-            prioritize_ends=bool(cfg.buffer.get("prioritize_ends", False)),
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
-        )
-    else:
-        raise ValueError(
-            f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
-        )
+    rb = make_replay_buffer(
+        cfg,
+        fabric,
+        log_dir,
+        n_envs=n_envs,
+        kind="dreamer",
+        obs_keys=obs_keys,
+        min_size=8,
+        dry_run_size=8,
+        sequence_length=int(cfg.per_rank_sequence_length),
+    )
+    episode_buffer = str(cfg.buffer.get("type", "sequential")).lower() == "episode"
     if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
         rb.load_state_dict(state["rb"])
 
@@ -648,7 +631,7 @@ def main(fabric, cfg: Dict[str, Any]):
         if "restart_on_exception" in infos:
             for i, env_roe in enumerate(infos["restart_on_exception"]):
                 if env_roe and not dones[i]:
-                    if not isinstance(rb, EpisodeBuffer):
+                    if not episode_buffer:
                         # both the host copy and (when the ring is on) the
                         # HBM mirror are patched by the staging facade
                         staging.force_done_last(i)

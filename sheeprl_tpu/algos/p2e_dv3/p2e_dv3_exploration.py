@@ -57,7 +57,7 @@ from sheeprl_tpu.algos.p2e_dv3.agent import apply_ensemble, build_agent, build_p
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.replay import make_replay_buffer
 from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.distributions import MSEDistribution, SymlogDistribution, TwoHotEncodingDistribution
 from sheeprl_tpu.envs.rollout import BurstActor
@@ -674,14 +674,15 @@ def main(fabric, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
-    buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 4
-    rb = EnvIndependentReplayBuffer(
-        max(buffer_size, 4),
-        n_envs,
+    rb = make_replay_buffer(
+        cfg,
+        fabric,
+        log_dir,
+        n_envs=n_envs,
+        kind="sequential",
         obs_keys=obs_keys,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
-        buffer_cls=SequentialReplayBuffer,
+        min_size=4,
+        dry_run_size=4,
     )
     if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
         rb.load_state_dict(state["rb"])

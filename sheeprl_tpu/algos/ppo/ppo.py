@@ -52,7 +52,7 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.config.instantiate import instantiate
-from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.replay import make_replay_buffer
 from sheeprl_tpu.obs import (
     count_h2d,
     get_telemetry,
@@ -291,12 +291,14 @@ def main(fabric, cfg: Dict[str, Any]):
             f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
             f"than the rollout steps ({cfg.algo.rollout_steps})"
         )
-    rb = ReplayBuffer(
-        int(cfg.buffer.size),
-        n_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
+    rb = make_replay_buffer(
+        cfg,
+        fabric,
+        log_dir,
+        n_envs=n_envs,
         obs_keys=obs_keys,
+        size=int(cfg.buffer.size),
+        sampled=False,
     )
 
     # ------------------------------------------------------------------

@@ -42,7 +42,7 @@ from sheeprl_tpu.algos.ppo_recurrent.agent import (
 )
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
-from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.replay import make_replay_buffer
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -252,12 +252,15 @@ def main(fabric, cfg: Dict[str, Any]):
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
     rollout_steps = int(cfg.algo.rollout_steps)
-    rb = ReplayBuffer(
-        max(int(cfg.buffer.size), rollout_steps),
-        n_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
+    rb = make_replay_buffer(
+        cfg,
+        fabric,
+        log_dir,
+        n_envs=n_envs,
         obs_keys=obs_keys,
+        size=int(cfg.buffer.size),
+        min_size=rollout_steps,
+        sampled=False,
     )
 
     # ------------------------------------------------------------------

@@ -56,7 +56,7 @@ from sheeprl_tpu.algos.sac.utils import concat_obs, test
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
-from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.replay import make_replay_buffer
 from sheeprl_tpu.data.device_ring import DeviceRingTransitions
 from sheeprl_tpu.data.staging import RingStaging, make_replay_staging
 from sheeprl_tpu.envs.rollout import BurstActor, JaxRolloutEngine, make_jax_env
@@ -99,6 +99,7 @@ def build_train_fn(
     donate: bool = True,
     state_plan=None,
     opt_plan=None,
+    emit_td: bool = False,
 ):
     """Compile G gradient steps (critic → EMA → actor → alpha) as one SPMD
     program. ``batch`` leaves are ``[G, B_local, ...]``; ``do_ema`` is a
@@ -114,7 +115,16 @@ def build_train_fn(
     collectives. The jax-0.4-era partitioner CHECK-fails on ``lax.scan``
     inside a partially-manual (``auto=``) shard_map, so the sharded path
     avoids shard_map entirely. ``None`` is the byte-identical manual
-    data-parallel program."""
+    data-parallel program.
+
+    ``emit_td=True`` (the prioritized-replay writeback path,
+    ``replay.strategy=td_priority``) additionally returns the per-row TD
+    residual ``min_i Q_i(s,a) − y`` of the *pre-update* critics, stacked
+    ``[G, B, 1]`` in the staged batch's row order, as the LAST output — the
+    aux of the same critic-loss evaluation, so the extra cost is one output,
+    not a second forward pass. With ``emit_td=False`` (the default, and
+    every uniform-replay path) the built program is byte-identical to
+    before the flag existed."""
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     n_critics = int(cfg.algo.critic.n)
@@ -147,11 +157,24 @@ def build_train_fn(
         td_target = batch["rewards"] + (1.0 - batch["dones"]) * gamma * min_target
         td_target = jax.lax.stop_gradient(td_target)
 
-        def qf_loss_fn(critic_params):
-            q = ensemble_q(critic, critic_params, batch["observations"], batch["actions"])
-            return critic_loss(q, td_target, n_critics)
+        if emit_td:
 
-        qf_loss, qf_grads = jax.value_and_grad(qf_loss_fn)(state["critics"])
+            def qf_loss_td_fn(critic_params):
+                q = ensemble_q(critic, critic_params, batch["observations"], batch["actions"])
+                return critic_loss(q, td_target, n_critics), q
+
+            (qf_loss, q_pre), qf_grads = jax.value_and_grad(qf_loss_td_fn, has_aux=True)(
+                state["critics"]
+            )
+            td = jnp.min(q_pre, axis=-1, keepdims=True) - td_target
+        else:
+
+            def qf_loss_fn(critic_params):
+                q = ensemble_q(critic, critic_params, batch["observations"], batch["actions"])
+                return critic_loss(q, td_target, n_critics)
+
+            qf_loss, qf_grads = jax.value_and_grad(qf_loss_fn)(state["critics"])
+            td = None
         qf_grads = pmean(qf_grads, axis)
         qf_updates, qf_opt = qf_tx.update(qf_grads, opt_states["qf"], state["critics"])
         critics = optax.apply_updates(state["critics"], qf_updates)
@@ -218,7 +241,10 @@ def build_train_fn(
                 losses=(qf_loss, actor_loss, alpha_loss),
                 clip_norms=learn_clips,
             )
-            return (new_state, new_opts, do_ema), (metrics, probes)
+            ys = (metrics, probes, td) if emit_td else (metrics, probes)
+            return (new_state, new_opts, do_ema), ys
+        if emit_td:
+            return (new_state, new_opts, do_ema), (metrics, td)
         return (new_state, new_opts, do_ema), metrics
 
     def local_train(state, opt_states, batch, key, do_ema):
@@ -227,27 +253,44 @@ def build_train_fn(
         (state, opt_states, _), ys = jax.lax.scan(
             one_step, (state, opt_states, do_ema), (batch, keys)
         )
-        metrics, probes = ys if learn_on else (ys, None)
+        td = None
+        if learn_on and emit_td:
+            metrics, probes, td = ys
+        elif learn_on:
+            metrics, probes = ys
+        elif emit_td:
+            metrics, td = ys
+            probes = None
+        else:
+            metrics, probes = ys, None
         metrics = pmean(jnp.mean(metrics, axis=0), axis)
+        out = (state, opt_states, metrics)
         if learn_on:
             # probes ride the scan ys stacked [G]: per-gradient-step samples
-            return state, opt_states, metrics, probes
-        return state, opt_states, metrics
+            out = out + (probes,)
+        if emit_td:
+            # td residuals ride the same ys, stacked [G, B, 1] — always LAST
+            out = out + (td,)
+        return out
 
     # decoupled mode keeps the old actor params alive for the player
     # thread, so donation must be off there
     donate_argnums = (0, 1) if donate else ()
     n_learn = 1 if learn_on else 0
+    # td residuals are [G, B, 1] with the batch axis data-sharded, like the
+    # staged batch itself
+    td_specs = (P(None, data_axis),) if emit_td else ()
     if state_plan is None:
         shmapped = shard_map(
             local_train,
             mesh=fabric.mesh,
             in_specs=(P(), P(), P(None, data_axis), P(), P()),
-            out_specs=(P(), P(), P()) + (P(),) * n_learn,
+            out_specs=(P(), P(), P()) + (P(),) * n_learn + td_specs,
             check_vma=False,
         )
         return jax.jit(shmapped, donate_argnums=donate_argnums)
     rep = fabric.replicated
+    td_shardings = (fabric.sharding(None, data_axis),) if emit_td else ()
     return jax.jit(
         local_train,
         in_shardings=(
@@ -258,7 +301,8 @@ def build_train_fn(
             rep,
         ),
         out_shardings=(state_plan.shardings(), opt_plan.shardings(), rep)
-        + (rep,) * n_learn,
+        + (rep,) * n_learn
+        + td_shardings,
         donate_argnums=donate_argnums,
     )
 
@@ -378,13 +422,13 @@ def main(fabric, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
-    buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 1
-    rb = ReplayBuffer(
-        max(buffer_size, 1),
-        n_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
+    rb = make_replay_buffer(
+        cfg,
+        fabric,
+        log_dir,
+        n_envs=n_envs,
         obs_keys=("observations",),
+        dry_run_size=1,
     )
 
     # ------------------------------------------------------------------
@@ -401,11 +445,18 @@ def main(fabric, cfg: Dict[str, Any]):
     # it, so nothing below touches the train-step critical path
     inrun = maybe_start_inrun_eval(fabric, cfg, log_dir)
 
+    needs_writeback = bool(getattr(rb, "needs_writeback", False))
     train_fn = build_train_fn(
         actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric, action_scale, action_bias, target_entropy,
-        state_plan=state_plan, opt_plan=opt_plan,
+        state_plan=state_plan, opt_plan=opt_plan, emit_td=needs_writeback,
     )
     batch_sharding = fabric.sharding(None, fabric.data_axis)
+    if backend == "jax" and hasattr(rb, "plan_burst"):
+        raise ValueError(
+            "env.backend=jax collects straight into the device ring, which "
+            "needs the plain replay buffer — run prioritized/sharded replay "
+            "(replay.strategy/replay.shards) on the python backend"
+        )
     if backend == "jax":
         # the jitted-scan collection writes straight into the device ring —
         # the ring IS the collection target on this backend, so it is always
@@ -637,8 +688,17 @@ def main(fabric, cfg: Dict[str, Any]):
                 agent_state, opt_states, losses = outs[0], outs[1], outs[2]
                 # [G]-stacked learn probes (4th output when probes are on):
                 # one cadence-gated device_get inside observe_probes
-                observe_probes(outs[3] if len(outs) > 3 else None, step=policy_step)
+                observe_probes(
+                    outs[3] if probes_enabled(cfg) and len(outs) > 3 else None,
+                    step=policy_step,
+                )
                 losses = fetch_losses_if_observed(losses, aggregator)
+            if needs_writeback:
+                # PER writeback (replay.strategy=td_priority): the [G, B, 1]
+                # td residuals flatten in the last plan's row order
+                staging.update_priorities(
+                    np.abs(np.asarray(jax.device_get(outs[-1]))).reshape(-1)
+                )
             if train_specs is not None:
                 # per train-step UNIT (FLOPs + bytes accessed): the counter
                 # advances by world_size per dispatched program (which runs

@@ -48,13 +48,13 @@ from sheeprl_tpu.algos.sac.sac import build_train_fn
 from sheeprl_tpu.algos.sac.utils import test
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
-from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.envs.vector import make_eval_env
 from sheeprl_tpu.obs import (
     get_telemetry,
     log_sps_metrics,
     observe_probes,
+    probes_enabled,
     profile_tick,
     register_train_cost,
     shape_specs,
@@ -67,6 +67,7 @@ from sheeprl_tpu.plane import (
     plane_env_split,
     version_after,
 )
+from sheeprl_tpu.replay import ReplayPlane, make_replay_buffer, replay_config
 from sheeprl_tpu.utils.host import HostParamMirror
 from sheeprl_tpu.utils.logger import create_tensorboard_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -156,20 +157,36 @@ def main(fabric, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
-    buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 1
-    rb = ReplayBuffer(
-        max(buffer_size, 1),
-        n_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
+    # replay storage through the one factory (sheeprl_tpu/replay): shards=1 +
+    # uniform returns the plain ReplayBuffer — bitwise the historical path;
+    # replay.shards=N partitions the env axis so each player process owns
+    # exactly one single-writer shard
+    replay_cfg = replay_config(cfg)
+    replay_shards = int(replay_cfg.get("shards", 1) or 1)
+    num_players, envs_per_player = plane_env_split(cfg, n_envs)
+    if replay_shards > 1 and replay_shards != num_players:
+        raise ValueError(
+            f"replay.shards={replay_shards} requires plane.num_players="
+            f"{replay_shards} so each player process owns exactly one shard "
+            f"(got plane.num_players={num_players})"
+        )
+    rb = make_replay_buffer(
+        cfg,
+        fabric,
+        log_dir,
+        n_envs=n_envs,
         obs_keys=("observations",),
+        dry_run_size=1,
+        shards=replay_shards,
     )
     if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
         rb.load_state_dict(state["rb"])
 
+    needs_writeback = bool(getattr(rb, "needs_writeback", False))
     train_fn = build_train_fn(
         actor, critic, actor_tx, qf_tx, alpha_tx, cfg, fabric,
         action_scale, action_bias, target_entropy, donate=False,
+        emit_td=needs_writeback,
     )
     batch_sharding = fabric.sharding(None, fabric.data_axis)
     # TPU-first replay staging (data/staging.py). The learner thread is the
@@ -180,6 +197,17 @@ def main(fabric, cfg: Dict[str, Any]):
         cfg, fabric, rb, batch_sharding=batch_sharding, seed=cfg.seed
     )
     rb = staging.rb
+    # zero-dispatch slab adoption (replay.adopt_slabs): sampled rows go
+    # slab → HBM directly through the device ring instead of the
+    # slab → host-rb → ring double copy
+    adopt_slabs = bool(replay_cfg.get("adopt_slabs", False))
+    if adopt_slabs and not staging.supports_adoption:
+        warnings.warn(
+            "replay.adopt_slabs=True needs the single-group device ring "
+            "(buffer.device_ring=True on a 1-group mesh); keeping the "
+            "host-copy path."
+        )
+        adopt_slabs = False
 
     last_train = 0
     train_step = 0
@@ -203,7 +231,6 @@ def main(fabric, cfg: Dict[str, Any]):
     # the actor–learner plane (sheeprl_tpu/plane, howto/actor_learner.md)
     # ------------------------------------------------------------------
 
-    num_players, envs_per_player = plane_env_split(cfg, n_envs)
     store_next_obs = not cfg.buffer.sample_next_obs
     slab_spec = SlabSpec.from_arrays(
         sac_slab_example(act_burst, envs_per_player, obs_dim, act_dim, store_next_obs)
@@ -244,6 +271,10 @@ def main(fabric, cfg: Dict[str, Any]):
         initial_params=actor_mirror(agent_state["actor"]),
         watchdog=watchdog,
     )
+    # sharded mode: player p's slab columns are exactly shard p's env
+    # columns, so ingest routes each handle straight into its shard (one
+    # copy per shard — no full-width concatenation)
+    replay_plane = ReplayPlane(plane, rb) if replay_shards > 1 else None
 
     # ------------------------------------------------------------------
     # the learner loop (reference trainer(), :273-548): one train round per
@@ -264,19 +295,27 @@ def main(fabric, cfg: Dict[str, Any]):
             if watchdog is not None:
                 watchdog.beat("sac-learner")
 
-            if plane.n_players == 1:
-                rows = {k: v[:n_act] for k, v in handles[0].data.items()}
+            if replay_plane is not None:
+                # per-shard ingest: commit-stamped adds + max-priority init
+                # (the prioritized commit channel), handles released inside
+                ep_stats = replay_plane.ingest(handles, n_act)
             else:
-                # assemble the full-width step rows in player order — the env
-                # axis concatenation restores the canonical seed order
-                rows = {
-                    k: np.concatenate([h.data[k][:n_act] for h in handles], axis=1)
-                    for k in handles[0].data
-                }
-            rb.add(rows)  # the one copy of the slab→replay path
-            ep_stats = [s for h in handles for s in h.ep_stats]
-            for h in handles:
-                h.release()
+                if plane.n_players == 1:
+                    rows = {k: v[:n_act] for k, v in handles[0].data.items()}
+                else:
+                    # assemble the full-width step rows in player order — the
+                    # env axis concatenation restores the canonical seed order
+                    rows = {
+                        k: np.concatenate([h.data[k][:n_act] for h in handles], axis=1)
+                        for k in handles[0].data
+                    }
+                if adopt_slabs:
+                    staging.adopt_slab(rows, n_act)  # slab → HBM, one copy
+                else:
+                    rb.add(rows)  # the one copy of the slab→replay path
+                ep_stats = [s for h in handles for s in h.ep_stats]
+                for h in handles:
+                    h.release()
             policy_step += n_envs * n_act
 
             if aggregator and not aggregator.disabled:
@@ -308,9 +347,16 @@ def main(fabric, cfg: Dict[str, Any]):
                     outs = train_fn(*train_args)
                     agent_state, opt_states, losses = outs[0], outs[1], outs[2]
                     observe_probes(
-                        outs[3] if len(outs) > 3 else None, step=policy_step
+                        outs[3] if probes_enabled(cfg) and len(outs) > 3 else None,
+                        step=policy_step,
                     )
                     losses = fetch_losses_if_observed(losses, aggregator)
+                if needs_writeback:
+                    # PER writeback (replay.strategy=td_priority): the [G, B, 1]
+                    # td residuals flatten in the last plan's row order
+                    staging.update_priorities(
+                        np.abs(np.asarray(jax.device_get(outs[-1]))).reshape(-1)
+                    )
                 if telemetry is not None and telemetry.needs_train_flops():
                     # donation is off in decoupled mode; one AOT cost
                     # analysis, registered per train-step UNIT

@@ -35,7 +35,7 @@ from sheeprl_tpu.algos.sac_ae.utils import normalize_obs_jnp, prepare_obs, test
 from sheeprl_tpu.ckpt import preemption_requested, should_checkpoint, warn_checkpoint_rounding
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.utils.host import HostParamMirror
-from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.replay import make_replay_buffer
 from sheeprl_tpu.data.staging import make_replay_staging
 from sheeprl_tpu.envs.rollout import BurstActor
 from sheeprl_tpu.envs.vector import make_vector_env
@@ -385,13 +385,13 @@ def main(fabric, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
-    buffer_size = int(cfg.buffer.size) // n_envs if not cfg.dry_run else 1
-    rb = ReplayBuffer(
-        max(buffer_size, 1),
-        n_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{fabric.global_rank}"),
+    rb = make_replay_buffer(
+        cfg,
+        fabric,
+        log_dir,
+        n_envs=n_envs,
         obs_keys=tuple(obs_keys),
+        dry_run_size=1,
     )
     if state is not None and cfg.buffer.get("checkpoint", False) and "rb" in state:
         rb.load_state_dict(state["rb"])

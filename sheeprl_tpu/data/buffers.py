@@ -40,6 +40,16 @@ def _as_np(v: Union[np.ndarray, MemmapArray]) -> np.ndarray:
     return v.array if isinstance(v, MemmapArray) else v
 
 
+def end_biased_start(rng: np.random.Generator, length: int, upper: int) -> int:
+    """One end-biased window-start draw: uniform over ``[0, length)`` clamped
+    to the inclusive max start ``upper``, so the probability mass of the
+    clamped tail piles onto the last valid start. This IS the
+    ``EpisodeBuffer`` ``prioritize_ends`` draw, factored out so the replay
+    plane's ``prioritize_ends`` sampling strategy (sheeprl_tpu/replay)
+    matches it bitwise by construction."""
+    return min(int(rng.integers(0, length)), upper)
+
+
 class ReplayBuffer:
     """Uniform-sampling ring buffer of shape ``[buffer_size, n_envs, ...]``."""
 
@@ -293,6 +303,48 @@ class ReplayBuffer:
             e_idx = envs_arr[rng.integers(0, len(envs_arr), size=total)]
         self._observe_sample_ages(t_idx)
         return t_idx, e_idx
+
+    def valid_time_indices(self, sample_next_obs: bool = False) -> np.ndarray:
+        """Public view of the sampleable time window (ring positions) — the
+        replay plane's sampling strategies draw over exactly this set so the
+        no-stored-successor rule stays defined in one place."""
+        return self._valid_time_indices(sample_next_obs)
+
+    def age_ordered_time_indices(self, sample_next_obs: bool = False) -> np.ndarray:
+        """The sampleable time window ordered oldest→newest (insertion
+        order). A flat ring is age-ordered ``0..pos-1`` until it wraps;
+        once full, age order starts at the write head ``_pos`` (the oldest
+        surviving row) and walks the ring. The ``prioritize_ends`` strategy
+        generalizes the EpisodeBuffer end bias over this ordering."""
+        if self._full:
+            ordered = (self._pos + np.arange(self._buffer_size)) % self._buffer_size
+        else:
+            ordered = np.arange(self._pos)
+        if sample_next_obs and len(ordered):
+            ordered = ordered[:-1]  # the newest row has no stored successor
+        return ordered
+
+    def observe_sample_ages(self, t_idx: np.ndarray) -> None:
+        """Staleness chokepoint for EXTERNAL planners (the replay plane's
+        strategies): any plan that bypasses ``plan_transitions`` must feed
+        its drawn rows through here to keep the PR-9 lineage intact."""
+        self._observe_sample_ages(t_idx)
+
+    def gather_plan(
+        self,
+        t_idx: np.ndarray,
+        e_idx: np.ndarray,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+    ) -> Dict[str, np.ndarray]:
+        """Gather a planned ``(t_idx, e_idx)`` index set as flat ``[total,
+        ...]`` rows — the entry the cross-shard sampler uses after planning
+        each shard's slice of a burst (sheeprl_tpu/replay/sharded.py)."""
+        if self._buf is None:
+            raise ValueError("No sample has been added to the buffer")
+        t_idx = np.asarray(t_idx, dtype=np.int64).reshape(-1)
+        e_idx = np.asarray(e_idx, dtype=np.int64).reshape(-1)
+        return self._gather(t_idx, e_idx, sample_next_obs, clone)
 
     def _observe_sample_ages(self, t_idx: np.ndarray) -> None:
         """Feed the drawn rows' ages into the staleness histogram — one
@@ -762,7 +814,7 @@ class EpisodeBuffer:
             ep_len = lengths[i]
             upper = ep_len - effective  # inclusive max start
             if prioritize_ends:
-                start = min(int(self._rng.integers(0, ep_len)), upper)
+                start = end_biased_start(self._rng, int(ep_len), int(upper))
             else:
                 start = int(self._rng.integers(0, upper + 1))
             for k in ep.keys():

@@ -46,7 +46,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, ReplayBuffer, _as_np
-from sheeprl_tpu.obs.counters import staged_device_put
+from sheeprl_tpu.obs.counters import add_replay_adoption, staged_device_put
 
 __all__ = ["DeviceRingReplay", "DeviceRingTransitions", "scatter_append"]
 
@@ -772,6 +772,11 @@ class DeviceRingTransitions:
         return self._rb.is_memmap
 
     @property
+    def n_groups(self) -> int:
+        """Mesh batch shards this ring is split over (1 = single device)."""
+        return len(self._groups)
+
+    @property
     def _device(self):
         return self._homes[0]
 
@@ -849,6 +854,53 @@ class DeviceRingTransitions:
             self._shards = [bufs]
             self._rb.advance_external(example_rows, int(steps))
             self._host_stale = True
+
+    def adopt_slab(self, rows: Dict[str, np.ndarray], n_valid: Optional[int] = None) -> int:
+        """Zero-dispatch slab adoption: land a trajectory slab's valid rows
+        in HBM directly — the plane's shared-memory slab views are the
+        *source* of one ``device_put`` at their exact size, scattered into
+        the ring at the positions a host ``add`` would have written.
+
+        This removes both costs of the historical slab → host rb → ring
+        path: the host-buffer row copy, and the flush's power-of-two row
+        padding (``_pad_rows``) on the host→HBM upload — ``bytes_staged_h2d``
+        for an adopted burst is the payload size, not up to 2×. The host
+        ring counters advance via ``advance_external`` (planning and the
+        staleness stamp stay correct); the host *data* goes stale until
+        :meth:`sync_host`, exactly like the jitted-scan adoption path.
+
+        ``rows`` leaves are ``[T, n_envs, ...]``; ``n_valid`` adopts only the
+        first ``n_valid`` rows (a partial slab). Single-shard rings only.
+        Returns the bytes staged over the host→HBM link.
+        """
+        if len(self._groups) != 1:
+            raise ValueError(
+                "adopt_slab requires a single-shard ring: a slab lands on "
+                "one device's storage (env-sharded adoption is not "
+                "supported yet)"
+            )
+        rows = {k: np.asarray(v) for k, v in rows.items()}
+        first = next(iter(rows.values()))
+        steps = int(first.shape[0] if n_valid is None else n_valid)
+        if steps <= 0:
+            return 0
+        with self._write_lock or nullcontext():
+            self._flush()  # earlier host-buffered adds must land first
+            if self._shards is None:
+                self._allocate({k: v[0] for k, v in rows.items()})
+            # same trailing-window rule as ReplayBuffer.add for oversize data
+            write_len = min(steps, self._capacity)
+            start = int(self._rb._pos) + steps - write_len
+            t_idx = (np.arange(start, start + write_len) % self._capacity).astype(np.int32)
+            payload = {
+                k: np.ascontiguousarray(v[steps - write_len : steps]) for k, v in rows.items()
+            }
+            dev = staged_device_put((t_idx, payload), self._homes[0])
+            self._shards[0] = self._scatter_fn(write_len)(self._shards[0], *dev)
+            self._rb.advance_external({k: v[0] for k, v in rows.items()}, steps)
+            self._host_stale = True
+        add_replay_adoption()
+        return int(sum(v.nbytes for v in payload.values()) + t_idx.nbytes)
 
     def sync_host(self) -> None:
         """Download the device ring into the host buffer (one device_get per

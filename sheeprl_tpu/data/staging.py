@@ -77,6 +77,32 @@ class ReplayStaging:
     def rb(self) -> Any:
         return self._rb
 
+    @property
+    def supports_adoption(self) -> bool:
+        """True when :meth:`adopt_slab` can land a slab straight in HBM."""
+        return False
+
+    def adopt_slab(self, rows: Dict[str, np.ndarray], n_valid: Optional[int] = None) -> int:
+        """Zero-dispatch slab adoption (device ring only) — see
+        :meth:`~sheeprl_tpu.data.device_ring.DeviceRingTransitions.adopt_slab`."""
+        raise NotImplementedError(
+            "slab adoption needs the single-group device ring "
+            "(buffer.device_ring=True on a 1-group mesh)"
+        )
+
+    def update_priorities(self, td_errors: np.ndarray) -> None:
+        """TD-priority writeback for the last sampled burst (no-op unless the
+        buffer is a prioritized ShardedReplay)."""
+        if hasattr(self._rb, "update_priorities"):
+            self._rb.update_priorities(td_errors)
+
+    def last_weights(self) -> Optional[np.ndarray]:
+        """Importance weights aligned with the last burst's flat row order
+        (``None`` for unweighted sampling)."""
+        if hasattr(self._rb, "last_weights"):
+            return self._rb.last_weights()
+        return None
+
     def sample_device(
         self,
         batch_size: int,
@@ -119,6 +145,13 @@ class RingStaging(ReplayStaging):
         return self._rb.sample_device(
             batch_size, sample_next_obs=sample_next_obs, n_samples=n_samples
         )
+
+    @property
+    def supports_adoption(self) -> bool:
+        return isinstance(self._rb, DeviceRingTransitions) and self._rb.n_groups == 1
+
+    def adopt_slab(self, rows: Dict[str, np.ndarray], n_valid: Optional[int] = None) -> int:
+        return self._rb.adopt_slab(rows, n_valid)
 
     def force_done_last(self, env: int) -> None:
         self._rb.force_done_last(env)
@@ -257,6 +290,13 @@ class HostStaging(ReplayStaging):
         note_queue_depth("staging_prefetch", len(self._pending))
         return batch
 
+    def update_priorities(self, td_errors: np.ndarray) -> None:
+        # under the shared lock: the writeback touches the same per-shard
+        # tables a concurrent planner reads
+        if hasattr(self._rb, "update_priorities"):
+            with self._lock:
+                self._rb.update_priorities(td_errors)
+
     def force_done_last(self, env: int) -> None:
         if not isinstance(self._rb, EnvIndependentReplayBuffer):
             raise NotImplementedError(
@@ -301,8 +341,20 @@ def make_replay_staging(
     sequence_mode = isinstance(rb, (EnvIndependentReplayBuffer, EpisodeBuffer))
     world_size = int(getattr(fabric, "world_size", 1) or 1) if fabric is not None else 1
     device = getattr(fabric, "device", None) if fabric is not None else None
+    # sharded/prioritized replay (sheeprl_tpu/replay): the facade plans its
+    # own cross-shard bursts on the host — duck-typed so data/ never imports
+    # the replay package (replay imports data, not the reverse)
+    is_sharded = hasattr(rb, "plan_burst")
 
     use_ring = bool(cfg.buffer.get("device_ring", False))
+    if use_ring and is_sharded:
+        warnings.warn(
+            "buffer.device_ring=True is not supported with sharded or "
+            "prioritized replay (replay.shards>1 or a non-uniform "
+            "replay.strategy): the cross-shard planner samples on the host; "
+            "falling back to the host prefetch pipeline."
+        )
+        use_ring = False
     if use_ring and jax.process_count() > 1:
         warnings.warn(
             "buffer.device_ring=True is not supported on multi-process "
@@ -351,10 +403,21 @@ def make_replay_staging(
     # depends on this)
     if seed is not None and hasattr(rb, "seed"):
         rb.seed(int(seed))
+    prefetch = bool(cfg.buffer.get("prefetch", True))
+    if prefetch and bool(getattr(rb, "needs_writeback", False)):
+        # TD-priority writeback must see the plan of the batch being trained
+        # on; prefetching would draw burst k+1's plan before burst k's
+        # priorities land, so the pipeline runs synchronous under it
+        warnings.warn(
+            "buffer.prefetch=True is disabled under a priority-writeback "
+            "replay strategy (replay.strategy=td_priority): the post-train "
+            "writeback must align with the last sampled plan."
+        )
+        prefetch = False
     return HostStaging(
         rb,
         batch_sharding,
         sequence_mode=sequence_mode,
-        prefetch=bool(cfg.buffer.get("prefetch", True)),
+        prefetch=prefetch,
         lock=lock,
     )

@@ -45,6 +45,8 @@ __all__ = [
     "add_plane_player_restart",
     "add_plane_slabs",
     "add_prefetch",
+    "add_replay_adoption",
+    "add_replay_priority_updates",
     "add_ring_gather",
     "add_rollout_burst",
     "add_serve_batch",
@@ -54,6 +56,7 @@ __all__ = [
     "add_serve_traced",
     "add_slo_alert",
     "add_train_burst",
+    "set_replay_shard_fill",
     "note_plane_policy_version",
     "device_memory_stats",
     "DevicePoller",
@@ -137,6 +140,13 @@ class Counters:
         self.plane_traj_slabs = 0
         self.plane_policy_version = 0
         self.plane_player_restarts = 0
+        # sharded replay plane (sheeprl_tpu/replay): priority rows rewritten
+        # by the TD-priority writeback channel, slabs adopted straight into
+        # the device ring (slab→HBM, no host-buffer hop), and a per-shard
+        # fill gauge ({shard -> fraction}, set after every ingest)
+        self.replay_priority_updates = 0
+        self.replay_adoptions = 0
+        self.replay_shard_fill: Dict[str, float] = {}
         # distributed comms (obs/dist/comms.py): host-level collectives
         # (fabric all-reduce/all-gather/broadcast/barrier) — total ops,
         # payload bytes, wall ms, plus a per-kind breakdown with the last
@@ -250,6 +260,9 @@ class Counters:
                 "plane_traj_slabs": self.plane_traj_slabs,
                 "plane_policy_version": self.plane_policy_version,
                 "plane_player_restarts": self.plane_player_restarts,
+                "replay_priority_updates": self.replay_priority_updates,
+                "replay_adoptions": self.replay_adoptions,
+                "replay_shard_fill": dict(self.replay_shard_fill),
                 "params_bytes_per_device": self.params_bytes_per_device,
                 "opt_state_bytes_per_device": self.opt_state_bytes_per_device,
                 "model_axis_size": self.model_axis_size,
@@ -494,6 +507,36 @@ def add_plane_player_restart(n: int = 1) -> None:
     if c is not None:
         with c._lock:
             c.plane_player_restarts += int(n)
+
+
+# -- sharded replay plane accounting -----------------------------------------
+
+
+def add_replay_priority_updates(n: int = 1) -> None:
+    """Record ``n`` priority rows rewritten by the TD-priority writeback
+    channel (sheeprl_tpu/replay/strategies.py)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.replay_priority_updates += int(n)
+
+
+def add_replay_adoption(n: int = 1) -> None:
+    """Record ``n`` slabs adopted straight into the device ring
+    (``DeviceRingTransitions.adopt_slab`` — no host-buffer hop)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.replay_adoptions += int(n)
+
+
+def set_replay_shard_fill(fills: Dict[str, float]) -> None:
+    """Record the per-shard fill gauge (fraction of ring capacity holding
+    data, keyed by shard index as a string)."""
+    c = _COUNTERS
+    if c is not None:
+        with c._lock:
+            c.replay_shard_fill.update({str(k): float(v) for k, v in fills.items()})
 
 
 def add_kernel_tier_degraded(n: int = 1) -> None:

@@ -246,6 +246,7 @@ def prometheus_text(snap: Dict[str, Any], prefix: str = "sheeprl") -> str:
         "learn",
         "serve_versions",
         "slo",
+        "replay_shard_fill",
     )
     for key, value in sorted(snap.items()):
         if key in skip:
@@ -282,6 +283,9 @@ def prometheus_text(snap: Dict[str, Any], prefix: str = "sheeprl") -> str:
     for queue, gauge in sorted((stale.get("queue_depth") or {}).items()):
         emit("queue_depth", gauge.get("last"), '{queue="%s"}' % queue)
         emit("queue_depth_max", gauge.get("max"), '{queue="%s"}' % queue)
+    # sharded replay plane (sheeprl_tpu/replay): fill fraction per host shard
+    for shard, fill in sorted((snap.get("replay_shard_fill") or {}).items()):
+        emit("replay_shard_fill", fill, '{shard="%s"}' % shard)
     lrn = snap.get("learn") or {}
     emit("learn_bursts_observed", lrn.get("bursts_observed"))
     for probe, rec in sorted((lrn.get("probes") or {}).items()):

@@ -1,0 +1,45 @@
+"""Sharded replay plane (ROADMAP item 4, howto/replay_plane.md).
+
+Multi-writer partitioned replay in the Reverb/Ape-X mold, grown from the
+repo's own pieces: plane players (PR 7) each own one single-writer host
+ring shard, a cross-shard planner draws bursts proportional to shard fill
+while preserving the PR-9 staleness lineage, sampling strategies (uniform /
+prioritize-ends / TD-priority with importance weights) are a first-class
+registry, and the single-group device ring can *adopt* slab rows straight
+to HBM (``bytes_staged_h2d`` ≈ payload, not 2×).
+
+``replay.shards=1`` with the uniform strategy is bitwise the pre-sharding
+path — :func:`make_replay_buffer` returns the plain ``ReplayBuffer`` and no
+facade is involved.
+"""
+
+from sheeprl_tpu.replay.factory import make_replay_buffer, replay_config, shard_env_split
+from sheeprl_tpu.replay.plane import ReplayPlane
+from sheeprl_tpu.replay.sharded import ShardedReplay, apportion_by_fill
+from sheeprl_tpu.replay.strategies import (
+    PrioritizeEndsStrategy,
+    SamplingStrategy,
+    TDPriorityStrategy,
+    UniformStrategy,
+    available_strategies,
+    get_strategy,
+    make_strategy,
+    register_strategy,
+)
+
+__all__ = [
+    "PrioritizeEndsStrategy",
+    "ReplayPlane",
+    "SamplingStrategy",
+    "ShardedReplay",
+    "TDPriorityStrategy",
+    "UniformStrategy",
+    "apportion_by_fill",
+    "available_strategies",
+    "get_strategy",
+    "make_replay_buffer",
+    "make_strategy",
+    "register_strategy",
+    "replay_config",
+    "shard_env_split",
+]

@@ -1,0 +1,82 @@
+"""Synthetic shard-writer entry for ``tools/bench_replay.py``.
+
+``run_writer`` speaks the full player protocol of the execution plane
+(:mod:`sheeprl_tpu.plane.worker` — acquire slab, fill rows, ``emit``) but
+generates trajectory rows synthetically with a configurable simulated
+env-step latency instead of stepping real environments. That makes the
+replay bench honest on a small host: each writer is *latency-bound* the way
+a real env fleet is (the wall time is sleeps, not compute), so running N
+writer processes measures the replay plane's ability to overlap N
+collection streams — the architecture claim — rather than raw CPU
+parallelism the host may not have.
+
+The bench harness launches this entry by dotted name
+(``sheeprl_tpu.replay.bench_writer:run_writer``) through the same
+``ProcessPlane`` supervisor the SAC learner uses, so slab transport,
+credited-slot backpressure, and respawn behavior are all the production
+code paths.
+
+Knobs (read from ``cfg.bench_replay``, all optional):
+
+- ``obs_dim`` / ``act_dim`` — synthetic row widths (defaults 8 / 2);
+- ``step_latency_s`` — simulated per-env-step latency (default 1 ms);
+- ``payload_fill`` — when true, rows carry deterministic non-zero payloads
+  (seeded per player) so adoption-parity checks can compare bytes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["bench_slab_example", "run_writer"]
+
+
+def bench_slab_example(
+    capacity: int, n_envs: int, obs_dim: int, act_dim: int
+) -> Dict[str, np.ndarray]:
+    """Example arrays fixing the synthetic trajectory-slab layout (the SAC
+    transition layout, minus next-obs — the bench samples with
+    ``sample_next_obs=True`` semantics where relevant)."""
+    return {
+        "observations": np.zeros((capacity, n_envs, obs_dim), np.float32),
+        "actions": np.zeros((capacity, n_envs, act_dim), np.float32),
+        "rewards": np.zeros((capacity, n_envs, 1), np.float32),
+        "dones": np.zeros((capacity, n_envs, 1), np.float32),
+    }
+
+
+def run_writer(ctx) -> None:
+    """Produce updates ``[ctx.start_update, num_updates]`` of synthetic
+    transition rows, one committed slab per burst, sleeping the configured
+    env-step latency per step."""
+    from sheeprl_tpu.plane.protocol import burst_plan
+
+    cfg = ctx.cfg
+    bench = dict(cfg.get("bench_replay", {}) or {})
+    n_envs = int(ctx.n_envs)
+    obs_dim = int(bench.get("obs_dim", 8))
+    act_dim = int(bench.get("act_dim", 2))
+    latency_s = float(bench.get("step_latency_s", 1e-3))
+    payload_fill = bool(bench.get("payload_fill", True))
+    rng = np.random.default_rng(int(cfg.seed) + 104729 * (int(ctx.player_idx) + 1))
+
+    update = int(ctx.start_update)
+    while update <= ctx.num_updates and not ctx.stop.is_set() and not ctx.orphaned():
+        n_act, _ = burst_plan(
+            update, ctx.act_burst, ctx.learning_starts, ctx.num_updates
+        )
+        token, views = ctx.acquire_slab()
+        for r in range(n_act):
+            if latency_s > 0:
+                time.sleep(latency_s)  # the simulated env step
+            if payload_fill:
+                views["observations"][r] = rng.random((n_envs, obs_dim), np.float32)
+                views["actions"][r] = rng.random((n_envs, act_dim), np.float32)
+                views["rewards"][r] = rng.random((n_envs, 1), np.float32)
+                views["dones"][r] = 0.0
+            ctx.beat()
+        ctx.emit(token, views, update, n_act, 0, [])
+        update += n_act

@@ -1,0 +1,203 @@
+"""The one place algo entrypoints get replay storage from.
+
+Every entrypoint used to open-code its buffer (size arithmetic, memmap
+directory layout, dreamer's sequential-vs-episode dispatch) — 16 sites with
+the same five lines. ``make_replay_buffer`` centralizes them so the sharded
+replay plane can slide under any off-policy algo without touching its loop,
+and ``tools/lint_replay.py`` can forbid raw buffer construction in
+``algos/`` wholesale.
+
+Size semantics (exactly the historical per-site arithmetic):
+
+- ``per_env=True`` (off-policy): ``cfg.buffer.size // n_envs`` rows per env
+  column, ``dry_run_size`` under ``cfg.dry_run``, floored at ``min_size``.
+- ``size=...`` (on-policy rollout storage): the caller's explicit row
+  count, still floored at ``min_size``.
+
+Sharding/strategy policy: only *sampled* transition storage
+(``kind="transition"``, ``sampled=True``) participates in the replay plane.
+``replay.shards>1`` partitions the env axis over N single-writer shards
+(``shard_envs`` gives the per-shard env counts); a non-uniform
+``replay.strategy`` wraps even a single shard in the
+:class:`~sheeprl_tpu.replay.sharded.ShardedReplay` facade so the strategy
+owns planning. ``shards=1`` + ``uniform`` returns the plain
+:class:`~sheeprl_tpu.data.buffers.ReplayBuffer` — the pre-sharding object,
+bitwise the old path. Sequence/episode storage ignores ``replay.strategy``
+with a warning (the EpisodeBuffer's own ``prioritize_ends`` flag already
+covers the episode case).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, List, Optional, Sequence, Union
+
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+from sheeprl_tpu.replay.sharded import ShardedReplay
+from sheeprl_tpu.replay.strategies import make_strategy
+
+__all__ = ["make_replay_buffer", "replay_config", "shard_env_split"]
+
+AnyReplay = Union[
+    ReplayBuffer, EpisodeBuffer, EnvIndependentReplayBuffer, ShardedReplay
+]
+
+
+def replay_config(cfg: Any) -> dict:
+    """``cfg.replay`` as a plain dict (tolerant of configs predating the
+    replay group)."""
+    try:
+        replay = cfg.get("replay", None)
+    except AttributeError:
+        replay = getattr(cfg, "replay", None)
+    return dict(replay) if replay else {}
+
+
+def shard_env_split(n_envs: int, n_shards: int) -> List[int]:
+    """Per-shard env-column counts: the env axis split as evenly as possible
+    (first ``n_envs % n_shards`` shards take one extra column) — the same
+    split ``plane_env_split`` applies to players, so player p's slab columns
+    are exactly shard p's env columns."""
+    if n_shards <= 0:
+        raise ValueError(f"'replay.shards' must be positive, got {n_shards}")
+    if n_shards > n_envs:
+        raise ValueError(
+            f"'replay.shards' ({n_shards}) cannot exceed the env count ({n_envs})"
+        )
+    base, extra = divmod(n_envs, n_shards)
+    return [base + (1 if i < extra else 0) for i in range(n_shards)]
+
+
+def _memmap_dir(log_dir: Optional[str], rank: int) -> Optional[str]:
+    if log_dir is None:
+        return None
+    return os.path.join(log_dir, "memmap_buffer", f"rank_{rank}")
+
+
+def make_replay_buffer(
+    cfg: Any,
+    fabric: Any,
+    log_dir: Optional[str],
+    *,
+    n_envs: int,
+    kind: str = "transition",
+    obs_keys: Sequence[str] = ("observations",),
+    per_env: bool = True,
+    size: Optional[int] = None,
+    min_size: int = 1,
+    dry_run_size: Optional[int] = None,
+    sequence_length: Optional[int] = None,
+    sampled: bool = True,
+    shards: Optional[int] = None,
+) -> AnyReplay:
+    """Build the replay storage an entrypoint needs (see module docstring)."""
+    if size is not None:
+        base = int(size)
+    elif bool(cfg.dry_run) and dry_run_size is not None:
+        base = int(dry_run_size)
+    else:
+        base = int(cfg.buffer.size) // n_envs if per_env else int(cfg.buffer.size)
+    buffer_size = max(base, int(min_size))
+    memmap = bool(cfg.buffer.memmap)
+    memmap_dir = _memmap_dir(log_dir, int(fabric.global_rank))
+    replay_cfg = replay_config(cfg)
+    strategy_name = str(replay_cfg.get("strategy", "uniform") or "uniform")
+    if shards is None:
+        # callers that pre-validate (the decoupled plane: shards must equal
+        # num_players) pass shards explicitly; everyone else takes the config.
+        # Rollout storage never participates in the replay plane, so a
+        # configured shard count does not apply to it.
+        shards = int(replay_cfg.get("shards", 1) or 1) if sampled else 1
+    shards = int(shards)
+
+    if kind == "dreamer":
+        # dreamer_v2's historical cfg.buffer.type dispatch, error text intact
+        buffer_type = str(cfg.buffer.get("type", "sequential")).lower()
+        if buffer_type == "sequential":
+            kind = "sequential"
+        elif buffer_type == "episode":
+            kind = "episode"
+        else:
+            raise ValueError(
+                f"Unrecognized buffer type: must be one of `sequential` or `episode`, "
+                f"received: {buffer_type}"
+            )
+
+    if kind in ("sequential", "episode") or not sampled:
+        if sampled and strategy_name != "uniform":
+            warnings.warn(
+                f"replay.strategy={strategy_name!r} only applies to transition replay; "
+                f"{kind!r} storage keeps uniform sampling "
+                "(episode storage has its own buffer.prioritize_ends flag)",
+                stacklevel=2,
+            )
+        if shards != 1:
+            raise ValueError(
+                f"replay.shards={shards} is only supported for sampled transition "
+                f"replay, not {kind!r} storage"
+            )
+
+    if kind == "sequential":
+        return EnvIndependentReplayBuffer(
+            buffer_size,
+            n_envs=n_envs,
+            obs_keys=obs_keys,
+            memmap=memmap,
+            memmap_dir=memmap_dir,
+            buffer_cls=SequentialReplayBuffer,
+        )
+    if kind == "episode":
+        if sequence_length is None:
+            raise ValueError("episode replay needs a 'sequence_length'")
+        # historical episode sizing floors at the sequence length alone
+        # (never min_size — that floor belongs to the sequential branch)
+        return EpisodeBuffer(
+            max(base, int(sequence_length)),
+            sequence_length=int(sequence_length),
+            n_envs=n_envs,
+            obs_keys=obs_keys,
+            prioritize_ends=bool(cfg.buffer.get("prioritize_ends", False)),
+            memmap=memmap,
+            memmap_dir=memmap_dir,
+        )
+    if kind != "transition":
+        raise ValueError(
+            f"Unknown replay kind {kind!r}: must be one of "
+            "`transition`, `sequential`, `episode`, or `dreamer`"
+        )
+
+    if not sampled or (shards == 1 and strategy_name == "uniform"):
+        # the pre-sharding object — rollout storage, or the bitwise
+        # single-shard uniform path
+        return ReplayBuffer(
+            buffer_size,
+            n_envs,
+            obs_keys=obs_keys,
+            memmap=memmap,
+            memmap_dir=memmap_dir,
+        )
+
+    env_counts = shard_env_split(n_envs, shards)
+    shard_bufs = []
+    for p, shard_envs in enumerate(env_counts):
+        shard_dir = (
+            os.path.join(memmap_dir, f"shard_{p}")
+            if (memmap_dir is not None and shards > 1)
+            else memmap_dir
+        )
+        shard_bufs.append(
+            ReplayBuffer(
+                buffer_size,
+                shard_envs,
+                obs_keys=obs_keys,
+                memmap=memmap,
+                memmap_dir=shard_dir,
+            )
+        )
+    return ShardedReplay(shard_bufs, strategy=make_strategy(replay_cfg))

@@ -1,0 +1,140 @@
+"""The replay plane's ingest bridge: plane players → partitioned shards.
+
+:class:`ReplayPlane` composes an already-built execution plane
+(:class:`~sheeprl_tpu.plane.supervisor.ProcessPlane` or ``LocalPlane``) with
+a :class:`~sheeprl_tpu.replay.sharded.ShardedReplay` whose shard partition
+mirrors the plane's env split — player ``p``'s slab columns are exactly
+shard ``p``'s env columns (``shard_env_split`` == ``plane_env_split`` when
+``replay.shards == plane.num_players``). That makes every shard
+single-writer by construction: slabs from player ``p`` only ever land in
+shard ``p``, so ingest needs no cross-shard coordination and the learner's
+``concatenate``-then-``add`` full-width copy disappears.
+
+Ingest also carries the PR-9 staleness lineage *per shard*: each player's
+slab commit stamp is re-armed through
+:func:`~sheeprl_tpu.obs.dist.staleness.stamp_next_add` right before that
+shard's ``add``, so sample ages are measured from each shard's own
+collection time instead of whichever handle happened to be received last
+(the single-buffer path's last-stamp-wins behavior).
+
+Writer-restart observability: the supervisor already fires a
+``plane_player_restart`` flight trigger and counter when it respawns a
+player. When that player is a replay *writer* (a shard owner), losing it
+also stalls a shard's fill, so :meth:`ReplayPlane.ingest` watches the
+plane's restart ledger and fires a ``replay_writer_restart`` flight trigger
+carrying the shard's fill at the moment of loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.obs import get_telemetry
+from sheeprl_tpu.obs.dist import staleness as _staleness
+
+__all__ = ["ReplayPlane"]
+
+
+class ReplayPlane:
+    """Route per-player trajectory slabs into per-player replay shards.
+
+    Wraps an already-built plane object (anything with ``recv``/``n_players``)
+    and a :class:`~sheeprl_tpu.replay.sharded.ShardedReplay` with
+    ``n_shards == plane.n_players``. The learner calls :meth:`ingest` once
+    per burst with the received handles; rows go straight from each slab
+    view into its owning shard (one copy per shard, no full-width
+    concatenation), newest rows are priority-initialized when the sampling
+    strategy needs writeback (the Ape-X "insert at max priority" commit
+    channel), and handles are released.
+    """
+
+    def __init__(self, plane: Any, sharded: Any):
+        n_players = int(getattr(plane, "n_players", 1))
+        n_shards = int(sharded.n_shards)
+        if n_players != n_shards:
+            raise ValueError(
+                f"replay.shards ({n_shards}) must equal plane.num_players "
+                f"({n_players}) so each player process owns exactly one shard"
+            )
+        self._plane = plane
+        self._sharded = sharded
+        # ProcessPlane keeps a per-player respawn ledger; LocalPlane (thread
+        # mode) has none and never restarts
+        self._restarts_seen: Optional[List[int]] = (
+            list(getattr(plane, "_restarts"))
+            if hasattr(plane, "_restarts")
+            else None
+        )
+
+    @property
+    def plane(self) -> Any:
+        return self._plane
+
+    @property
+    def sharded(self) -> Any:
+        return self._sharded
+
+    @property
+    def n_players(self) -> int:
+        return int(getattr(self._plane, "n_players", 1))
+
+    def recv(self, update: int) -> List[Any]:
+        """One burst's handles, in player order (delegates to the plane)."""
+        return [self._plane.recv(p, update) for p in range(self.n_players)]
+
+    def ingest(
+        self, handles: Sequence[Any], n_act: int
+    ) -> List[Tuple[float, int]]:
+        """Land one burst of slab handles into their shards.
+
+        For each player ``p``: arm the staleness clock with that slab's
+        commit stamp, add rows ``[:n_act]`` to shard ``p``, initialize the
+        newest rows at max priority when the strategy tracks priorities,
+        and release the handle. Returns the merged episode stats in player
+        order (the same list the single-buffer path assembled)."""
+        if len(handles) != self._sharded.n_shards:
+            raise ValueError(
+                f"got {len(handles)} slab handles for "
+                f"{self._sharded.n_shards} shards"
+            )
+        n_act = int(n_act)
+        needs_writeback = self._sharded.needs_writeback
+        ep_stats: List[Tuple[float, int]] = []
+        for p, h in enumerate(handles):
+            commit_ts = float(getattr(h, "commit_ts", 0.0) or 0.0)
+            if commit_ts:
+                # per-shard stamp — each shard's rows age from their own
+                # collection time (recv's burst-level stamp covered only
+                # the last handle received)
+                _staleness.stamp_next_add(commit_ts)
+            rows = {k: v[:n_act] for k, v in h.data.items()}
+            self._sharded.add_shard(p, rows)
+            if needs_writeback:
+                self._sharded.init_priorities_newest(p, n_act)
+            ep_stats.extend(h.ep_stats)
+            h.release()
+        self._observe_restarts()
+        return ep_stats
+
+    def _observe_restarts(self) -> None:
+        """Fire a ``replay_writer_restart`` flight trigger for any shard
+        writer the supervisor respawned since the last ingest."""
+        ledger = getattr(self._plane, "_restarts", None)
+        if ledger is None or self._restarts_seen is None:
+            return
+        for p, count in enumerate(ledger):
+            if p < len(self._restarts_seen) and count > self._restarts_seen[p]:
+                self._restarts_seen[p] = int(count)
+                telemetry = get_telemetry()
+                if telemetry is not None and telemetry.flight is not None:
+                    fills = self._sharded.fills()
+                    telemetry.flight.trigger(
+                        "replay_writer_restart",
+                        {
+                            "shard": p,
+                            "restart": int(count),
+                            "shard_fill": float(fills[p]) if p < len(fills) else 0.0,
+                        },
+                    )

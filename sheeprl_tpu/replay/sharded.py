@@ -1,0 +1,322 @@
+"""Partitioned host replay: N single-writer ring shards behind one facade.
+
+The Reverb shape (Cassirer et al., 2021) on one host: each plane player
+owns a shard (a plain :class:`~sheeprl_tpu.data.buffers.ReplayBuffer` whose
+env columns are that player's env slice), so writers never contend on a
+ring position, and the learner samples across shards through a single
+facade that keeps the ``ReplayBuffer`` surface (``add`` / ``sample`` /
+``seed`` / ``state_dict`` / ``bind_write_lock`` / ``to_device`` via the
+staging facade).
+
+Cross-shard planning: a burst of ``total`` rows is apportioned over shards
+**proportional to shard fill** (valid rows × env columns) with
+largest-remainder rounding — deterministic, no rng draw — then each shard's
+slice is planned by the active :class:`~sheeprl_tpu.replay.strategies.
+SamplingStrategy` *at the shard's own plan chokepoint* (staleness ages
+observed per shard, PR-9 lineage intact) and the gathered rows are
+interleaved by a facade-rng permutation so no gradient step in a multi-step
+burst sees a shard-contiguous block.
+
+Determinism contract: ``shards=1`` with the uniform strategy never
+constructs this facade at all (``make_replay_buffer`` returns the plain
+buffer), so the single-shard path is bitwise the pre-sharding code by
+construction. When a facade IS constructed with one shard (a non-uniform
+strategy), ``seed(s)`` still seeds shard 0 with ``s`` itself; with N
+shards, shard ``i`` gets ``s + i`` and the facade's interleave rng gets
+``s + n_shards`` (the ``EnvIndependentReplayBuffer`` offset idiom, so no
+two streams share a seed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs.counters import set_replay_shard_fill
+from sheeprl_tpu.replay.strategies import SamplingStrategy, UniformStrategy
+
+__all__ = ["ShardedReplay", "apportion_by_fill"]
+
+
+def apportion_by_fill(total: int, weights: Sequence[float]) -> List[int]:
+    """Split ``total`` draws proportional to ``weights`` (largest-remainder
+    rounding, ties to the lowest index) — deterministic so the cross-shard
+    plan consumes no rng. Zero-weight entries get nothing."""
+    weights = [max(float(w), 0.0) for w in weights]
+    wsum = sum(weights)
+    if total <= 0:
+        return [0] * len(weights)
+    if wsum <= 0.0:
+        raise ValueError("No shard holds data to sample from")
+    quotas = [total * w / wsum for w in weights]
+    counts = [int(q) for q in quotas]
+    short = total - sum(counts)
+    # hand the leftover draws to the largest fractional remainders
+    order = sorted(range(len(weights)), key=lambda i: (-(quotas[i] - counts[i]), i))
+    for i in order[:short]:
+        counts[i] += 1
+    return counts
+
+
+class ShardedReplay:
+    """Facade over N single-writer replay shards with strategy sampling."""
+
+    def __init__(
+        self,
+        shards: Sequence[ReplayBuffer],
+        strategy: Optional[SamplingStrategy] = None,
+    ):
+        if not shards:
+            raise ValueError("ShardedReplay needs at least one shard")
+        self._shards: List[ReplayBuffer] = list(shards)
+        self._strategy: SamplingStrategy = strategy or UniformStrategy()
+        self._rng: np.random.Generator = np.random.default_rng()
+        # env-column offsets of each shard inside the global env axis
+        self._env_offsets = np.cumsum([0] + [s.n_envs for s in self._shards])
+        # last cross-shard plan in OUTPUT row order: (shard, t_idx, e_idx)
+        self._last_plan: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+        self._last_weights: Optional[np.ndarray] = None
+
+    # -- surface parity with ReplayBuffer ---------------------------------
+
+    @property
+    def shards(self) -> List[ReplayBuffer]:
+        return self._shards
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._shards)
+
+    @property
+    def strategy(self) -> SamplingStrategy:
+        return self._strategy
+
+    @property
+    def needs_writeback(self) -> bool:
+        return self._strategy.needs_writeback
+
+    @property
+    def buffer_size(self) -> int:
+        return sum(s.buffer_size for s in self._shards)
+
+    @property
+    def n_envs(self) -> int:
+        return sum(s.n_envs for s in self._shards)
+
+    @property
+    def full(self) -> bool:
+        return all(s.full for s in self._shards)
+
+    @property
+    def empty(self) -> bool:
+        return all(s.empty for s in self._shards)
+
+    @property
+    def is_memmap(self) -> bool:
+        return all(s.is_memmap for s in self._shards)
+
+    def __len__(self) -> int:
+        return self.buffer_size
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        n = len(self._shards)
+        if n == 1:
+            # single shard: the shard IS the old single buffer — same seed,
+            # and the facade's interleave rng is never consulted (n==1 plans
+            # skip the permutation entirely)
+            self._shards[0].seed(seed)
+            self._rng = np.random.default_rng(None if seed is None else seed + 1)
+            return
+        self._rng = np.random.default_rng(None if seed is None else seed + n)
+        for i, s in enumerate(self._shards):
+            s.seed(None if seed is None else seed + i)
+
+    def bind_write_lock(self, lock: Any) -> None:
+        for s in self._shards:
+            s.bind_write_lock(lock)
+
+    # -- ingest ------------------------------------------------------------
+
+    def shard_for_env(self, env: int) -> Tuple[int, int]:
+        """(shard index, local env column) of a global env column."""
+        p = int(np.searchsorted(self._env_offsets, env, side="right")) - 1
+        if p < 0 or p >= len(self._shards):
+            raise ValueError(f"env column {env} outside [0, {self.n_envs})")
+        return p, env - int(self._env_offsets[p])
+
+    def add_shard(self, shard: int, data: Dict[str, np.ndarray], **kwargs: Any) -> None:
+        """Route one writer's ``[T, shard_envs, ...]`` rows into its shard —
+        the single-writer ingest path the replay plane uses (one plane
+        player per shard, no cross-writer contention)."""
+        self._shards[shard].add(data, **kwargs)
+        if len(self._shards) > 1:
+            new = self._shards[shard]
+            fill = 1.0 if new.full else new._pos / new.buffer_size
+            set_replay_shard_fill({str(shard): fill})
+
+    def add(self, data: Dict[str, np.ndarray], validate_args: bool = False) -> None:
+        """Whole-fleet ``[T, n_envs, ...]`` insert, split along the env axis
+        by shard ownership (coupled single-collector algos)."""
+        for p in range(len(self._shards)):
+            lo, hi = int(self._env_offsets[p]), int(self._env_offsets[p + 1])
+            self.add_shard(p, {k: np.asarray(v)[:, lo:hi] for k, v in data.items()},
+                           validate_args=validate_args)
+
+    def fills(self) -> List[float]:
+        """Per-shard fill fraction (1.0 once a shard's ring has wrapped)."""
+        out = []
+        for s in self._shards:
+            out.append(1.0 if s.full else (0.0 if s.empty else s._pos / s.buffer_size))
+        return out
+
+    def init_priorities_newest(self, shard: int, steps: int) -> None:
+        """Mark the ``steps`` newest rows of ``shard`` max-priority — called
+        by the replay plane right after an ingest so fresh transitions are
+        sampled soon (the Ape-X commit-channel behavior)."""
+        s = self._shards[shard]
+        if steps <= 0:
+            return
+        write_len = min(int(steps), s.buffer_size)
+        start = s._pos - write_len
+        t_idx = np.arange(start, start + write_len) % s.buffer_size
+        self._strategy.init_priorities(s, t_idx)
+
+    # -- sampling ----------------------------------------------------------
+
+    def _shard_weights(self, sample_next_obs: bool) -> List[float]:
+        out = []
+        for s in self._shards:
+            if s.empty or (not s.full and s._pos == 0):
+                out.append(0.0)
+            else:
+                out.append(len(s.valid_time_indices(sample_next_obs)) * float(s.n_envs))
+        return out
+
+    def plan_burst(
+        self, total: int, sample_next_obs: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Plan ``total`` rows across shards: fill-proportional apportionment,
+        per-shard strategy plan (staleness observed at each shard's
+        chokepoint), facade-rng interleave. Returns output-ordered
+        ``(shard_ids, t_idx, e_idx)`` with e_idx LOCAL to each shard."""
+        weights = self._shard_weights(sample_next_obs)
+        counts = apportion_by_fill(total, weights)
+        weighted = self._strategy.needs_writeback
+        shard_ids = np.empty(total, np.int64)
+        t_all = np.empty(total, np.int64)
+        e_all = np.empty(total, np.int64)
+        w_all = np.empty(total, np.float64) if weighted else None
+        cursor = 0
+        for p, count in enumerate(counts):
+            if count == 0:
+                continue
+            t_idx, e_idx = self._strategy.plan(
+                self._shards[p], count, sample_next_obs=sample_next_obs, n_samples=1
+            )
+            shard_ids[cursor : cursor + count] = p
+            t_all[cursor : cursor + count] = t_idx
+            e_all[cursor : cursor + count] = e_idx
+            if weighted:
+                # raw (unnormalized) importance weights, captured in this
+                # shard's plan order so the permutation below keeps them
+                # aligned row-for-row with the plan
+                w_all[cursor : cursor + count] = self._strategy.weights(
+                    self._shards[p], normalize=False
+                )
+            cursor += count
+        if len(self._shards) > 1:
+            perm = self._rng.permutation(total)
+            shard_ids, t_all, e_all = shard_ids[perm], t_all[perm], e_all[perm]
+            if weighted:
+                w_all = w_all[perm]
+        # normalize by the GLOBAL max so shards with different priority
+        # scales stay comparable
+        self._last_weights = (w_all / w_all.max()) if weighted else None
+        return shard_ids, t_all, e_all
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        """``[n_samples, batch_size, ...]`` rows drawn across shards."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        total = batch_size * n_samples
+        shard_ids, t_all, e_all = self.plan_burst(total, sample_next_obs)
+        self._last_plan = (shard_ids, t_all, e_all)
+        parts: Dict[str, np.ndarray] = {}
+        for p in range(len(self._shards)):
+            mask = shard_ids == p
+            if not mask.any():
+                continue
+            rows = self._shards[p].gather_plan(
+                t_all[mask], e_all[mask], sample_next_obs=sample_next_obs, clone=False
+            )
+            for k, v in rows.items():
+                if k not in parts:
+                    parts[k] = np.empty((total,) + v.shape[1:], v.dtype)
+                parts[k][mask] = v
+        return {
+            k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in parts.items()
+        }
+
+    def last_weights(self) -> Optional[np.ndarray]:
+        """Importance weights aligned with the last sampled burst's flat row
+        order (``None`` for unweighted strategies)."""
+        return self._last_weights
+
+    def update_priorities(self, td_errors: np.ndarray) -> None:
+        """Write the last burst's TD errors back through the strategy, routed
+        to each row's owning shard (flat row order of the last plan)."""
+        if self._last_plan is None:
+            raise RuntimeError("update_priorities called before any sample")
+        shard_ids, t_all, e_all = self._last_plan
+        td = np.asarray(td_errors).reshape(-1)
+        if len(td) != len(shard_ids):
+            raise ValueError(
+                f"td_errors has {len(td)} rows but the last plan drew {len(shard_ids)}"
+            )
+        for p in range(len(self._shards)):
+            mask = shard_ids == p
+            if mask.any():
+                self._strategy.update_priorities(
+                    self._shards[p], t_all[mask], e_all[mask], td[mask]
+                )
+
+    def sample_tensors(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        dtype: Optional[Any] = None,
+        device: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        from sheeprl_tpu.data.buffers import to_device
+
+        batch = self.sample(batch_size, sample_next_obs, clone, n_samples, **kwargs)
+        return to_device(batch, dtype=dtype, device=device)
+
+    # -- checkpointing ------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"shards": [s.state_dict() for s in self._shards]}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        saved = state["shards"]
+        if len(saved) != len(self._shards):
+            raise ValueError(
+                f"Checkpoint has {len(saved)} replay shards but the run is configured "
+                f"with {len(self._shards)} — replay.shards must match to resume"
+            )
+        for s, sd in zip(self._shards, saved):
+            s.load_state_dict(sd)

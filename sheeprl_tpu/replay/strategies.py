@@ -1,0 +1,297 @@
+"""First-class replay sampling strategies (the Ape-X ingredient).
+
+A :class:`SamplingStrategy` owns the *plan* step of a replay draw — which
+``(t_idx, e_idx)`` pairs a burst reads — while the buffers keep owning
+storage, gather, and the valid-window semantics. Strategies are registered
+by name (:func:`register_strategy` / :func:`get_strategy`) and selected via
+``cfg.replay.strategy``:
+
+- ``uniform`` — delegates straight to ``ReplayBuffer.plan_transitions``:
+  byte-for-byte the single-buffer planner, consuming the buffer's own rng
+  stream in the same order (the ``replay.shards=1`` bitwise gate rides on
+  this).
+- ``prioritize_ends`` — the ``EpisodeBuffer`` end-bias generalized to flat
+  transition rings: draw an offset uniformly over the age-ordered valid
+  window and clamp it to the last valid start
+  (:func:`sheeprl_tpu.data.buffers.end_biased_start` — the *same* function
+  the EpisodeBuffer draw calls), so recent rows are over-sampled exactly the
+  way episode tails are.
+- ``td_priority`` — proportional prioritized replay (Schaul et al., 2016,
+  as deployed by Ape-X): sampling probability ``p_i^alpha / sum p^alpha``
+  with ``p_i = |td_i| + eps``, importance weights ``(N * P_i)^-beta``
+  normalized by their max, and a post-train writeback channel
+  (:meth:`TDPriorityStrategy.update_priorities`) that re-scores the rows
+  the last plan drew. Unseen rows carry the running max priority so every
+  transition is sampled at least once with high probability.
+
+Every strategy observes the drawn rows' ages at the plan chokepoint
+(``rb.observe_sample_ages`` — for uniform this happens inside
+``plan_transitions``), preserving the PR-9 staleness lineage no matter how
+the plan was produced.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from sheeprl_tpu.data.buffers import ReplayBuffer, end_biased_start
+from sheeprl_tpu.obs.counters import add_replay_priority_updates
+
+__all__ = [
+    "SamplingStrategy",
+    "UniformStrategy",
+    "PrioritizeEndsStrategy",
+    "TDPriorityStrategy",
+    "available_strategies",
+    "get_strategy",
+    "make_strategy",
+    "register_strategy",
+]
+
+_REGISTRY: Dict[str, Type["SamplingStrategy"]] = {}
+
+
+def register_strategy(name: str):
+    """Class decorator registering a strategy under ``name``."""
+
+    def deco(cls: Type["SamplingStrategy"]) -> Type["SamplingStrategy"]:
+        cls.name = name
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def available_strategies() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def get_strategy(name: str) -> Type["SamplingStrategy"]:
+    try:
+        return _REGISTRY[str(name)]
+    except KeyError:
+        raise ValueError(
+            f"Unknown replay sampling strategy {name!r}: must be one of {sorted(_REGISTRY)}"
+        ) from None
+
+
+def make_strategy(replay_cfg: Optional[Dict[str, Any]]) -> "SamplingStrategy":
+    """Build the strategy ``cfg.replay`` names (defaults to ``uniform``)."""
+    replay_cfg = replay_cfg or {}
+    name = str(replay_cfg.get("strategy", "uniform") or "uniform")
+    cls = get_strategy(name)
+    if name == "td_priority":
+        prio = replay_cfg.get("priority", {}) or {}
+        return cls(
+            alpha=float(prio.get("alpha", 0.6)),
+            beta=float(prio.get("beta", 0.4)),
+            eps=float(prio.get("eps", 1e-6)),
+        )
+    return cls()
+
+
+def _plan_envs(
+    rng: np.random.Generator, n_envs: int, envs: Optional[Sequence[int]], total: int
+) -> np.ndarray:
+    """The env-column draw shared with ``plan_transitions`` (same order:
+    time indices first, env columns second, off one rng stream)."""
+    if envs is None:
+        return rng.integers(0, n_envs, size=total)
+    envs_arr = np.asarray(envs, dtype=np.int64)
+    return envs_arr[rng.integers(0, len(envs_arr), size=total)]
+
+
+class SamplingStrategy:
+    """Plans which rows a replay burst reads; stateless unless prioritized."""
+
+    name = "base"
+    #: True when the training loop must write updated priorities back after
+    #: each gradient burst — the staging facade then disables prefetch so the
+    #: last plan always corresponds to the batch just trained on
+    needs_writeback = False
+
+    def plan(
+        self,
+        rb: ReplayBuffer,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+        rng: Optional[np.random.Generator] = None,
+        envs: Optional[Sequence[int]] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    def weights(self, rb: ReplayBuffer, normalize: bool = True) -> Optional[np.ndarray]:
+        """Importance weights aligned with ``rb``'s last plan (None when the
+        strategy is unweighted). ``normalize=False`` returns the raw
+        ``(N * P)^-beta`` values so a cross-shard caller can normalize by the
+        global max instead of each shard's own."""
+        return None
+
+    def update_priorities(
+        self, rb: ReplayBuffer, t_idx: np.ndarray, e_idx: np.ndarray, td_errors: np.ndarray
+    ) -> None:
+        """Post-train priority writeback (no-op unless prioritized)."""
+
+    def init_priorities(self, rb: ReplayBuffer, t_idx: np.ndarray) -> None:
+        """Mark freshly ingested time rows max-priority (no-op unless
+        prioritized) — the commit-channel hook the replay plane calls after
+        routing a slab into a shard."""
+
+
+@register_strategy("uniform")
+class UniformStrategy(SamplingStrategy):
+    """Delegates to the buffer's own uniform planner — bitwise the current
+    single-buffer path (same rng stream, same draw order)."""
+
+    def plan(self, rb, batch_size, sample_next_obs=False, n_samples=1, rng=None, envs=None):
+        return rb.plan_transitions(
+            batch_size, sample_next_obs=sample_next_obs, n_samples=n_samples, rng=rng, envs=envs
+        )
+
+
+@register_strategy("prioritize_ends")
+class PrioritizeEndsStrategy(SamplingStrategy):
+    """EpisodeBuffer's end bias over a flat ring's age-ordered window.
+
+    The EpisodeBuffer draw picks a window start uniformly over the *whole*
+    episode and clamps to the last valid start, piling the tail's mass onto
+    the newest eligible position. Here the "episode" is the ring's full
+    age-ordered valid window: offsets draw via the identical
+    :func:`end_biased_start` with ``length = len(window incl. the
+    successor-less newest row)`` and ``upper = length - effective`` where
+    ``effective = 1 + (1 if sample_next_obs else 0)`` — a transition is a
+    length-1 sequence, plus its stored successor when requested.
+    """
+
+    def plan(self, rb, batch_size, sample_next_obs=False, n_samples=1, rng=None, envs=None):
+        rng = rb._rng if rng is None else rng
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        if rb.empty or (not rb.full and rb._pos == 0):
+            raise ValueError("No sample has been added to the buffer")
+        # ordered over the FULL window (successor-less newest row included:
+        # it is the clamped tail, like the last steps of an episode)
+        ordered = rb.age_ordered_time_indices(sample_next_obs=False)
+        length = len(ordered)
+        effective = 1 + (1 if sample_next_obs else 0)
+        upper = length - effective
+        if upper < 0:
+            raise RuntimeError(
+                "You want to sample the next observations, but only one sample has been "
+                "added to the buffer. Make sure that at least two samples are added."
+            )
+        total = batch_size * n_samples
+        raw = rng.integers(0, length, size=total)
+        t_idx = ordered[np.minimum(raw, upper)]
+        e_idx = _plan_envs(rng, rb.n_envs, envs, total)
+        rb.observe_sample_ages(t_idx)
+        return t_idx, e_idx
+
+
+@register_strategy("td_priority")
+class TDPriorityStrategy(SamplingStrategy):
+    """Proportional TD-error prioritization with importance weights.
+
+    Per-buffer state (the ``[size, n_envs]`` priority table and the running
+    max) is keyed on the buffer instance, so one strategy object serves
+    every shard of a :class:`~sheeprl_tpu.replay.sharded.ShardedReplay`
+    without the shards sharing priorities.
+    """
+
+    needs_writeback = True
+
+    def __init__(self, alpha: float = 0.6, beta: float = 0.4, eps: float = 1e-6):
+        if not 0.0 <= alpha:
+            raise ValueError(f"'alpha' must be non-negative, got {alpha}")
+        if not 0.0 <= beta:
+            raise ValueError(f"'beta' must be non-negative, got {beta}")
+        if eps <= 0.0:
+            raise ValueError(f"'eps' must be positive, got {eps}")
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.eps = float(eps)
+        # id(rb) -> (rb, priority table, running max); the strong buffer ref
+        # pins the id so it cannot be recycled under us
+        self._state: Dict[int, Tuple[ReplayBuffer, np.ndarray, float]] = {}
+        # id(rb) -> (t_idx, e_idx, P_i, n_valid) of the last plan — what
+        # weights() aligns with and update_priorities() falls back to
+        self._last: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray, int]] = {}
+
+    def _table(self, rb: ReplayBuffer) -> np.ndarray:
+        key = id(rb)
+        if key not in self._state:
+            self._state[key] = (rb, np.zeros((rb.buffer_size, rb.n_envs), np.float64), 1.0)
+        return self._state[key][1]
+
+    def _max_prio(self, rb: ReplayBuffer) -> float:
+        self._table(rb)
+        return self._state[id(rb)][2]
+
+    def plan(self, rb, batch_size, sample_next_obs=False, n_samples=1, rng=None, envs=None):
+        rng = rb._rng if rng is None else rng
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        if rb.empty or (not rb.full and rb._pos == 0):
+            raise ValueError("No sample has been added to the buffer")
+        valid = rb.valid_time_indices(sample_next_obs)
+        if len(valid) == 0:
+            raise RuntimeError(
+                "You want to sample the next observations, but only one sample has been "
+                "added to the buffer. Make sure that at least two samples are added."
+            )
+        table = self._table(rb)
+        env_cols = (
+            np.arange(rb.n_envs, dtype=np.int64)
+            if envs is None
+            else np.asarray(envs, dtype=np.int64)
+        )
+        prio = table[np.ix_(valid, env_cols)]  # [L, E]
+        prio = np.where(prio > 0.0, prio, self._max_prio(rb))
+        scaled = prio.ravel() ** self.alpha
+        probs = scaled / scaled.sum()
+        total = batch_size * n_samples
+        n_cols = len(env_cols)
+        flat = rng.choice(len(probs), size=total, p=probs)
+        t_idx = valid[flat // n_cols]
+        e_idx = env_cols[flat % n_cols]
+        self._last[id(rb)] = (t_idx, e_idx, probs[flat], len(probs))
+        rb.observe_sample_ages(t_idx)
+        return t_idx, e_idx
+
+    def weights(self, rb, normalize=True):
+        last = self._last.get(id(rb))
+        if last is None:
+            return None
+        _, _, p_sel, n_valid = last
+        w = (n_valid * p_sel) ** (-self.beta)
+        return w / w.max() if normalize else w
+
+    def update_priorities(self, rb, t_idx, e_idx, td_errors):
+        t_idx = np.asarray(t_idx, dtype=np.int64).reshape(-1)
+        e_idx = np.asarray(e_idx, dtype=np.int64).reshape(-1)
+        td = np.abs(np.asarray(td_errors, dtype=np.float64).reshape(-1)) + self.eps
+        if not (len(t_idx) == len(e_idx) == len(td)):
+            raise ValueError(
+                f"Priority writeback shapes disagree: {len(t_idx)} rows, "
+                f"{len(e_idx)} env columns, {len(td)} td errors"
+            )
+        table = self._table(rb)
+        table[t_idx, e_idx] = td
+        key = id(rb)
+        rb_ref, tbl, max_prio = self._state[key]
+        self._state[key] = (rb_ref, tbl, max(max_prio, float(td.max())) if len(td) else max_prio)
+        add_replay_priority_updates(len(td))
+
+    def init_priorities(self, rb, t_idx):
+        t_idx = np.asarray(t_idx, dtype=np.int64).reshape(-1)
+        if len(t_idx) == 0:
+            return
+        table = self._table(rb)
+        table[t_idx, :] = self._max_prio(rb)

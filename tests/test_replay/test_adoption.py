@@ -1,0 +1,133 @@
+"""Zero-dispatch slab adoption: ``adopt_slab`` must land exactly the rows a
+host ``add`` + flush would have landed (seeded bitwise parity) while staging
+only the payload bytes — not the copy path's power-of-two padded upload
+(sheeprl_tpu/data/device_ring.py, sheeprl_tpu/data/staging.py)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_ring import DeviceRingTransitions
+from sheeprl_tpu.data.staging import HostStaging, RingStaging
+from sheeprl_tpu.obs import counters as obs_counters
+
+
+def _slab(steps, n_envs, obs_dim=3, start=0):
+    """[T, n_envs, ...] trajectory rows, value-coded by step."""
+    t = np.arange(start, start + steps, dtype=np.float32)
+    return {
+        "observations": np.tile(t[:, None, None], (1, n_envs, obs_dim)),
+        "next_observations": np.tile(t[:, None, None] + 1, (1, n_envs, obs_dim)),
+        "actions": np.tile(-t[:, None, None], (1, n_envs, 2)),
+        "rewards": t[:, None, None].repeat(n_envs, axis=1),
+        "dones": np.zeros((steps, n_envs, 1), np.float32),
+    }
+
+
+def _ring(size=16, n_envs=2, seed=0):
+    host = ReplayBuffer(size, n_envs, obs_keys=("observations",))
+    return DeviceRingTransitions(host, seed=seed)
+
+
+def _assert_same_samples(ring_a, ring_b, seed=5, batch=8, n_samples=2):
+    ring_a.seed(seed)
+    ring_b.seed(seed)
+    got_a = ring_a.sample_device(batch, n_samples=n_samples)
+    got_b = ring_b.sample_device(batch, n_samples=n_samples)
+    assert set(got_a) == set(got_b)
+    for k in got_a:
+        np.testing.assert_array_equal(
+            np.asarray(got_a[k]), np.asarray(got_b[k]), err_msg=k
+        )
+
+
+def test_adopt_slab_bitwise_matches_copy_path():
+    """Same slab, two routes into HBM: slab → host rb → ring (add+flush) vs
+    slab → HBM (adopt). Seeded sampling must be indistinguishable."""
+    ring_copy, ring_adopt = _ring(), _ring()
+    slab = _slab(6, 2)
+    ring_copy.add(slab)
+    ring_adopt.adopt_slab(slab)
+    assert ring_copy.host._pos == ring_adopt.host._pos == 6
+    _assert_same_samples(ring_copy, ring_adopt)
+
+
+def test_adopt_slab_partial_rows():
+    """``n_valid`` adopts only a slab's filled prefix — the plane's partial
+    final bursts."""
+    ring_copy, ring_adopt = _ring(), _ring()
+    slab = _slab(8, 2)
+    ring_copy.add({k: v[:5] for k, v in slab.items()})
+    ring_adopt.adopt_slab(slab, n_valid=5)
+    assert ring_adopt.host._pos == 5
+    _assert_same_samples(ring_copy, ring_adopt)
+
+
+def test_adopt_slab_wraps_ring_boundary():
+    ring_copy, ring_adopt = _ring(size=8), _ring(size=8)
+    first = _slab(6, 2)
+    ring_copy.add(first)
+    ring_adopt.adopt_slab(first)
+    second = _slab(5, 2, start=6)  # 6+5 wraps an 8-row ring
+    ring_copy.add(second)
+    ring_adopt.adopt_slab(second)
+    assert ring_copy.host.full and ring_adopt.host.full
+    _assert_same_samples(ring_copy, ring_adopt)
+
+
+def test_adopt_slab_bytes_are_payload_not_padded():
+    """The whole point: an adopted burst stages payload + index bytes, while
+    the copy path's flush pads rows to a power of two — strictly more."""
+    slab = _slab(6, 2)  # 6 rows: the flush pads to 8
+    payload_bytes = sum(np.ascontiguousarray(v).nbytes for v in slab.values())
+    idx_bytes = np.arange(6, dtype=np.int32).nbytes
+
+    c = obs_counters.Counters()
+    obs_counters.install(c)
+    try:
+        ring_adopt = _ring()
+        adopted = ring_adopt.adopt_slab(slab)
+        assert adopted == payload_bytes + idx_bytes
+        adopt_h2d = c.as_dict()["bytes_staged_h2d"]
+        assert c.as_dict()["replay_adoptions"] == 1
+    finally:
+        obs_counters.install(None)
+
+    c2 = obs_counters.Counters()
+    obs_counters.install(c2)
+    try:
+        ring_copy = _ring()
+        ring_copy.add(slab)
+        ring_copy._flush()
+        copy_h2d = c2.as_dict()["bytes_staged_h2d"]
+    finally:
+        obs_counters.install(None)
+
+    # adoption ≈ payload; the copy path uploaded 8 padded rows for 6 valid
+    assert adopt_h2d == adopted
+    assert copy_h2d >= payload_bytes * 8 // 6
+    assert adopt_h2d < copy_h2d
+
+
+def test_adopt_slab_zero_rows_is_a_noop():
+    ring = _ring()
+    ring.add(_slab(3, 2))
+    assert ring.adopt_slab(_slab(4, 2), n_valid=0) == 0
+    assert ring.host._pos == 3
+
+
+def test_staging_adoption_surface():
+    """RingStaging over a single-group transitions ring advertises adoption;
+    the host path refuses with a pointer at the ring config."""
+    ring = _ring()
+    staging = RingStaging(ring)
+    assert staging.supports_adoption
+    slab = _slab(4, 2)
+    assert staging.adopt_slab(slab) > 0
+    assert ring.host._pos == 4
+
+    host_rb = ReplayBuffer(16, 2, obs_keys=("observations",))
+    host = HostStaging(host_rb, sequence_mode=False, prefetch=False)
+    assert not host.supports_adoption
+    with pytest.raises(NotImplementedError, match="single-group device ring"):
+        host.adopt_slab(slab)

@@ -1,0 +1,181 @@
+"""make_replay_buffer: the one construction site — size arithmetic, kind
+dispatch, dreamer's type switch, and the sharding/strategy policy
+(sheeprl_tpu/replay/factory.py)."""
+
+import types
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+)
+from sheeprl_tpu.replay import ShardedReplay, make_replay_buffer, shard_env_split
+from sheeprl_tpu.utils.utils import dotdict
+
+
+def _cfg(buffer=None, replay=None, dry_run=False):
+    conf = {
+        "dry_run": dry_run,
+        "buffer": {"size": 1024, "memmap": False, **(buffer or {})},
+    }
+    if replay is not None:
+        conf["replay"] = replay
+    return dotdict(conf)
+
+
+FABRIC = types.SimpleNamespace(global_rank=0)
+
+
+def _make(cfg, **kw):
+    kw.setdefault("n_envs", 4)
+    return make_replay_buffer(cfg, FABRIC, None, **kw)
+
+
+# ---------------------------------------------------------------------------
+# env split
+# ---------------------------------------------------------------------------
+
+
+def test_shard_env_split_units():
+    assert shard_env_split(8, 1) == [8]
+    assert shard_env_split(8, 4) == [2, 2, 2, 2]
+    assert shard_env_split(8, 3) == [3, 3, 2]
+    assert shard_env_split(3, 3) == [1, 1, 1]
+    with pytest.raises(ValueError, match="'replay.shards' must be positive"):
+        shard_env_split(8, 0)
+    with pytest.raises(ValueError, match="cannot exceed the env count"):
+        shard_env_split(2, 3)
+
+
+# ---------------------------------------------------------------------------
+# the bitwise default: shards=1 + uniform is the plain buffer
+# ---------------------------------------------------------------------------
+
+
+def test_default_returns_plain_replay_buffer():
+    rb = _make(_cfg())
+    assert type(rb) is ReplayBuffer
+    assert rb.buffer_size == 1024 // 4
+    assert rb.n_envs == 4
+
+
+def test_explicit_uniform_config_still_plain():
+    rb = _make(_cfg(replay={"shards": 1, "strategy": "uniform"}))
+    assert type(rb) is ReplayBuffer
+
+
+def test_size_arithmetic():
+    # dry_run takes the probe size
+    rb = _make(_cfg(dry_run=True), dry_run_size=1)
+    assert rb.buffer_size == 1
+    # explicit size wins over cfg.buffer.size
+    rb = _make(_cfg(), size=77, sampled=False)
+    assert rb.buffer_size == 77
+    # min_size floors tiny configured buffers
+    rb = _make(_cfg(buffer={"size": 2}), min_size=8)
+    assert rb.buffer_size == 8
+
+
+# ---------------------------------------------------------------------------
+# sharded / prioritized dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_transition_replay():
+    rb = _make(_cfg(replay={"shards": 4}), n_envs=8)
+    assert isinstance(rb, ShardedReplay)
+    assert rb.n_shards == 4
+    assert [s.n_envs for s in rb.shards] == [2, 2, 2, 2]
+    assert rb.strategy.name == "uniform"
+    assert rb.needs_writeback is False
+
+
+def test_prioritized_single_shard_gets_facade():
+    rb = _make(_cfg(replay={"shards": 1, "strategy": "td_priority"}))
+    assert isinstance(rb, ShardedReplay)
+    assert rb.n_shards == 1
+    assert rb.needs_writeback is True
+
+
+def test_prioritize_ends_strategy_dispatch():
+    rb = _make(_cfg(replay={"strategy": "prioritize_ends"}))
+    assert isinstance(rb, ShardedReplay)
+    assert rb.strategy.name == "prioritize_ends"
+    assert rb.needs_writeback is False
+
+
+def test_sharded_memmap_uses_per_shard_subdirs(tmp_path):
+    cfg = _cfg(buffer={"memmap": True}, replay={"shards": 2})
+    rb = make_replay_buffer(cfg, FABRIC, str(tmp_path), n_envs=4)
+    rb.add(
+        {
+            "observations": np.zeros((1, 4, 3), np.float32),
+            "dones": np.zeros((1, 4, 1), np.float32),
+        }
+    )
+    assert (tmp_path / "memmap_buffer" / "rank_0" / "shard_0").exists()
+    assert (tmp_path / "memmap_buffer" / "rank_0" / "shard_1").exists()
+
+
+# ---------------------------------------------------------------------------
+# sequence / episode / dreamer kinds
+# ---------------------------------------------------------------------------
+
+
+def test_sequential_kind():
+    rb = _make(_cfg(), kind="sequential", min_size=8)
+    assert isinstance(rb, EnvIndependentReplayBuffer)
+
+
+def test_dreamer_kind_dispatch():
+    rb = _make(_cfg(buffer={"type": "sequential"}), kind="dreamer", min_size=8)
+    assert isinstance(rb, EnvIndependentReplayBuffer)
+    rb = _make(
+        _cfg(buffer={"type": "episode"}), kind="dreamer", min_size=8, sequence_length=50
+    )
+    assert isinstance(rb, EpisodeBuffer)
+    with pytest.raises(ValueError, match="must be one of `sequential` or `episode`"):
+        _make(_cfg(buffer={"type": "nope"}), kind="dreamer")
+
+
+def test_episode_sizing_floors_at_sequence_length_not_min_size():
+    """Historical dv2 episode sizing: max(base, sequence_length) — the
+    min_size floor belongs to the sequential branch only."""
+    rb = _make(
+        _cfg(buffer={"size": 2, "type": "episode"}),
+        kind="dreamer",
+        min_size=8,
+        sequence_length=3,
+        n_envs=1,
+    )
+    assert rb.buffer_size == 3  # NOT 8
+
+
+def test_episode_requires_sequence_length():
+    with pytest.raises(ValueError, match="episode replay needs a 'sequence_length'"):
+        _make(_cfg(), kind="episode")
+
+
+def test_strategy_warning_on_sequence_storage():
+    with pytest.warns(UserWarning, match="only applies to transition replay"):
+        _make(_cfg(replay={"strategy": "td_priority"}), kind="sequential", min_size=8)
+
+
+def test_shards_rejected_on_sequence_storage():
+    with pytest.raises(ValueError, match="only supported for sampled transition"):
+        _make(_cfg(replay={"shards": 2}), kind="sequential", min_size=8)
+
+
+def test_unsampled_rollout_storage_is_plain():
+    # on-policy rollout storage never participates in the replay plane, even
+    # when the config carries a replay group
+    rb = _make(_cfg(replay={"shards": 2, "strategy": "td_priority"}), size=64, sampled=False)
+    assert type(rb) is ReplayBuffer
+
+
+def test_unknown_kind():
+    with pytest.raises(ValueError, match="Unknown replay kind"):
+        _make(_cfg(), kind="banana")

@@ -1,0 +1,279 @@
+"""ShardedReplay facade: fill-proportional apportionment, single-shard
+bitwise parity, cross-shard routing of ingest/sampling/priority-writeback,
+weight alignment under the interleave permutation, and checkpoint shape
+(sheeprl_tpu/replay/sharded.py)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import counters as obs_counters
+from sheeprl_tpu.replay import ShardedReplay, apportion_by_fill
+from sheeprl_tpu.replay.strategies import TDPriorityStrategy, UniformStrategy
+
+
+def _coded_rows(steps, n_envs, code, obs_dim=3):
+    """Rows whose observation value encodes ``code*1000 + step*10 + env`` so a
+    sampled row proves which shard/step/env it came from."""
+    obs = np.empty((steps, n_envs, obs_dim), np.float32)
+    for t in range(steps):
+        for e in range(n_envs):
+            obs[t, e] = code * 1000 + t * 10 + e
+    return {
+        "observations": obs,
+        "actions": np.zeros((steps, n_envs, 2), np.float32),
+        "rewards": np.zeros((steps, n_envs, 1), np.float32),
+        "dones": np.zeros((steps, n_envs, 1), np.float32),
+    }
+
+
+def _facade(shard_specs, strategy=None, size=32):
+    """shard_specs: list of (n_envs, steps_to_fill, code)."""
+    shards = []
+    for n_envs, steps, code in shard_specs:
+        rb = ReplayBuffer(size, n_envs, obs_keys=("observations",))
+        if steps:
+            rb.add(_coded_rows(steps, n_envs, code))
+        shards.append(rb)
+    return ShardedReplay(shards, strategy=strategy)
+
+
+# ---------------------------------------------------------------------------
+# apportionment
+# ---------------------------------------------------------------------------
+
+
+def test_apportion_by_fill_units():
+    assert apportion_by_fill(10, [1.0, 1.0]) == [5, 5]
+    assert apportion_by_fill(10, [3.0, 1.0]) == [8, 2]  # 7.5/2.5, tie → low index
+    assert apportion_by_fill(5, [0.0, 2.0]) == [0, 5]
+    assert apportion_by_fill(0, [1.0, 1.0]) == [0, 0]
+    assert apportion_by_fill(7, [1.0, 1.0, 1.0]) == [3, 2, 2]
+    assert sum(apportion_by_fill(97, [0.3, 11.0, 2.5, 0.0])) == 97
+    with pytest.raises(ValueError, match="No shard holds data"):
+        apportion_by_fill(4, [0.0, 0.0])
+
+
+def test_plan_burst_apportions_by_fill():
+    """A shard holding 3x the rows receives ~3x the draws, deterministically
+    (the split consumes no rng)."""
+    sr = _facade([(2, 24, 1), (2, 8, 2)])
+    sr.seed(0)
+    shard_ids, _, _ = sr.plan_burst(32)
+    counts = np.bincount(shard_ids, minlength=2)
+    np.testing.assert_array_equal(counts, [24, 8])
+
+
+# ---------------------------------------------------------------------------
+# single-shard parity (the facade is transparent at n=1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sample_next_obs", [False, True])
+@pytest.mark.parametrize("n_samples", [1, 3])
+def test_single_shard_uniform_facade_bitwise(sample_next_obs, n_samples):
+    """ShardedReplay([rb], uniform) samples bitwise what the bare buffer
+    samples at the same seed — no permutation, no extra rng consumption."""
+    plain = ReplayBuffer(32, 4, obs_keys=("observations",))
+    shard = ReplayBuffer(32, 4, obs_keys=("observations",))
+    plain.add(_coded_rows(20, 4, 0))
+    shard.add(_coded_rows(20, 4, 0))
+    sr = ShardedReplay([shard], strategy=UniformStrategy())
+    plain.seed(9)
+    sr.seed(9)
+    for _ in range(3):  # streams stay in lockstep across repeated draws
+        want = plain.sample(8, sample_next_obs=sample_next_obs, n_samples=n_samples)
+        got = sr.sample(8, sample_next_obs=sample_next_obs, n_samples=n_samples)
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# multi-shard routing
+# ---------------------------------------------------------------------------
+
+
+def test_add_splits_env_axis_by_shard_ownership():
+    sr = _facade([(2, 0, 0), (3, 0, 0)])
+    data = _coded_rows(6, 5, 7)
+    sr.add(data)
+    np.testing.assert_array_equal(
+        np.asarray(sr.shards[0].buffer["observations"][:6]), data["observations"][:, :2]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sr.shards[1].buffer["observations"][:6]), data["observations"][:, 2:]
+    )
+    assert sr.n_envs == 5
+    assert sr.shard_for_env(0) == (0, 0)
+    assert sr.shard_for_env(1) == (0, 1)
+    assert sr.shard_for_env(2) == (1, 0)
+    assert sr.shard_for_env(4) == (1, 2)
+    with pytest.raises(ValueError, match="env column 5"):
+        sr.shard_for_env(5)
+
+
+def test_sample_rows_come_from_their_shard():
+    """Every sampled row's coded value matches the shard the plan assigned
+    it to — the scatter/gather across shards never crosses wires."""
+    sr = _facade([(2, 16, 1), (2, 16, 2), (2, 16, 3)])
+    sr.seed(4)
+    out = sr.sample(16, n_samples=2)
+    assert out["observations"].shape == (2, 16, 3)
+    shard_ids, t_all, e_all = sr._last_plan
+    flat = out["observations"].reshape(32, 3)[:, 0]
+    want = (shard_ids + 1) * 1000 + t_all * 10 + e_all
+    np.testing.assert_array_equal(flat, want)
+
+
+def test_seeded_sampling_is_deterministic():
+    a = _facade([(2, 12, 1), (2, 12, 2)])
+    b = _facade([(2, 12, 1), (2, 12, 2)])
+    a.seed(21)
+    b.seed(21)
+    for _ in range(2):
+        sa = a.sample(8)
+        sb = b.sample(8)
+        for k in sa:
+            np.testing.assert_array_equal(sa[k], sb[k], err_msg=k)
+
+
+def test_sample_rejects_bad_sizes_and_empty():
+    sr = _facade([(2, 4, 1), (2, 4, 2)])
+    with pytest.raises(ValueError, match="must be both greater than 0"):
+        sr.sample(0)
+    empty = _facade([(2, 0, 0), (2, 0, 0)])
+    with pytest.raises(ValueError, match="No shard holds data"):
+        empty.sample(4)
+
+
+def test_shard_fill_tracking():
+    sr = _facade([(2, 0, 0), (2, 0, 0)], size=16)
+    c = obs_counters.Counters()
+    obs_counters.install(c)
+    try:
+        sr.add_shard(0, _coded_rows(4, 2, 1))
+        sr.add_shard(1, _coded_rows(16, 2, 2))
+        assert sr.fills() == [0.25, 1.0]
+        snap = c.as_dict()["replay_shard_fill"]
+        assert snap == {"0": 0.25, "1": 1.0}
+    finally:
+        obs_counters.install(None)
+
+
+# ---------------------------------------------------------------------------
+# prioritized path: init / writeback routing / weight alignment
+# ---------------------------------------------------------------------------
+
+
+def test_init_priorities_newest_marks_the_fresh_rows():
+    strat = TDPriorityStrategy()
+    sr = _facade([(2, 6, 1), (2, 3, 2)], strategy=strat, size=8)
+    sr.init_priorities_newest(0, 2)  # rows 4,5 of shard 0
+    table = strat._table(sr.shards[0])
+    assert (table[4:6] > 0).all()
+    assert (table[:4] == 0).all()
+    # wrap: shard 1 at pos=3 in a size-8 ring, 5 newest rows span the seam
+    sr.shards[1].add(_coded_rows(7, 2, 2))  # pos now 10 % 8 = 2, full
+    sr.init_priorities_newest(1, 5)
+    t1 = strat._table(sr.shards[1])
+    marked = {t for t in range(8) if (t1[t] > 0).all()}
+    assert marked == {5, 6, 7, 0, 1}
+
+
+def test_update_priorities_routes_to_owning_shard():
+    strat = TDPriorityStrategy(eps=1e-6)
+    sr = _facade([(2, 8, 1), (2, 8, 2)], strategy=strat)
+    sr.seed(2)
+    out = sr.sample(16)
+    td = np.arange(1.0, 17.0)
+    sr.update_priorities(td)
+    shard_ids, t_all, e_all = sr._last_plan
+    for i in range(16):
+        table = strat._table(sr.shards[shard_ids[i]])
+        # later duplicate writes win; check the LAST write of each cell
+        dup = (shard_ids == shard_ids[i]) & (t_all == t_all[i]) & (e_all == e_all[i])
+        expect = td[np.flatnonzero(dup)[-1]] + 1e-6
+        assert table[t_all[i], e_all[i]] == pytest.approx(expect)
+
+
+def test_update_priorities_errors():
+    sr = _facade([(2, 8, 1), (2, 8, 2)], strategy=TDPriorityStrategy())
+    with pytest.raises(RuntimeError, match="before any sample"):
+        sr.update_priorities(np.ones(4))
+    sr.seed(0)
+    sr.sample(8)
+    with pytest.raises(ValueError, match="td_errors has 3 rows but the last plan drew 8"):
+        sr.update_priorities(np.ones(3))
+
+
+def test_last_weights_stay_aligned_through_the_interleave():
+    """The regression the permutation made possible: importance weights must
+    ride the SAME permutation as the plan rows. Recompute each output row's
+    weight from its shard's priority table and require an exact match."""
+    strat = TDPriorityStrategy(alpha=0.7, beta=0.5, eps=1e-6)
+    sr = _facade([(2, 8, 1), (2, 8, 2)], strategy=strat)
+    sr.seed(13)
+    # distinct priorities everywhere so a misaligned permutation cannot pass
+    for p in range(2):
+        t = np.repeat(np.arange(8), 2)
+        e = np.tile(np.arange(2), 8)
+        strat.update_priorities(sr.shards[p], t, e, 0.1 + 0.37 * (p + 1) * (t * 2 + e + 1))
+    sr.sample(32)
+    w = sr.last_weights()
+    assert w is not None and w.shape == (32,) and w.max() == pytest.approx(1.0)
+
+    shard_ids, t_all, e_all = sr._last_plan
+    raw = np.empty(32)
+    for p in range(2):
+        mask = shard_ids == p
+        rb = sr.shards[p]
+        table = strat._table(rb)
+        valid = rb.valid_time_indices(False)
+        prio = table[np.ix_(valid, np.arange(rb.n_envs))]
+        prio = np.where(prio > 0.0, prio, strat._max_prio(rb))
+        scaled = prio.ravel() ** strat.alpha
+        probs = scaled / scaled.sum()
+        pos = np.searchsorted(valid, t_all[mask])  # valid is sorted arange here
+        p_sel = probs[pos * rb.n_envs + e_all[mask]]
+        raw[mask] = (len(probs) * p_sel) ** (-strat.beta)
+    np.testing.assert_allclose(w, raw / raw.max())
+
+
+def test_last_weights_none_for_uniform():
+    sr = _facade([(2, 8, 1), (2, 8, 2)])
+    sr.seed(0)
+    sr.sample(8)
+    assert sr.last_weights() is None
+    assert sr.needs_writeback is False
+
+
+# ---------------------------------------------------------------------------
+# checkpointing
+# ---------------------------------------------------------------------------
+
+
+def test_state_dict_round_trip():
+    src = _facade([(2, 12, 1), (2, 5, 2)])
+    dst = _facade([(2, 0, 0), (2, 0, 0)])
+    dst.load_state_dict(src.state_dict())
+    for a, b in zip(src.shards, dst.shards):
+        assert a._pos == b._pos and a.full == b.full
+        np.testing.assert_array_equal(
+            np.asarray(a.buffer["observations"]), np.asarray(b.buffer["observations"])
+        )
+    # shard-count mismatch is a configuration error, stated as one
+    three = _facade([(2, 0, 0), (1, 0, 0), (1, 0, 0)])
+    with pytest.raises(ValueError, match="replay.shards must match to resume"):
+        three.load_state_dict(src.state_dict())
+
+
+def test_facade_surface_properties():
+    sr = _facade([(2, 40, 1), (3, 2, 2)], size=32)
+    assert sr.n_shards == 2
+    assert len(sr) == 64
+    assert sr.buffer_size == 64
+    assert not sr.full and not sr.empty
+    assert sr.shards[0].full
+    with pytest.raises(ValueError, match="at least one shard"):
+        ShardedReplay([])

@@ -1,0 +1,269 @@
+"""Sampling-strategy registry: uniform bitwise parity, the EpisodeBuffer
+end-bias equivalence, TD-priority writeback round-trips, and importance
+weight units (sheeprl_tpu/replay/strategies.py)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EpisodeBuffer, ReplayBuffer, end_biased_start
+from sheeprl_tpu.replay.strategies import (
+    PrioritizeEndsStrategy,
+    TDPriorityStrategy,
+    UniformStrategy,
+    available_strategies,
+    get_strategy,
+    make_strategy,
+)
+
+
+def _fill(rb, steps, n_envs, obs_dim=3):
+    """Rows whose observation value IS the step index (self-describing)."""
+    for i in range(steps):
+        rb.add(
+            {
+                "observations": np.full((1, n_envs, obs_dim), i, np.float32),
+                "actions": np.full((1, n_envs, 2), -i, np.float32),
+                "rewards": np.full((1, n_envs, 1), float(i), np.float32),
+                "dones": np.zeros((1, n_envs, 1), np.float32),
+            }
+        )
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_contents():
+    assert available_strategies() == ["prioritize_ends", "td_priority", "uniform"]
+    assert get_strategy("uniform") is UniformStrategy
+    with pytest.raises(ValueError, match="Unknown replay sampling strategy"):
+        get_strategy("nope")
+
+
+def test_make_strategy_dispatch():
+    assert isinstance(make_strategy(None), UniformStrategy)
+    assert isinstance(make_strategy({}), UniformStrategy)
+    assert isinstance(make_strategy({"strategy": "prioritize_ends"}), PrioritizeEndsStrategy)
+    td = make_strategy(
+        {"strategy": "td_priority", "priority": {"alpha": 0.9, "beta": 0.5, "eps": 1e-3}}
+    )
+    assert isinstance(td, TDPriorityStrategy)
+    assert (td.alpha, td.beta, td.eps) == (0.9, 0.5, 1e-3)
+    # defaults when the priority block is absent
+    td2 = make_strategy({"strategy": "td_priority"})
+    assert (td2.alpha, td2.beta, td2.eps) == (0.6, 0.4, 1e-6)
+
+
+def test_td_priority_rejects_bad_hyperparameters():
+    with pytest.raises(ValueError, match="'alpha' must be non-negative"):
+        TDPriorityStrategy(alpha=-0.1)
+    with pytest.raises(ValueError, match="'beta' must be non-negative"):
+        TDPriorityStrategy(beta=-1.0)
+    with pytest.raises(ValueError, match="'eps' must be positive"):
+        TDPriorityStrategy(eps=0.0)
+
+
+# ---------------------------------------------------------------------------
+# uniform: bitwise the buffer's own planner
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sample_next_obs", [False, True])
+def test_uniform_plan_bitwise_matches_plan_transitions(sample_next_obs):
+    """Same seed, same draws: the strategy consumes the buffer's rng stream
+    exactly like ``plan_transitions`` (the shards=1 bitwise gate)."""
+    a = ReplayBuffer(16, 2, obs_keys=("observations",))
+    b = ReplayBuffer(16, 2, obs_keys=("observations",))
+    _fill(a, 10, 2)
+    _fill(b, 10, 2)
+    a.seed(11)
+    b.seed(11)
+    strat = UniformStrategy()
+    for _ in range(3):  # repeated draws stay in lockstep
+        t1, e1 = a.plan_transitions(8, sample_next_obs=sample_next_obs, n_samples=2)
+        t2, e2 = strat.plan(b, 8, sample_next_obs=sample_next_obs, n_samples=2)
+        np.testing.assert_array_equal(t1, t2)
+        np.testing.assert_array_equal(e1, e2)
+
+
+# ---------------------------------------------------------------------------
+# prioritize_ends: the EpisodeBuffer end bias, exactly
+# ---------------------------------------------------------------------------
+
+
+def test_prioritize_ends_matches_episode_buffer_draw():
+    """A flat ring's end-biased draw IS the EpisodeBuffer ``prioritize_ends``
+    draw: same seed, same rng consumption, identical picked positions."""
+    L, total, seed = 10, 64, 123
+    rb = ReplayBuffer(16, 1, obs_keys=("observations",))
+    _fill(rb, L, 1)
+
+    epb = EpisodeBuffer(4 * L, 1, n_envs=1, obs_keys=("observations",))
+    ep = {
+        "observations": np.arange(L, dtype=np.float32).reshape(L, 1, 1),
+        "dones": np.zeros((L, 1, 1), np.float32),
+    }
+    ep["dones"][-1] = 1
+    epb.add(ep)
+    epb.seed(seed)
+
+    # mirror the EpisodeBuffer's stream: it draws the episode choice vector
+    # first (one eligible episode), then one end-biased start per row
+    rng = np.random.default_rng(seed)
+    rng.integers(0, 1, size=total)
+    t_idx, _ = PrioritizeEndsStrategy().plan(rb, total, sample_next_obs=True, rng=rng)
+
+    # sequence_length=1 + sample_next_obs: effective window 2, upper=L-2 on
+    # both sides; the sampled observation value is the picked start
+    got = epb.sample(total, sample_next_obs=True, prioritize_ends=True)
+    starts = np.asarray(got["observations"])[0, 0, :, 0].astype(np.int64)
+    np.testing.assert_array_equal(t_idx, starts)
+    # the clamp binds: position L-2 carries the tail mass (raw L-2 and L-1)
+    assert t_idx.max() == L - 2
+
+
+def test_prioritize_ends_respects_wrap_order_and_valid_window():
+    """On a wrapped ring the draw orders by AGE (write head first), so the
+    clamped tail is the newest row, not the highest ring index."""
+    size = 8
+    rb = ReplayBuffer(size, 1, obs_keys=("observations",))
+    _fill(rb, 13, 1)  # wrapped: _pos=5, oldest surviving row at position 5
+    rb.seed(3)
+    t_idx, e_idx = PrioritizeEndsStrategy().plan(rb, 256, sample_next_obs=True)
+    ordered = rb.age_ordered_time_indices()
+    # every draw is a valid age-ordered position, and the newest row (no
+    # stored successor) is excluded under sample_next_obs
+    assert set(t_idx) <= set(ordered[:-1])
+    # mirror the draw with the same seeded stream
+    rng = np.random.default_rng(3)
+    raw = rng.integers(0, size, size=256)
+    np.testing.assert_array_equal(t_idx, ordered[np.minimum(raw, size - 2)])
+    np.testing.assert_array_equal(e_idx, rng.integers(0, 1, size=256))
+
+
+def test_prioritize_ends_single_row_next_obs_raises():
+    rb = ReplayBuffer(4, 1, obs_keys=("observations",))
+    _fill(rb, 1, 1)
+    with pytest.raises(RuntimeError, match="at least two samples"):
+        PrioritizeEndsStrategy().plan(rb, 4, sample_next_obs=True)
+
+
+def test_strategies_reject_empty_buffer():
+    rb = ReplayBuffer(4, 1, obs_keys=("observations",))
+    for strat in (UniformStrategy(), PrioritizeEndsStrategy(), TDPriorityStrategy()):
+        with pytest.raises(ValueError, match="No sample has been added"):
+            strat.plan(rb, 4)
+
+
+# ---------------------------------------------------------------------------
+# td_priority: writeback round-trip + importance weights
+# ---------------------------------------------------------------------------
+
+
+def test_td_priority_writeback_round_trip():
+    """update_priorities lands ``|td| + eps`` at exactly the written cells
+    and advances the running max new rows inherit."""
+    rb = ReplayBuffer(16, 2, obs_keys=("observations",))
+    _fill(rb, 8, 2)
+    rb.seed(0)
+    strat = TDPriorityStrategy(alpha=1.0, beta=1.0, eps=1e-6)
+    strat.plan(rb, 8)
+    # distinct cells (a plan may repeat cells; fancy assignment last-wins)
+    t_idx = np.arange(8)
+    e_idx = np.tile(np.arange(2), 4)
+    td = np.linspace(-2.0, 2.0, 8)
+    strat.update_priorities(rb, t_idx, e_idx, td)
+    table = strat._table(rb)
+    np.testing.assert_allclose(table[t_idx, e_idx], np.abs(td) + 1e-6)
+    assert strat._max_prio(rb) == pytest.approx(2.0 + 1e-6)
+    # fresh rows adopt the (new) running max
+    strat.init_priorities(rb, np.array([9, 10]))
+    np.testing.assert_allclose(table[9, :], strat._max_prio(rb))
+    np.testing.assert_allclose(table[10, :], strat._max_prio(rb))
+
+
+def test_td_priority_writeback_shape_mismatch():
+    rb = ReplayBuffer(16, 2, obs_keys=("observations",))
+    _fill(rb, 8, 2)
+    strat = TDPriorityStrategy()
+    with pytest.raises(ValueError, match="Priority writeback shapes disagree"):
+        strat.update_priorities(rb, np.arange(4), np.zeros(4, np.int64), np.ones(3))
+
+
+def test_td_priority_concentrates_on_high_priority_rows():
+    """One cell with overwhelming priority captures (nearly) every draw —
+    proportional prioritization is live, not uniform-with-extra-steps."""
+    rb = ReplayBuffer(16, 2, obs_keys=("observations",))
+    _fill(rb, 8, 2)
+    rb.seed(5)
+    strat = TDPriorityStrategy(alpha=1.0, beta=0.4, eps=1e-6)
+    all_t = np.repeat(np.arange(8), 2)
+    all_e = np.tile(np.arange(2), 8)
+    td = np.full(16, 1e-4)
+    td[all_t.tolist().index(3) + 1] = 0.0  # keep deterministic layout simple
+    strat.update_priorities(rb, all_t, all_e, td)
+    strat.update_priorities(rb, np.array([3]), np.array([1]), np.array([1e6]))
+    t_idx, e_idx = strat.plan(rb, 512)
+    hot = (t_idx == 3) & (e_idx == 1)
+    assert hot.mean() > 0.95
+
+
+def test_td_priority_weights_units():
+    """Uniform priorities → every normalized weight is exactly 1; beta=0
+    switches importance correction off regardless of the priorities."""
+    rb = ReplayBuffer(16, 2, obs_keys=("observations",))
+    _fill(rb, 8, 2)
+    rb.seed(1)
+    strat = TDPriorityStrategy(alpha=0.6, beta=0.4)
+    strat.plan(rb, 32)  # all cells still at the initial max priority
+    np.testing.assert_allclose(strat.weights(rb), np.ones(32))
+
+    # skewed priorities: w = (N * P)^-beta, normalized by the max
+    strat.update_priorities(rb, np.arange(8), np.zeros(8, np.int64), np.linspace(0.1, 3.0, 8))
+    t_idx, e_idx = strat.plan(rb, 64)
+    w = strat.weights(rb)
+    assert w.shape == (64,) and w.max() == pytest.approx(1.0)
+    assert (w > 0).all() and (w <= 1.0).all()
+    # manual recomputation from the table, aligned row-for-row
+    table = strat._table(rb)
+    prio = table[np.ix_(rb.valid_time_indices(False), np.arange(2))]
+    prio = np.where(prio > 0.0, prio, strat._max_prio(rb))
+    scaled = prio.ravel() ** strat.alpha
+    probs = scaled / scaled.sum()
+    flat = t_idx * 2 + e_idx  # valid == arange(8) here, env columns = 2
+    want = (len(probs) * probs[flat]) ** (-strat.beta)
+    np.testing.assert_allclose(w, want / want.max())
+
+    flat_strat = TDPriorityStrategy(alpha=0.6, beta=0.0)
+    flat_strat.update_priorities(rb, np.arange(8), np.ones(8, np.int64), np.linspace(1, 9, 8))
+    flat_strat.plan(rb, 32)
+    np.testing.assert_allclose(flat_strat.weights(rb), np.ones(32))
+
+
+def test_td_priority_weights_none_before_any_plan():
+    rb = ReplayBuffer(16, 2, obs_keys=("observations",))
+    _fill(rb, 4, 2)
+    assert TDPriorityStrategy().weights(rb) is None
+
+
+def test_td_priority_state_is_per_buffer():
+    """One strategy object serves many shards without cross-talk."""
+    a = ReplayBuffer(8, 1, obs_keys=("observations",))
+    b = ReplayBuffer(8, 1, obs_keys=("observations",))
+    _fill(a, 4, 1)
+    _fill(b, 4, 1)
+    strat = TDPriorityStrategy()
+    strat.update_priorities(a, np.array([0]), np.array([0]), np.array([7.0]))
+    assert strat._table(a)[0, 0] == pytest.approx(7.0 + strat.eps)
+    assert strat._table(b)[0, 0] == 0.0
+
+
+def test_end_biased_start_clamp():
+    rng = np.random.default_rng(0)
+    draws = np.array([end_biased_start(rng, 10, 6) for _ in range(200)])
+    assert draws.max() == 6  # clamped
+    assert (draws >= 0).all()
+    # mass at the clamp exceeds any interior position (4 raw values fold in)
+    counts = np.bincount(draws, minlength=7)
+    assert counts[6] > counts[:6].max()

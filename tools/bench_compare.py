@@ -113,6 +113,9 @@ _LOWER_KEYS = (
     "grad_norm_p95",
     "learn_warnings",
     "learn_criticals",
+    # replay plane (tools/bench_replay): h2d bytes per adopted burst — the
+    # zero-dispatch adoption path regressing toward the padded copy upload
+    "bytes_staged_h2d",
 )
 
 

@@ -1,0 +1,375 @@
+#!/usr/bin/env python
+"""Replay-plane throughput bench: N writer processes, one learner, committed
+as ``REPLAY_r<k>.json`` rounds that ``tools/bench_compare.py --prefix
+REPLAY`` diffs (``replay_sample_sps`` higher-better, ``bytes_staged_h2d``
+lower-better).
+
+Each cell brings up the production transport end to end: a real
+:class:`~sheeprl_tpu.plane.supervisor.ProcessPlane` whose players run the
+synthetic shard-writer entry (``sheeprl_tpu.replay.bench_writer:run_writer``
+— slab protocol, credited-slot backpressure, respawn ladder all live), a
+:class:`~sheeprl_tpu.replay.sharded.ShardedReplay` with one shard per
+writer, and a :class:`~sheeprl_tpu.replay.plane.ReplayPlane` routing slabs
+into shards. The learner samples at a *samples-per-insert* rate coupled to
+ingest, so the sampled-transitions-per-second number measures how fast the
+plane can feed a learner, not how fast numpy can index in a tight loop.
+
+Honesty notes (why the scaling claim holds on a small host):
+
+- writers are **latency-bound** — their wall time is simulated env-step
+  sleeps (``bench_replay.step_latency_s``), not compute, so N writer
+  processes measure the plane's ability to overlap N collection streams
+  (the architecture claim) rather than raw CPU parallelism;
+- per-writer env count is fixed across cells, so the 4-writer cell
+  collects a 4x env fleet — exactly how the decoupled plane scales;
+- the clock starts after the first burst lands, excluding process spawn
+  and jax import from the steady-state rate;
+- ``sample_age_p95_s`` rides on each line from the PR-9 staleness lineage
+  (per-shard commit stamps through the plan chokepoint), bounding how
+  stale the coupled sampler actually ran.
+
+Evidence lines::
+
+    {"metric": "replay.sample_sps.4w", "value": ..., "unit": "steps/s",
+     "sample_age_p95_s": ..., "insert_sps": ..., "shard_fill": [...], ...}
+    {"metric": "replay.adopt_h2d", "value": <bytes>, "unit": "bytes",
+     "bytes_staged_h2d": ..., "copy_path_bytes": ..., ...}
+
+Usage::
+
+    python tools/bench_replay.py                 # 1w + 4w cells + adoption
+    python tools/bench_replay.py --writers 1,2   # small-host smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/bench_replay.py` puts tools/ first
+    sys.path.insert(0, REPO)
+
+# the bench is host-side plumbing; never let a learner-side jax import grab
+# an accelerator out from under a training run
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _bench_cfg(args, workdir: str):
+    """The minimal composed-config surface ProcessPlane and the bench
+    writer read (picklable; ``_plain`` re-wraps it for the children)."""
+    from sheeprl_tpu.utils.utils import dotdict
+
+    return dotdict(
+        {
+            "seed": int(args.seed),
+            "dry_run": False,
+            "env": {"mp_context": args.mp_context},
+            "plane": {
+                "queue_slots": int(args.queue_slots),
+                "max_player_restarts": 0,  # a dead writer fails the bench
+                "poll_interval_s": 0.05,
+                "recv_timeout_s": 120.0,
+                "keep_policies": 2,
+            },
+            "bench_replay": {
+                "obs_dim": int(args.obs_dim),
+                "act_dim": int(args.act_dim),
+                "step_latency_s": float(args.step_latency_s),
+                "payload_fill": True,
+            },
+        }
+    )
+
+
+def run_cell(args, n_writers: int, workdir: str) -> Dict[str, Any]:
+    """One throughput cell: n_writers plane players feeding n_writers
+    shards, the learner sampling at ``samples_per_insert`` x ingest."""
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+    from sheeprl_tpu.obs.dist import staleness as _staleness
+    from sheeprl_tpu.plane.protocol import burst_plan
+    from sheeprl_tpu.plane.slabs import SlabSpec
+    from sheeprl_tpu.plane.supervisor import ProcessPlane
+    from sheeprl_tpu.replay import ShardedReplay
+    from sheeprl_tpu.replay.bench_writer import bench_slab_example
+    from sheeprl_tpu.replay.plane import ReplayPlane
+    from sheeprl_tpu.replay.strategies import make_strategy
+
+    envs = int(args.envs_per_writer)
+    act_burst = int(args.act_burst)
+    num_updates = int(args.updates)
+    batch = int(args.batch_size)
+    spi = float(args.samples_per_insert)
+    cfg = _bench_cfg(args, workdir)
+    spec = SlabSpec.from_arrays(
+        bench_slab_example(act_burst, envs, int(args.obs_dim), int(args.act_dim))
+    )
+    # writers never train: learning_starts == num_updates keeps burst_plan
+    # in the random phase, so no player ever waits on a policy version
+    scalars = {
+        "num_updates": num_updates,
+        "learning_starts": num_updates,
+        "first_train_update": num_updates + 1,
+        "act_burst": act_burst,
+        "max_policy_lag": 0,
+    }
+    replay_cfg = {
+        "strategy": args.strategy,
+        "priority": {"alpha": 0.6, "beta": 0.4, "eps": 1e-6},
+    }
+    sharded = ShardedReplay(
+        [
+            ReplayBuffer(int(args.shard_rows), envs, obs_keys=("observations",))
+            for _ in range(n_writers)
+        ],
+        strategy=make_strategy(replay_cfg),
+    )
+    sharded.seed(int(args.seed))
+    td_rng = np.random.default_rng(int(args.seed) + 1)
+
+    tracker = _staleness.StalenessTracker()
+    _staleness.install(tracker)
+    plane = None
+    t0 = time.monotonic()
+    try:
+        plane = ProcessPlane(
+            cfg,
+            log_dir=workdir,
+            entry="sheeprl_tpu.replay.bench_writer:run_writer",
+            spec=spec,
+            n_players=n_writers,
+            envs_per_player=envs,
+            scalars=scalars,
+            player_keys=[np.zeros(2, np.uint32) for _ in range(n_writers)],
+            algo_name="bench_replay",
+            start_update=1,
+        )
+        plane.publish(0, {"params": np.zeros(1, np.float32)})
+        plane.start()
+        replay_plane = ReplayPlane(plane, sharded)
+
+        update, budget = 1, 0.0
+        inserted = sampled = 0
+        t_steady: Optional[float] = None
+        while update <= num_updates:
+            n_act, _ = burst_plan(update, act_burst, num_updates, num_updates)
+            handles = replay_plane.recv(update)
+            replay_plane.ingest(handles, n_act)
+            ins = n_act * envs * n_writers
+            budget += ins * spi
+            while budget >= batch:
+                sharded.sample(batch, sample_next_obs=False, n_samples=1)
+                if sharded.needs_writeback:
+                    # exercise the writeback channel at full rate — the
+                    # priority table update is part of the sampler's cost
+                    sharded.update_priorities(td_rng.random(batch) + 1e-3)
+                budget -= batch
+                if t_steady is not None:
+                    sampled += batch
+            if t_steady is None:
+                # burst 1 pays process spawn + jax import; the steady-state
+                # clock starts after it lands
+                t_steady = time.monotonic()
+            else:
+                inserted += ins
+            update += n_act
+        wall = time.monotonic() - (t_steady or t0)
+    finally:
+        if plane is not None:
+            plane.drain()
+        _staleness.install(None)
+
+    summary = tracker.summary() or {}
+    age = summary.get("sample_age_s") or {}
+    line = {
+        "metric": f"replay.sample_sps.{n_writers}w",
+        "value": round(sampled / wall, 1) if wall > 0 else 0.0,
+        "unit": "steps/s",
+        "sample_age_p95_s": age.get("p95_s"),
+        "sample_age_p50_s": age.get("p50_s"),
+        "insert_sps": round(inserted / wall, 1) if wall > 0 else 0.0,
+        "shard_fill": [round(f, 4) for f in sharded.fills()],
+        "writers": n_writers,
+        "envs_per_writer": envs,
+        "updates": num_updates,
+        "act_burst": act_burst,
+        "batch_size": batch,
+        "samples_per_insert": spi,
+        "strategy": args.strategy,
+        "step_latency_s": float(args.step_latency_s),
+        "total_wall_s": round(time.monotonic() - t0, 2),
+        "steady_wall_s": round(wall, 3),
+    }
+    return line
+
+
+def run_adoption(args) -> Dict[str, Any]:
+    """The zero-dispatch evidence: one burst staged slab -> HBM (adopt) vs
+    slab -> host rb -> ring (copy), h2d bytes from the staging counters."""
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+    from sheeprl_tpu.data.device_ring import DeviceRingTransitions
+    from sheeprl_tpu.obs import counters as obs_counters
+
+    steps, envs, obs_dim = 48, int(args.envs_per_writer), int(args.obs_dim)
+    rng = np.random.default_rng(int(args.seed))
+    slab = {
+        "observations": rng.random((steps, envs, obs_dim)).astype(np.float32),
+        "next_observations": rng.random((steps, envs, obs_dim)).astype(np.float32),
+        "actions": rng.random((steps, envs, int(args.act_dim))).astype(np.float32),
+        "rewards": rng.random((steps, envs, 1)).astype(np.float32),
+        "dones": np.zeros((steps, envs, 1), np.float32),
+    }
+    payload = sum(np.ascontiguousarray(v).nbytes for v in slab.values())
+
+    def _ring():
+        return DeviceRingTransitions(
+            ReplayBuffer(256, envs, obs_keys=("observations",)), seed=int(args.seed)
+        )
+
+    def _measure(fn) -> int:
+        c = obs_counters.Counters()
+        obs_counters.install(c)
+        try:
+            fn()
+            return int(c.as_dict()["bytes_staged_h2d"])
+        finally:
+            obs_counters.install(None)
+
+    adopt_h2d = _measure(lambda: _ring().adopt_slab(slab))
+
+    def _copy():
+        ring = _ring()
+        ring.add(slab)
+        ring._flush()
+
+    copy_h2d = _measure(_copy)
+    return {
+        "metric": "replay.adopt_h2d",
+        "value": adopt_h2d,
+        "unit": "bytes",
+        "bytes_staged_h2d": adopt_h2d,
+        "copy_path_bytes": copy_h2d,
+        "payload_bytes": payload,
+        "copy_over_adopt_x": round(copy_h2d / adopt_h2d, 3) if adopt_h2d else None,
+        "rows": steps,
+    }
+
+
+def next_round(out_dir: str, prefix: str) -> int:
+    import glob
+    import re
+
+    rounds = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(out_dir, f"{prefix}_r*.json"))
+        if (m := re.search(rf"{prefix}_r(\d+)\.json$", p))
+    ]
+    return (max(rounds) + 1) if rounds else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--writers",
+        default="1,4",
+        help="comma-separated writer counts to cell over (default 1,4)",
+    )
+    parser.add_argument("--updates", type=int, default=960)
+    parser.add_argument("--act-burst", type=int, default=64, dest="act_burst")
+    parser.add_argument("--envs-per-writer", type=int, default=4, dest="envs_per_writer")
+    parser.add_argument("--batch-size", type=int, default=256, dest="batch_size")
+    parser.add_argument(
+        "--samples-per-insert", type=float, default=1.0, dest="samples_per_insert"
+    )
+    parser.add_argument("--shard-rows", type=int, default=4096, dest="shard_rows")
+    parser.add_argument("--strategy", default="uniform")
+    parser.add_argument("--obs-dim", type=int, default=8, dest="obs_dim")
+    parser.add_argument("--act-dim", type=int, default=2, dest="act_dim")
+    parser.add_argument(
+        "--step-latency-s", type=float, default=1e-3, dest="step_latency_s"
+    )
+    parser.add_argument("--queue-slots", type=int, default=4, dest="queue_slots")
+    parser.add_argument("--mp-context", default="forkserver", dest="mp_context")
+    parser.add_argument("--seed", type=int, default=5)
+    parser.add_argument("--no-adopt", action="store_true", help="skip the h2d cell")
+    parser.add_argument("--out-dir", default=REPO, dest="out_dir")
+    parser.add_argument("--workdir", default=None)
+    parser.add_argument("--round", type=int, default=None)
+    parser.add_argument("--no-write", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.workdir is None:
+        args.workdir = os.path.join(args.out_dir, ".replay_runs")
+    counts = [int(c) for c in str(args.writers).split(",") if c.strip()]
+
+    t0 = time.monotonic()
+    lines: List[Dict[str, Any]] = []
+    failures = 0
+    by_writers: Dict[int, float] = {}
+    for n in counts:
+        workdir = os.path.join(args.workdir, f"{n}w")
+        os.makedirs(workdir, exist_ok=True)
+        print(f"[bench-replay] {n} writer(s): {args.updates} updates ...", flush=True)
+        try:
+            line = run_cell(args, n, workdir)
+        except Exception as exc:  # a dead plane is evidence too
+            failures += 1
+            lines.append(
+                {
+                    "metric": f"replay.sample_sps.{n}w",
+                    "skipped": f"{type(exc).__name__}: {exc}",
+                    "unit": "steps/s",
+                }
+            )
+            continue
+        by_writers[n] = float(line["value"])
+        if 1 in by_writers and n != 1 and by_writers[1] > 0:
+            line["scaling_vs_1w"] = round(by_writers[n] / by_writers[1], 2)
+        lines.append(line)
+        print(f"[bench-replay] {json.dumps(line)}", flush=True)
+
+    if not args.no_adopt:
+        try:
+            line = run_adoption(args)
+            lines.append(line)
+            print(f"[bench-replay] {json.dumps(line)}", flush=True)
+        except Exception as exc:
+            failures += 1
+            lines.append(
+                {
+                    "metric": "replay.adopt_h2d",
+                    "skipped": f"{type(exc).__name__}: {exc}",
+                    "unit": "bytes",
+                }
+            )
+
+    doc = {
+        "n": args.round if args.round is not None else next_round(args.out_dir, "REPLAY"),
+        "cmd": shlex.join(
+            [os.path.basename(sys.executable), "tools/bench_replay.py", *(argv or sys.argv[1:])]
+        ),
+        "rc": 1 if failures else 0,
+        "schema": "sheeprl_tpu/replay/v1",
+        "wall_s": round(time.monotonic() - t0, 1),
+        "cells": len(lines),
+        "tail": "\n".join(json.dumps(line) for line in lines),
+    }
+    if args.no_write:
+        print(json.dumps(doc, indent=1))
+    else:
+        path = os.path.join(args.out_dir, f"REPLAY_r{doc['n']:02d}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"[bench-replay] wrote {path} ({doc['cells']} cells, {doc['wall_s']}s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

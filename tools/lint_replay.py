@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Replay-uniformity lint: every algo entrypoint gets its replay storage from
+the one factory.
+
+Replay construction lives exactly once, in ``sheeprl_tpu/replay/factory.py``
+(``make_replay_buffer``): size arithmetic, memmap directory layout, dreamer's
+sequential-vs-episode dispatch, and the sharded/prioritized replay-plane
+policy (``replay.shards`` / ``replay.strategy``). Before the factory existed
+the same five-line construction block was copy-pasted across sixteen
+entrypoints — which is exactly how the sharded replay plane could NOT have
+been slid under them. This lint fails when a file under
+``sheeprl_tpu/algos/`` re-grows inline construction:
+
+- a direct ``ReplayBuffer(...)`` / ``SequentialReplayBuffer(...)`` /
+  ``EpisodeBuffer(...)`` / ``EnvIndependentReplayBuffer(...)`` /
+  ``ShardedReplay(...)`` construction (call ``make_replay_buffer`` instead);
+- an import of those classes from ``sheeprl_tpu.data.buffers`` or
+  ``sheeprl_tpu.replay`` (``isinstance`` checks go through the staging
+  object's surface, not the concrete classes).
+
+The jax-backend rollout engine's device ring
+(``DeviceRingTransitions``) is storage for *collection*, not replay
+construction, and stays allowed.
+
+AST-based, so comments and docstrings are fine. Usage:
+``python tools/lint_replay.py`` — exits non-zero with a findings list on
+violation. Wired into the CI tier-1 lane (.github/workflows/tests.yml).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALGOS_DIR = os.path.join(REPO, "sheeprl_tpu", "algos")
+
+#: buffer classes only the factory may construct
+FORBIDDEN_CLASSES = {
+    "ReplayBuffer",
+    "SequentialReplayBuffer",
+    "EpisodeBuffer",
+    "EnvIndependentReplayBuffer",
+    "ShardedReplay",
+}
+
+#: modules whose buffer-class imports are forbidden in algos/
+BUFFER_MODULES = {"sheeprl_tpu.data.buffers", "sheeprl_tpu.replay"}
+
+#: names algos/ may import from those modules (the sanctioned surface)
+ALLOWED_IMPORTS = {
+    "make_replay_buffer",
+    "replay_config",
+    "shard_env_split",
+    "ReplayPlane",
+}
+
+
+def lint_file(path: str) -> list:
+    src = open(path).read()
+    tree = ast.parse(src, filename=path)
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if name in FORBIDDEN_CLASSES:
+                findings.append(
+                    (node.lineno,
+                     f"inline replay construction `{name}(...)` — build "
+                     "replay storage through the one factory: "
+                     "sheeprl_tpu.replay.make_replay_buffer(...)")
+                )
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in BUFFER_MODULES:
+                for alias in node.names:
+                    if alias.name in FORBIDDEN_CLASSES or (
+                        node.module == "sheeprl_tpu.data.buffers"
+                        and alias.name not in ALLOWED_IMPORTS
+                        and alias.name in FORBIDDEN_CLASSES
+                    ):
+                        findings.append(
+                            (node.lineno,
+                             f"buffer-class import `{alias.name}` from "
+                             f"{node.module} — algos/ talks to replay storage "
+                             "through make_replay_buffer and the staging "
+                             "facade, never the concrete classes")
+                        )
+    return findings
+
+
+def main() -> int:
+    failures = []
+    for root, _dirs, files in os.walk(ALGOS_DIR):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            for lineno, msg in lint_file(path):
+                failures.append(f"{os.path.relpath(path, REPO)}:{lineno}: {msg}")
+    if failures:
+        print("replay-uniformity lint FAILED:")
+        for f in failures:
+            print(f"  {f}")
+        print(
+            "\nAll replay construction in sheeprl_tpu/algos/ must go through "
+            "sheeprl_tpu/replay/factory.py (make_replay_buffer)."
+        )
+        return 1
+    print("replay-uniformity lint passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
